@@ -1,0 +1,28 @@
+"""jylis-tpu: a TPU-native distributed in-memory database for delta-state CRDTs.
+
+Functional equivalent of the jylis reference (a masterless CRDT database
+speaking the Redis RESP protocol; see /root/reference/README.md:3-6), built
+TPU-first: every CRDT keyspace is a struct-of-arrays tensor resident on the
+accelerator, and the anti-entropy merge hot path (reference:
+jylis/cluster.pony:250-252 -> repo_manager.pony:92-93) is a batched XLA
+lattice-join kernel instead of a sequential per-key loop.
+
+Layering (mirrors SURVEY.md section 1, re-designed for JAX/XLA):
+
+  utils/     config, logging, name generation          (reference L0)
+  ops/       CRDT lattice kernels, jit/vmap-able       (reference L2, pony-crdt)
+  models/    per-type repos + database router          (reference L3/L4)
+  cluster/   gossip membership + anti-entropy          (reference L5)
+  server/    RESP protocol server                      (reference L6)
+  parallel/  mesh sharding of the keyspace (pjit)      (no reference analog;
+             scale-out of the merge path across chips)
+
+64-bit integers are required by the data-type semantics (u64 timestamps and
+counters, docs/_docs/types/*.md), so x64 mode is enabled at import.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

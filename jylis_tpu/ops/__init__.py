@@ -1,0 +1,14 @@
+"""CRDT lattice kernels.
+
+Each data type is a join-semilattice expressed as pure, jit/vmap-able
+functions over struct-of-arrays state (the TPU-native re-design of the
+pony-crdt library the reference depends on; semantics pinned by
+/root/reference/docs/_docs/types/*.md "Detailed Semantics").
+
+Device kernels:  gcount, pncount, treg, tlog  (dense/padded tensor layouts)
+Host lattices:   hostref (pure-Python reference used for differential tests,
+                 the SYSTEM log, and the CPU baseline), ujson_host, p2set
+"""
+
+from . import gcount, pncount, treg, tlog, hostref  # noqa: F401
+from .interner import Interner  # noqa: F401

@@ -1,0 +1,81 @@
+"""GCOUNT: grow-only counter lattice as batched TPU kernels.
+
+Semantics (docs/_docs/types/gcount.md:43-47): state is a map
+replica-id -> u64; join takes the per-replica max; the counter's value is the
+sum over replicas. Driven by the reference repo at
+jylis/repo_gcount.pony:25-60 (INC adds to this node's entry, GET sums).
+
+TPU-native layout: the whole keyspace for the type is ONE dense tensor
+``counts[key, replica] : uint64`` (replica ids are interned to columns on the
+host). The per-key sequential converge loop of the reference
+(repo_manager.pony:92-93) becomes a single scatter-max over the batch — one
+XLA op regardless of batch size, which is the BASELINE.json north star.
+
+All functions are pure and jittable; duplicate keys inside one batch are safe
+because max/add are commutative-associative combiners.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+UINT64 = jnp.uint64
+
+
+class GCountState(NamedTuple):
+    """Dense grow-only counter keyspace: ``counts[key, replica]``."""
+
+    counts: jax.Array  # (K, R) uint64
+
+
+def init(num_keys: int, num_replicas: int) -> GCountState:
+    return GCountState(jnp.zeros((num_keys, num_replicas), UINT64))
+
+
+def join(a: GCountState, b: GCountState) -> GCountState:
+    """Full-state lattice join: elementwise per-replica max."""
+    return GCountState(jnp.maximum(a.counts, b.counts))
+
+
+def converge_batch(
+    state: GCountState, key_idx: jax.Array, deltas: jax.Array
+) -> GCountState:
+    """Join a batch of per-key deltas into the keyspace in one scatter-max.
+
+    key_idx: (B,) int32 rows to merge into; deltas: (B, R) uint64 joinable
+    delta states (absolute per-replica values, delta-CRDT style). Out-of-range
+    rows are dropped, matching fire-and-forget delivery (SURVEY.md section 2.5).
+    """
+    return GCountState(state.counts.at[key_idx].max(deltas, mode="drop"))
+
+
+def increment(
+    state: GCountState,
+    key_idx: jax.Array,
+    replica_idx: jax.Array,
+    amount: jax.Array,
+) -> GCountState:
+    """Local INC: add amounts at (key, replica) coordinates (u64 wraparound,
+    same overflow posture as the reference's Pony u64)."""
+    return GCountState(state.counts.at[key_idx, replica_idx].add(amount, mode="drop"))
+
+
+def read(state: GCountState, key_idx: jax.Array) -> jax.Array:
+    """GET for a batch of keys: row sums, uint64."""
+    return jnp.sum(state.counts[key_idx], axis=-1, dtype=UINT64)
+
+
+def read_all(state: GCountState) -> jax.Array:
+    return jnp.sum(state.counts, axis=-1, dtype=UINT64)
+
+
+def grow(state: GCountState, num_keys: int, num_replicas: int) -> GCountState:
+    """Host-side capacity growth (zeros are the lattice identity)."""
+    k, r = state.counts.shape
+    if num_keys == k and num_replicas == r:
+        return state
+    out = jnp.zeros((num_keys, num_replicas), UINT64)
+    return GCountState(out.at[:k, :r].set(state.counts))

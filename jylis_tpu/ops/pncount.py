@@ -1,0 +1,89 @@
+"""PNCOUNT: positive/negative counter lattice as batched TPU kernels.
+
+Semantics (docs/_docs/types/pncount.md:49-55): two grow-only per-replica
+maps, P and N, converged independently by per-replica max; the value is
+sum(P) - sum(N) as a signed 64-bit integer. Reference repo:
+jylis/repo_pncount.pony:26-67 (INC grows P, DEC grows N, GET nets them).
+
+Layout mirrors gcount: two (K, R) uint64 tensors; batched converge is two
+scatter-max ops. This type is the north-star benchmark target
+(BASELINE.json: 1M-key, 64-replica anti-entropy).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+UINT64 = jnp.uint64
+
+
+class PNCountState(NamedTuple):
+    p: jax.Array  # (K, R) uint64 — increments per replica
+    n: jax.Array  # (K, R) uint64 — decrements per replica
+
+
+def init(num_keys: int, num_replicas: int) -> PNCountState:
+    z = jnp.zeros((num_keys, num_replicas), UINT64)
+    return PNCountState(z, z)
+
+
+def join(a: PNCountState, b: PNCountState) -> PNCountState:
+    return PNCountState(jnp.maximum(a.p, b.p), jnp.maximum(a.n, b.n))
+
+
+def converge_batch(
+    state: PNCountState,
+    key_idx: jax.Array,
+    delta_p: jax.Array,
+    delta_n: jax.Array,
+) -> PNCountState:
+    """Join a delta batch: (B,) key rows, (B, R) joinable P and N deltas."""
+    return PNCountState(
+        state.p.at[key_idx].max(delta_p, mode="drop"),
+        state.n.at[key_idx].max(delta_n, mode="drop"),
+    )
+
+
+def increment(
+    state: PNCountState, key_idx: jax.Array, replica_idx: jax.Array, amount: jax.Array
+) -> PNCountState:
+    return PNCountState(
+        state.p.at[key_idx, replica_idx].add(amount, mode="drop"), state.n
+    )
+
+
+def decrement(
+    state: PNCountState, key_idx: jax.Array, replica_idx: jax.Array, amount: jax.Array
+) -> PNCountState:
+    return PNCountState(
+        state.p, state.n.at[key_idx, replica_idx].add(amount, mode="drop")
+    )
+
+
+def read(state: PNCountState, key_idx: jax.Array) -> jax.Array:
+    """GET for a batch of keys: signed net value.
+
+    Computed with u64 wraparound then bitcast to int64, matching the
+    reference's Pony (p_sum - n_sum).i64() modular behavior
+    (repo_pncount.pony:55-57).
+    """
+    p = jnp.sum(state.p[key_idx], axis=-1, dtype=UINT64)
+    n = jnp.sum(state.n[key_idx], axis=-1, dtype=UINT64)
+    return jax.lax.bitcast_convert_type(p - n, jnp.int64)
+
+
+def read_all(state: PNCountState) -> jax.Array:
+    p = jnp.sum(state.p, axis=-1, dtype=UINT64)
+    n = jnp.sum(state.n, axis=-1, dtype=UINT64)
+    return jax.lax.bitcast_convert_type(p - n, jnp.int64)
+
+
+def grow(state: PNCountState, num_keys: int, num_replicas: int) -> PNCountState:
+    k, r = state.p.shape
+    if num_keys == k and num_replicas == r:
+        return state
+    z = jnp.zeros((num_keys, num_replicas), UINT64)
+    return PNCountState(z.at[:k, :r].set(state.p), z.at[:k, :r].set(state.n))

@@ -1,0 +1,222 @@
+"""TLOG: timestamped log with grow-only cutoff as batched TPU kernels.
+
+Semantics (docs/_docs/types/tlog.md:116-133): a log is a list of
+(value, ts) entries sorted ts-desc (value-desc on ties); merging unions the
+lists, drops duplicates (equal ts AND equal value), takes the max cutoff,
+and discards entries with ts < cutoff. Reference repo:
+jylis/repo_tlog.pony:29-111 (INS/GET/SIZE/CUTOFF/TRIM/TRIMAT/CLR).
+
+TPU-native layout: the keyspace is a padded 2-D block —
+``ts[key, slot] : uint64``, ``vid[key, slot] : int64`` (interned value id,
+-1 = empty slot), ``rank[key, slot] : uint64`` (order-preserving value
+prefix), plus ``length[key] : int32`` and ``cutoff[key] : uint64``. Rows are
+kept in canonical device order: valid entries first, sorted by
+(ts desc, rank desc, vid desc). vid is a deterministic final tie-break so
+replicas converge to identical tensors; host GET rendering re-sorts the one
+requested row with full strings, so client-visible ordering is exactly the
+documented string order even on rank-prefix collisions.
+
+The merge is a vmap'd sort-dedup-mask kernel: concat both rows, two stable
+multi-key ``lax.sort`` passes (order, then compaction), neighbor-equality
+dedup — O(L log L) in parallel on device versus the reference's sequential
+per-entry list insertion.
+
+Contract: one converge batch has at most one delta per key (deltas coalesce
+per key per flush window, as in the reference repo pattern).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+UINT64 = jnp.uint64
+INT64 = jnp.int64
+
+
+class TLogState(NamedTuple):
+    ts: jax.Array  # (K, L) uint64, 0 in empty slots
+    rank: jax.Array  # (K, L) uint64, 0 in empty slots
+    vid: jax.Array  # (K, L) int64, -1 in empty slots
+    length: jax.Array  # (K,) int32 valid-entry count
+    cutoff: jax.Array  # (K,) uint64 grow-only cutoff timestamp
+
+
+def init(num_keys: int, max_len: int) -> TLogState:
+    return TLogState(
+        jnp.zeros((num_keys, max_len), UINT64),
+        jnp.zeros((num_keys, max_len), UINT64),
+        jnp.full((num_keys, max_len), -1, INT64),
+        jnp.zeros((num_keys,), jnp.int32),
+        jnp.zeros((num_keys,), UINT64),
+    )
+
+
+def _canonicalize(ts, rank, vid, valid):
+    """Stable-sort one row to canonical order: valid entries first, then
+    (ts desc, rank desc, vid desc). Returns (ts, rank, vid, length)."""
+    inv = (~valid).astype(jnp.int32)
+    _, _, _, _, ts, rank, vid = lax.sort(
+        (inv, ~ts, ~rank, ~vid.astype(UINT64), ts, rank, vid),
+        dimension=0,
+        is_stable=True,
+        num_keys=4,
+    )
+    length = jnp.sum(valid).astype(jnp.int32)
+    # scrub invalid slots to the padding identity so states are bitwise equal
+    idx = jnp.arange(ts.shape[0])
+    keep = idx < length
+    return (
+        jnp.where(keep, ts, 0),
+        jnp.where(keep, rank, 0),
+        jnp.where(keep, vid, -1),
+        length,
+    )
+
+
+def _merge_row(a_ts, a_rank, a_vid, a_cut, b_ts, b_rank, b_vid, b_cut):
+    """Join two padded rows -> (ts, rank, vid, length, cutoff) of size
+    len(a)+len(b) (caller truncates; see converge_batch overflow contract)."""
+    ts = jnp.concatenate([a_ts, b_ts])
+    rank = jnp.concatenate([a_rank, b_rank])
+    vid = jnp.concatenate([a_vid, b_vid])
+    cut = jnp.maximum(a_cut, b_cut)
+    valid = (vid >= 0) & (ts >= cut)
+    ts, rank, vid, _ = _canonicalize(ts, rank, vid, valid)
+    # duplicates (equal ts AND value; vid equality IS value equality) are now
+    # adjacent — drop every entry equal to its left neighbor
+    dup = jnp.zeros(ts.shape, bool).at[1:].set(
+        (ts[1:] == ts[:-1]) & (vid[1:] == vid[:-1]) & (vid[1:] >= 0)
+    )
+    ts, rank, vid, length = _canonicalize(ts, rank, vid, (vid >= 0) & ~dup)
+    return ts, rank, vid, length, cut
+
+
+def converge_batch(
+    state: TLogState,
+    key_idx: jax.Array,
+    d_ts: jax.Array,
+    d_rank: jax.Array,
+    d_vid: jax.Array,
+    d_cutoff: jax.Array,
+) -> tuple[TLogState, jax.Array]:
+    """Join delta logs into the keyspace (unique keys per batch).
+
+    key_idx: (B,); d_ts/d_rank/d_vid: (B, Ld) padded delta rows; d_cutoff:
+    (B,). Returns (state, overflow) where overflow (B,) bool flags rows whose
+    merged length exceeded capacity L. Overflowed rows in the RETURNED state
+    are truncated (lowest-(ts,value) entries dropped); on overflow the caller
+    must discard the returned state, grow() the retained PRE-merge state, and
+    re-merge the delta into that. The host repo checks lengths up front to
+    make this path rare.
+    """
+    L = state.ts.shape[1]
+    a_ts = state.ts[key_idx]
+    a_rank = state.rank[key_idx]
+    a_vid = state.vid[key_idx]
+    a_cut = state.cutoff[key_idx]
+    m_ts, m_rank, m_vid, m_len, m_cut = jax.vmap(_merge_row)(
+        a_ts, a_rank, a_vid, a_cut, d_ts, d_rank, d_vid, d_cutoff
+    )
+    overflow = m_len > L
+    return (
+        TLogState(
+            state.ts.at[key_idx].set(m_ts[:, :L], mode="drop"),
+            state.rank.at[key_idx].set(m_rank[:, :L], mode="drop"),
+            state.vid.at[key_idx].set(m_vid[:, :L], mode="drop"),
+            state.length.at[key_idx].set(jnp.minimum(m_len, L), mode="drop"),
+            state.cutoff.at[key_idx].set(m_cut, mode="drop"),
+        ),
+        overflow,
+    )
+
+
+def insert_batch(
+    state: TLogState,
+    key_idx: jax.Array,
+    ts: jax.Array,
+    rank: jax.Array,
+    vid: jax.Array,
+) -> tuple[TLogState, jax.Array]:
+    """Local INS of one entry per key (unique keys): a 1-entry log join."""
+    return converge_batch(
+        state,
+        key_idx,
+        ts[:, None],
+        rank[:, None],
+        vid[:, None],
+        jnp.zeros(key_idx.shape, UINT64),
+    )
+
+
+def _row_apply_cutoff(ts, rank, vid, length, new_cut):
+    """Drop the suffix with ts < new_cut from a canonical-order row."""
+    keep = jnp.sum((ts >= new_cut) & (vid >= 0)).astype(jnp.int32)
+    idx = jnp.arange(ts.shape[0])
+    m = idx < keep
+    return jnp.where(m, ts, 0), jnp.where(m, rank, 0), jnp.where(m, vid, -1), keep
+
+
+def trimat_batch(state: TLogState, key_idx: jax.Array, t: jax.Array) -> TLogState:
+    """TRIMAT: raise each key's cutoff to max(cutoff, t) and drop older
+    entries (tlog.md:46-52)."""
+    new_cut = jnp.maximum(state.cutoff[key_idx], t)
+    r_ts, r_rank, r_vid, r_len = jax.vmap(_row_apply_cutoff)(
+        state.ts[key_idx],
+        state.rank[key_idx],
+        state.vid[key_idx],
+        state.length[key_idx],
+        new_cut,
+    )
+    return TLogState(
+        state.ts.at[key_idx].set(r_ts, mode="drop"),
+        state.rank.at[key_idx].set(r_rank, mode="drop"),
+        state.vid.at[key_idx].set(r_vid, mode="drop"),
+        state.length.at[key_idx].set(r_len, mode="drop"),
+        state.cutoff.at[key_idx].set(new_cut, mode="drop"),
+    )
+
+
+def trim_batch(state: TLogState, key_idx: jax.Array, count: jax.Array) -> TLogState:
+    """TRIM: cutoff := ts of entry at index count-1 (tlog.md:54-60);
+    count 0 == CLR; count > length is a no-op; count < 0 is a no-op (the
+    reference parses count as unsigned, so negatives never occur there)."""
+    rows_ts = state.ts[key_idx]  # (B, L)
+    length = state.length[key_idx]
+    L = rows_ts.shape[1]
+    at = jnp.clip(count - 1, 0, L - 1)
+    ts_at = jnp.take_along_axis(rows_ts, at[:, None], axis=1)[:, 0]
+    latest_plus1 = jnp.where(length > 0, rows_ts[:, 0] + 1, 0)  # CLR target
+    target = jnp.where(
+        count == 0,
+        latest_plus1,
+        jnp.where((count > 0) & (count <= length), ts_at, 0),
+    )
+    return trimat_batch(state, key_idx, target)
+
+
+def clear_batch(state: TLogState, key_idx: jax.Array) -> TLogState:
+    """CLR: cutoff := latest ts + 1; no-op on empty logs (tlog.md:62-66)."""
+    return trim_batch(state, key_idx, jnp.zeros(key_idx.shape, jnp.int64))
+
+
+def read_row(state: TLogState, key: jax.Array):
+    """GET: one key's padded row (ts, vid, length) — host renders & sorts
+    with full strings."""
+    return state.ts[key], state.vid[key], state.length[key]
+
+
+def grow(state: TLogState, num_keys: int, max_len: int) -> TLogState:
+    k, l = state.ts.shape
+    if (num_keys, max_len) == (k, l):
+        return state
+    return TLogState(
+        jnp.zeros((num_keys, max_len), UINT64).at[:k, :l].set(state.ts),
+        jnp.zeros((num_keys, max_len), UINT64).at[:k, :l].set(state.rank),
+        jnp.full((num_keys, max_len), -1, INT64).at[:k, :l].set(state.vid),
+        jnp.zeros((num_keys,), jnp.int32).at[:k].set(state.length),
+        jnp.zeros((num_keys,), UINT64).at[:k].set(state.cutoff),
+    )

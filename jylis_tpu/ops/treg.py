@@ -1,0 +1,137 @@
+"""TREG: last-writer-wins timestamped register as batched TPU kernels.
+
+Semantics (docs/_docs/types/treg.md:56-63): a register keeps one
+(value, timestamp) pair; pair A beats pair B iff ts_A > ts_B, or the
+timestamps are equal and value_A > value_B by string sorting rules.
+Reference repo: jylis/repo_treg.pony:24-68.
+
+TPU-native layout: the keyspace is three parallel vectors —
+``ts[key] : uint64``, ``rank[key] : uint64`` (order-preserving 8-byte value
+prefix, see ops/interner.py), and ``vid[key] : int64`` (interned value id,
+-1 = unset). The value tie-break runs on-device via the rank; batches where
+ts and rank are equal but vids differ (a prefix collision) are flagged and
+resolved on host with full strings — correctness is exact, the device just
+fast-paths the overwhelmingly common case.
+
+Contract: one batch must contain at most one delta per key (the reference
+coalesces per-key deltas per flush window, repo_gcount.pony:43-48 pattern);
+use ``converge_many`` to fold several replica batches.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+UINT64 = jnp.uint64
+
+
+class TRegState(NamedTuple):
+    ts: jax.Array  # (K,) uint64; 0 when unset
+    rank: jax.Array  # (K,) uint64 value-prefix rank; 0 when unset
+    vid: jax.Array  # (K,) int64 interned value id; -1 when unset
+
+
+def init(num_keys: int) -> TRegState:
+    return TRegState(
+        jnp.zeros((num_keys,), UINT64),
+        jnp.zeros((num_keys,), UINT64),
+        jnp.full((num_keys,), -1, jnp.int64),
+    )
+
+
+def _b_wins(
+    ts_a: jax.Array, rank_a: jax.Array, vid_a: jax.Array,
+    ts_b: jax.Array, rank_b: jax.Array, vid_b: jax.Array,
+):
+    """Where pair B strictly beats pair A, plus an on-host-tie flag.
+
+    An unset register (vid -1, ts 0, rank 0) loses to any set pair: a set
+    pair has either ts > 0 or a real value whose presence beats absence —
+    encoded by treating vid >= 0 as a final presence tie-break.
+    """
+    wins = (ts_b > ts_a) | (
+        (ts_b == ts_a)
+        & ((rank_b > rank_a) | ((rank_b == rank_a) & (vid_a < 0) & (vid_b >= 0)))
+    )
+    tie = (ts_b == ts_a) & (rank_b == rank_a) & (vid_a >= 0) & (vid_b >= 0) & (vid_a != vid_b)
+    return wins, tie
+
+
+def converge_batch(
+    state: TRegState,
+    key_idx: jax.Array,
+    d_ts: jax.Array,
+    d_rank: jax.Array,
+    d_vid: jax.Array,
+) -> tuple[TRegState, jax.Array]:
+    """Join one delta batch (unique keys): gather rows, compare, scatter.
+
+    Returns (new_state, tie_mask); tie_mask (B,) bool marks rows whose
+    winner must be decided on host by full string comparison.
+    """
+    cur_ts = state.ts[key_idx]
+    cur_rank = state.rank[key_idx]
+    cur_vid = state.vid[key_idx]
+    wins, tie = _b_wins(cur_ts, cur_rank, cur_vid, d_ts, d_rank, d_vid)
+    new_ts = jnp.where(wins, d_ts, cur_ts)
+    new_rank = jnp.where(wins, d_rank, cur_rank)
+    new_vid = jnp.where(wins, d_vid, cur_vid)
+    return (
+        TRegState(
+            state.ts.at[key_idx].set(new_ts, mode="drop"),
+            state.rank.at[key_idx].set(new_rank, mode="drop"),
+            state.vid.at[key_idx].set(new_vid, mode="drop"),
+        ),
+        tie,
+    )
+
+
+def converge_many(
+    state: TRegState,
+    key_idx: jax.Array,
+    d_ts: jax.Array,
+    d_rank: jax.Array,
+    d_vid: jax.Array,
+) -> tuple[TRegState, jax.Array]:
+    """Fold several replica batches: inputs are (N, B)-shaped; scans over N.
+
+    Returns (state, tie_mask (N, B)). One compiled program for the whole
+    anti-entropy round (BASELINE.json config 3: 1M keys, random-ts merge).
+    """
+
+    def step(st, batch):
+        ki, ts, rk, vd = batch
+        st, tie = converge_batch(st, ki, ts, rk, vd)
+        return st, tie
+
+    return jax.lax.scan(step, state, (key_idx, d_ts, d_rank, d_vid))
+
+
+def set_batch(
+    state: TRegState,
+    key_idx: jax.Array,
+    ts: jax.Array,
+    rank: jax.Array,
+    vid: jax.Array,
+) -> tuple[TRegState, jax.Array]:
+    """Local SET is lattice-identical to converging a delta (LWW join)."""
+    return converge_batch(state, key_idx, ts, rank, vid)
+
+
+def read(state: TRegState, key_idx: jax.Array):
+    """GET for a batch of keys -> (ts, vid); vid -1 means nil reply."""
+    return state.ts[key_idx], state.vid[key_idx]
+
+
+def grow(state: TRegState, num_keys: int) -> TRegState:
+    k = state.ts.shape[0]
+    if num_keys == k:
+        return state
+    return TRegState(
+        jnp.zeros((num_keys,), UINT64).at[:k].set(state.ts),
+        jnp.zeros((num_keys,), UINT64).at[:k].set(state.rank),
+        jnp.full((num_keys,), -1, jnp.int64).at[:k].set(state.vid),
+    )

@@ -1,0 +1,159 @@
+"""Differential tests for the TLOG device kernel against hostref.TLog.
+
+Random INS/TRIM/TRIMAT/CLR workloads plus cross-replica merges in random
+delivery orders must agree with the pure-Python oracle implementing
+docs/_docs/types/tlog.md:116-133.
+"""
+
+import numpy as np
+import pytest
+
+import jylis_tpu  # noqa: F401
+from jylis_tpu.ops import tlog, hostref
+from jylis_tpu.ops.interner import Interner, prefix_rank
+
+K, L = 8, 64
+
+
+def row_entries(state, k, interner):
+    """Decode one key's row into the oracle's [(value, ts)] desc order."""
+    ts = np.asarray(state.ts[k])
+    vid = np.asarray(state.vid[k])
+    n = int(np.asarray(state.length[k]))
+    ents = [(interner.lookup(int(vid[i])), int(ts[i])) for i in range(n)]
+    # client-visible order: host re-sort by (ts desc, value desc)
+    return sorted(ents, key=lambda e: (e[1], e[0]), reverse=True)
+
+
+def ins(state, interner, key, value, ts):
+    vid = interner.intern(value)
+    st, ovf = tlog.insert_batch(
+        state,
+        np.array([key], np.int32),
+        np.array([ts], np.uint64),
+        np.array([prefix_rank(value)], np.uint64),
+        np.array([vid], np.int64),
+    )
+    assert not bool(np.asarray(ovf)[0])
+    return st
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_tlog_random_ops_match_hostref(seed):
+    rng = np.random.default_rng(seed)
+    interner = Interner()
+    state = tlog.init(K, L)
+    refs = [hostref.TLog() for _ in range(K)]
+
+    for _ in range(150):
+        k = int(rng.integers(0, K))
+        op = rng.random()
+        if op < 0.7:
+            # small spaces force duplicate (ts, value) pairs and ts ties
+            v = bytes([97 + int(rng.integers(0, 3))])
+            t = int(rng.integers(0, 20))
+            state = ins(state, interner, k, v, t)
+            refs[k].insert(v, t)
+        elif op < 0.8:
+            c = int(rng.integers(0, 6))
+            state = tlog.trim_batch(
+                state, np.array([k], np.int32), np.array([c], np.int64)
+            )
+            refs[k].trim(c)
+        elif op < 0.9:
+            t = int(rng.integers(0, 20))
+            state = tlog.trimat_batch(
+                state, np.array([k], np.int32), np.array([t], np.uint64)
+            )
+            refs[k].raise_cutoff(t)
+        else:
+            state = tlog.clear_batch(state, np.array([k], np.int32))
+            refs[k].clear()
+
+    for k in range(K):
+        assert row_entries(state, k, interner) == refs[k].latest()
+        assert int(np.asarray(state.cutoff[k])) == refs[k].cutoff
+        assert int(np.asarray(state.length[k])) == refs[k].size()
+
+
+def test_tlog_merge_order_independent():
+    """Three replicas write disjoint + overlapping entries; all delivery
+    orders converge to the oracle merge."""
+    rng = np.random.default_rng(5)
+    interner = Interner()
+    n_rep = 3
+
+    rep_logs = [[hostref.TLog() for _ in range(K)] for _ in range(n_rep)]
+    for rep in range(n_rep):
+        for _ in range(40):
+            k = int(rng.integers(0, K))
+            v = bytes([97 + int(rng.integers(0, 4))])
+            t = int(rng.integers(0, 30))
+            rep_logs[rep][k].insert(v, t)
+        # one replica also trims
+        if rep == 1:
+            for k in range(K):
+                rep_logs[rep][k].trim(3)
+
+    oracle = [hostref.TLog() for _ in range(K)]
+    for rep in range(n_rep):
+        for k in range(K):
+            oracle[k].converge(rep_logs[rep][k])
+
+    def delta_rows(rep):
+        ts = np.zeros((K, L), np.uint64)
+        rank = np.zeros((K, L), np.uint64)
+        vid = np.full((K, L), -1, np.int64)
+        cut = np.zeros((K,), np.uint64)
+        for k in range(K):
+            for i, (v, t) in enumerate(rep_logs[rep][k].latest()):
+                ts[k, i] = t
+                rank[k, i] = prefix_rank(v)
+                vid[k, i] = interner.intern(v)
+            cut[k] = rep_logs[rep][k].cutoff
+        return ts, rank, vid, cut
+
+    all_keys = np.arange(K, dtype=np.int32)
+    for order_seed in range(4):
+        order = np.random.default_rng(order_seed).permutation(n_rep)
+        state = tlog.init(K, L)
+        for rep in order:
+            ts, rank, vid, cut = delta_rows(rep)
+            state, ovf = tlog.converge_batch(state, all_keys, ts, rank, vid, cut)
+            assert not np.asarray(ovf).any()
+            # duplicate delivery is harmless
+            state, _ = tlog.converge_batch(state, all_keys, ts, rank, vid, cut)
+        for k in range(K):
+            assert row_entries(state, k, interner) == oracle[k].latest(), (
+                order,
+                k,
+            )
+
+
+def test_tlog_overflow_flagged():
+    interner = Interner()
+    state = tlog.init(1, 2)
+    for i, t in enumerate([1, 2]):
+        state = ins(state, interner, 0, b"%d" % t, t)
+    vid = interner.intern(b"x")
+    _, ovf = tlog.insert_batch(
+        state,
+        np.array([0], np.int32),
+        np.array([9], np.uint64),
+        np.array([prefix_rank(b"x")], np.uint64),
+        np.array([vid], np.int64),
+    )
+    assert bool(np.asarray(ovf)[0])
+
+
+def test_tlog_trim_then_reinsert_old_is_ignored():
+    interner = Interner()
+    state = tlog.init(1, 8)
+    for t in [10, 20, 30]:
+        state = ins(state, interner, 0, b"v", t)
+    state = tlog.trim_batch(state, np.array([0], np.int32), np.array([2], np.int64))
+    assert int(np.asarray(state.cutoff[0])) == 20
+    assert int(np.asarray(state.length[0])) == 2
+    # an entry older than the cutoff is outdated and ignored (tlog.md:34)
+    state = ins(state, interner, 0, b"old", 5)
+    assert int(np.asarray(state.length[0])) == 2
