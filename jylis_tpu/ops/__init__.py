@@ -10,5 +10,5 @@ Host lattices:   hostref (pure-Python reference used for differential tests,
                  the SYSTEM log, and the CPU baseline), ujson_host, p2set
 """
 
-from . import gcount, pncount, treg, tlog, hostref  # noqa: F401
+from . import gcount, pncount, treg, tlog, hostref, ujson_host, p2set  # noqa: F401
 from .interner import Interner  # noqa: F401

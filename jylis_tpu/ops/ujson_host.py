@@ -1,0 +1,234 @@
+"""UJSON: nested observed-remove maps/sets with causal add-wins semantics.
+
+Host-side authoritative implementation of the documented lattice
+(docs/_docs/types/ujson.md:134-182): a UJSON node is a flat set of
+(path, primitive-value) pairs, each tagged with a causal dot
+(replica-id, seq); removal is by causal context (observed-remove), and a
+concurrent insert of an identical pair beats its removal (add-wins).
+Reference repo driving it: jylis/repo_ujson.pony:28-110.
+
+The dot-store is an ORSWOT-style delta CRDT (Almeida et al.,
+"Efficient State-based CRDTs by Delta-Mutation", PAPERS.md): a mutation's
+delta carries only the new entries plus a causal context covering the new
+dots and every removed dot. Joins are: keep an entry iff it is present in
+both sides, or present in one side and its dot is NOT covered by the other
+side's context (i.e. the other side never observed it — it survives).
+
+This lattice lives on the host: its data volume per document is tiny and
+its structure is pointer-heavy; the TPU payoff in this system is the dense
+counter/register/log keyspaces (ops/gcount etc.). A device-batched join for
+large UJSON fan-ins is future work tracked in parallel/PLAN.md.
+
+Values are stored as canonical JSON tokens (the exact primitive serialisation,
+e.g. '"user"', '42', 'true', 'null') so value identity is representation
+identity — 1 and 1.0 stay distinct, matching string-typed storage in the
+reference.
+"""
+
+from __future__ import annotations
+
+import json
+
+Dot = tuple[int, int]  # (replica-id, seq)
+Path = tuple[str, ...]
+
+
+class CausalContext:
+    """Compacted causal history: per-replica contiguous max (version vector)
+    plus a cloud of out-of-band dots (ujson.md:176 — compaction keeps this
+    bounded)."""
+
+    __slots__ = ("vv", "cloud")
+
+    def __init__(self):
+        self.vv: dict[int, int] = {}
+        self.cloud: set[Dot] = set()
+
+    def contains(self, dot: Dot) -> bool:
+        r, s = dot
+        return s <= self.vv.get(r, 0) or dot in self.cloud
+
+    def add(self, dot: Dot) -> None:
+        self.cloud.add(dot)
+        self.compact()
+
+    def next_dot(self, replica: int) -> Dot:
+        """Mint the next contiguous dot for a replica (local mutations only)."""
+        s = self.vv.get(replica, 0) + 1
+        self.vv[replica] = s
+        return (replica, s)
+
+    def join(self, other: "CausalContext") -> None:
+        for r, s in other.vv.items():
+            if s > self.vv.get(r, 0):
+                self.vv[r] = s
+        self.cloud |= other.cloud
+        self.compact()
+
+    def compact(self) -> None:
+        moved = True
+        while moved:
+            moved = False
+            for dot in list(self.cloud):
+                r, s = dot
+                top = self.vv.get(r, 0)
+                if s == top + 1:
+                    self.vv[r] = s
+                    self.cloud.discard(dot)
+                    moved = True
+                elif s <= top:
+                    self.cloud.discard(dot)
+                    moved = True
+
+
+def parse_doc(doc: str) -> list[tuple[Path, str]]:
+    """Parse a JSON document into its UJSON leaves: (relative-path, token).
+
+    Maps extend the path; sets (JSON arrays) do NOT contribute path
+    components, which is exactly why nested sets flatten and sibling maps
+    in a set merge (ujson.md:165-170).
+    """
+    data = json.loads(doc)
+    leaves: list[tuple[Path, str]] = []
+
+    def walk(node, path: Path):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(v, path + (str(k),))
+        elif isinstance(node, list):
+            for v in node:
+                walk(v, path)
+        else:
+            leaves.append((path, json.dumps(node)))
+
+    walk(data, ())
+    return leaves
+
+
+def parse_value(doc: str) -> str:
+    """Parse a single JSON primitive (INS/RM argument) to its token; raises
+    ValueError on maps/sets (ujson.md:83)."""
+    data = json.loads(doc)
+    if isinstance(data, (dict, list)):
+        raise ValueError("value must be a JSON primitive")
+    return json.dumps(data)
+
+
+class UJSON:
+    """One document: dot-store + causal context, with delta-mutators.
+
+    Every mutator takes an optional ``delta`` UJSON accumulating the minimal
+    joinable state of the mutation (the reference's delta-accumulator
+    pattern, repo_ujson.pony:53-66); deltas for the same document within a
+    flush window coalesce by join.
+    """
+
+    __slots__ = ("entries", "ctx")
+
+    def __init__(self):
+        self.entries: dict[Dot, tuple[Path, str]] = {}
+        self.ctx = CausalContext()
+
+    # ---- queries ----------------------------------------------------------
+
+    def _under(self, path: Path) -> list[Dot]:
+        n = len(path)
+        return [
+            d for d, (p, _) in self.entries.items() if p[:n] == path
+        ]
+
+    def is_empty(self) -> bool:
+        return not self.entries
+
+    def render(self, path: Path = ()) -> str:
+        """Render the subtree at path as compact JSON; "" when absent
+        (ujson.md:34-38). Set/map member order is unspecified by the
+        semantics; we emit a deterministic sorted order."""
+        n = len(path)
+        values: set[str] = set()
+        children: dict[str, bool] = {}
+        for p, token in self.entries.values():
+            if p[:n] != path:
+                continue
+            if len(p) == n:
+                values.add(token)
+            else:
+                children[p[n]] = True
+        if not values and not children:
+            return ""
+        rendered_map = None
+        if children:
+            items = sorted(children)
+            rendered_map = (
+                "{" + ",".join(json.dumps(k) + ":" + self.render(path + (k,)) for k in items) + "}"
+            )
+        vals = sorted(values)
+        if rendered_map is None:
+            return vals[0] if len(vals) == 1 else "[" + ",".join(vals) + "]"
+        if not vals:
+            return rendered_map
+        return "[" + ",".join(vals + [rendered_map]) + "]"
+
+    # ---- mutators ---------------------------------------------------------
+
+    def _remove_dots(self, dots, delta: "UJSON | None") -> None:
+        """Observed-remove: drop entries and record their dots in our context
+        and in the delta's context (no delta entries -> receiver removes)."""
+        for d in dots:
+            self.entries.pop(d, None)
+            self.ctx.add(d)
+            if delta is not None:
+                delta.ctx.add(d)
+
+    def _add_leaf(self, replica: int, path: Path, token: str, delta) -> None:
+        dot = self.ctx.next_dot(replica)
+        self.entries[dot] = (path, token)
+        if delta is not None:
+            delta.entries[dot] = (path, token)
+            delta.ctx.add(dot)
+
+    def set_doc(self, replica: int, path: Path, doc: str, delta=None) -> None:
+        """SET: clear the subtree (observed dots only), then add the parsed
+        leaves under fresh dots (ujson.md:44-61)."""
+        leaves = parse_doc(doc)
+        self._remove_dots(self._under(path), delta)
+        for sub, token in leaves:
+            self._add_leaf(replica, path + sub, token, delta)
+
+    def ins(self, replica: int, path: Path, value: str, delta=None) -> None:
+        """INS: add one primitive alongside existing values (ujson.md:77-89)."""
+        self._add_leaf(replica, path, parse_value(value), delta)
+
+    def rm(self, replica: int, path: Path, value: str, delta=None) -> None:
+        """RM: remove the observed dots of one exact (path, value) pair
+        (ujson.md:91-103)."""
+        token = parse_value(value)
+        dots = [
+            d for d, (p, t) in self.entries.items() if p == path and t == token
+        ]
+        self._remove_dots(dots, delta)
+
+    def clr(self, replica: int, path: Path, delta=None) -> None:
+        """CLR: remove all observed dots at or under path (ujson.md:63-75)."""
+        self._remove_dots(self._under(path), delta)
+
+    # ---- lattice ----------------------------------------------------------
+
+    def converge(self, other: "UJSON") -> bool:
+        """ORSWOT join; returns True if local state changed."""
+        changed = False
+        # entries present only here, observed (covered) by other -> removed
+        for d in list(self.entries):
+            if d not in other.entries and other.ctx.contains(d):
+                del self.entries[d]
+                changed = True
+        # entries present only there, not covered by us -> added
+        for d, pv in other.entries.items():
+            if d not in self.entries and not self.ctx.contains(d):
+                self.entries[d] = pv
+                changed = True
+        before = (dict(self.ctx.vv), set(self.ctx.cloud))
+        self.ctx.join(other.ctx)
+        if (self.ctx.vv, self.ctx.cloud) != before:
+            changed = True
+        return changed

@@ -1,0 +1,200 @@
+"""Tests for the UJSON host lattice.
+
+Executable versions of the documented semantics: the example session
+(docs/_docs/types/ujson.md:107-131), add-wins vs concurrent removal
+(ujson.md:61,75,89,103), observed-remove (ujson.md:73), set collapsing
+rules (ujson.md:140-170), plus convergence under random op/delivery orders.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from jylis_tpu.ops.ujson_host import UJSON, parse_doc, parse_value
+
+
+def test_parse_doc_flattening():
+    # nested sets flatten; maps in sets merge paths; ujson.md:165-170
+    leaves = parse_doc('[1, [2, 3], {"a": [4, {"b": 5}]}]')
+    got = sorted(leaves)
+    assert got == [
+        ((), "1"),
+        ((), "2"),
+        ((), "3"),
+        (("a",), "4"),
+        (("a", "b"), "5"),
+    ]
+
+
+def test_parse_value_rejects_collections():
+    with pytest.raises(ValueError):
+        parse_value("[1]")
+    with pytest.raises(ValueError):
+        parse_value('{"a":1}')
+    assert parse_value('"x"') == '"x"'
+
+
+def test_docs_example_session():
+    """The full example at ujson.md:107-131 (rendering order is unspecified
+    by the semantics; we compare parsed structures with sets as sorted
+    lists)."""
+    u = UJSON()
+    rep = 1
+    u.set_doc(rep, ("users:my-user",), '{"created_at":1514793601,"contact":{"email":"my-user@example.com"}}')
+    assert u.render(("users:my-user", "created_at")) == "1514793601"
+    assert json.loads(u.render(("users:my-user", "contact"))) == {"email": "my-user@example.com"}
+    u.ins(rep, ("users:my-user", "roles"), '"user"')
+    u.ins(rep, ("users:my-user", "roles"), '"vendor"')
+    assert json.loads(u.render(("users:my-user", "roles"))) == ["user", "vendor"]
+    u.ins(rep, ("users:my-user", "roles"), '"admin"')
+    u.rm(rep, ("users:my-user", "roles"), '"vendor"')
+    u.set_doc(rep, ("users:my-user", "contact", "email"), '"new-email@example.com"')
+    got = json.loads(u.render(("users:my-user",)))
+    assert got == {
+        "roles": ["admin", "user"],
+        "created_at": 1514793601,
+        "contact": {"email": "new-email@example.com"},
+    }
+    u.clr(rep, ("users:my-user",))
+    assert u.render(("users:my-user",)) == ""
+
+
+def test_duplicate_ins_idempotent():
+    # "A rose is a rose": adding a duplicate value has no effect; ujson.md:160-163
+    u = UJSON()
+    u.ins(1, ("s",), "1")
+    u.ins(1, ("s",), "1")
+    assert u.render(("s",)) == "1"  # set of one renders bare
+
+
+def test_single_value_renders_bare_and_empty_prunes():
+    u = UJSON()
+    u.ins(1, ("a", "b"), "true")
+    assert u.render(()) == '{"a":{"b":true}}'
+    u.rm(1, ("a", "b"), "true")
+    # cascading disappearance of empty maps; ujson.md:148-153
+    assert u.render(()) == ""
+
+
+def test_values_alongside_map_render_as_set():
+    u = UJSON()
+    u.ins(1, ("k",), "1")
+    u.set_doc(1, ("k", "nested"), "2")
+    got = json.loads(u.render(("k",)))
+    assert got == [1, {"nested": 2}]
+
+
+def test_add_wins_concurrent_remove():
+    """Replica A removes a value while replica B concurrently re-inserts the
+    identical value; after convergence the insertion survives everywhere."""
+    a, b = UJSON(), UJSON()
+    a.ins(1, ("x",), '"v"')
+    da = UJSON()
+    # sync initial state to b
+    b.converge(a)
+    # concurrent: A removes, B inserts the identical value again
+    a.rm(1, ("x",), '"v"', da)
+    db = UJSON()
+    b.ins(2, ("x",), '"v"', db)
+    a.converge(db)
+    b.converge(da)
+    assert a.render(("x",)) == '"v"'
+    assert b.render(("x",)) == '"v"'
+
+
+def test_observed_remove_only():
+    """CLR clears only causally-observed data: a concurrent insert at another
+    replica survives the clear (ujson.md:73)."""
+    a, b = UJSON(), UJSON()
+    a.ins(1, ("x",), "1")
+    b.converge(a)
+    # concurrent: b inserts 2; a clears (has never seen 2)
+    db = UJSON()
+    b.ins(2, ("x",), "2", db)
+    da = UJSON()
+    a.clr(1, ("x",), da)
+    a.converge(db)
+    b.converge(da)
+    assert a.render(("x",)) == "2"
+    assert b.render(("x",)) == "2"
+
+
+def test_concurrent_set_merges_to_set():
+    """Two replicas concurrently SET different values at the same path; the
+    converged result is a set of both (ujson.md:58-59)."""
+    a, b = UJSON(), UJSON()
+    da, db = UJSON(), UJSON()
+    a.set_doc(1, ("k",), '"x"', da)
+    b.set_doc(2, ("k",), '"y"', db)
+    a.converge(db)
+    b.converge(da)
+    assert json.loads(a.render(("k",))) == ["x", "y"]
+    assert a.render(("k",)) == b.render(("k",))
+
+
+def test_set_clears_before_write_causally():
+    a = UJSON()
+    a.ins(1, ("k",), "1")
+    a.ins(1, ("k",), "2")
+    a.set_doc(1, ("k",), "3")
+    assert a.render(("k",)) == "3"
+
+
+def test_delta_propagation_equals_full_state():
+    """Applying only the per-op deltas at a peer yields the same state as
+    applying the full state (delta-CRDT correctness)."""
+    rng = np.random.default_rng(0)
+    a = UJSON()
+    peer_delta = UJSON()  # coalesced delta stream
+    for i in range(100):
+        op = rng.random()
+        path = ("p%d" % rng.integers(0, 4),)
+        val = "%d" % rng.integers(0, 5)
+        d = UJSON()
+        if op < 0.5:
+            a.ins(1, path, val, d)
+        elif op < 0.7:
+            a.rm(1, path, val, d)
+        elif op < 0.9:
+            a.set_doc(1, path, val, d)
+        else:
+            a.clr(1, path, d)
+        peer_delta.converge(d)
+
+    via_deltas = UJSON()
+    via_deltas.converge(peer_delta)
+    via_state = UJSON()
+    via_state.converge(a)
+    assert via_deltas.render(()) == via_state.render(()) == a.render(())
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_multi_replica_random_convergence(seed):
+    """32 replicas make random concurrent edits (BASELINE.json config 5
+    shape); merging all deltas in any delivery order converges identically."""
+    rng = np.random.default_rng(seed)
+    n_rep = 32
+    reps = [UJSON() for _ in range(n_rep)]
+    deltas = [UJSON() for _ in range(n_rep)]
+    for r in range(n_rep):
+        for _ in range(10):
+            op = rng.random()
+            path = tuple("k%d" % x for x in rng.integers(0, 3, size=rng.integers(1, 3)))
+            val = "%d" % rng.integers(0, 4)
+            if op < 0.6:
+                reps[r].ins(r, path, val, deltas[r])
+            elif op < 0.8:
+                reps[r].set_doc(r, path, val, deltas[r])
+            else:
+                reps[r].rm(r, path, val, deltas[r])
+
+    renders = []
+    for order_seed in range(3):
+        order = np.random.default_rng(100 + order_seed).permutation(n_rep)
+        node = UJSON()
+        for r in order:
+            node.converge(deltas[r])
+            node.converge(deltas[r])  # duplicate delivery harmless
+        renders.append(node.render(()))
+    assert renders[0] == renders[1] == renders[2]
