@@ -1,0 +1,18 @@
+"""Data-type repos and the database router.
+
+Reference analog: the L3/L4 layers (SURVEY.md sections 2.2-2.3) —
+jylis/repo_*.pony and database.pony — re-designed for the host/device
+split: each repo keeps authoritative lattice state in device tensors
+(ops/), buffers mutations and incoming deltas into coalesced pending
+batches, and drains them as single fused XLA calls that also return the
+touched rows' serving values into a host cache, so reads are host dict
+lookups and the device sees only large batches.
+"""
+
+from .database import Database  # noqa: F401
+from .manager import RepoManager  # noqa: F401
+from .repo_counters import RepoGCOUNT, RepoPNCOUNT  # noqa: F401
+from .repo_treg import RepoTREG  # noqa: F401
+from .repo_tlog import RepoTLOG  # noqa: F401
+from .repo_ujson import RepoUJSON  # noqa: F401
+from .repo_system import RepoSYSTEM  # noqa: F401

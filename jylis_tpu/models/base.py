@@ -1,0 +1,62 @@
+"""Shared repo plumbing: argument parsing, batching helpers, capacity math.
+
+The repo plugin contract (mirroring RepoAny, repo_manager.pony:5-10):
+
+    apply(resp, args: list[bytes]) -> bool   # True if data changed;
+                                             # raises ParseError for help
+    deltas_size() -> int
+    flush_deltas() -> list[(key: bytes, delta)]
+    converge(key: bytes, delta) -> None
+
+plus ``drain()`` (device-repo specific): apply all buffered mutations /
+deltas to device state in one fused batch.
+"""
+
+from __future__ import annotations
+
+U64_MAX = (1 << 64) - 1
+
+
+class ParseError(Exception):
+    """Command didn't parse; the manager replies with help text."""
+
+
+def need(args: list[bytes], i: int) -> bytes:
+    try:
+        return args[i]
+    except IndexError:
+        raise ParseError() from None
+
+
+def parse_u64(b: bytes) -> int:
+    """Strict unsigned 64-bit parse (Pony String.u64() behavior: digits
+    only, no sign, must fit)."""
+    if not b.isdigit():
+        raise ParseError()
+    v = int(b)
+    if v > U64_MAX:
+        raise ParseError()
+    return v
+
+
+def parse_opt_count(args: list[bytes], i: int) -> int:
+    """Optional count arg: any missing/unparseable value means "all"
+    (the reference's try-usize-else -1 trick, repo_tlog.pony:49-50)."""
+    try:
+        return parse_u64(args[i])
+    except (ParseError, IndexError):
+        return U64_MAX
+
+
+# batch-padding row index: out of range for any real keyspace, so padded
+# scatter updates fall into mode="drop" instead of colliding with row 0
+PAD_ROW = (1 << 31) - 1
+
+
+def bucket(n: int, lo: int = 16) -> int:
+    """Next power of two >= n (>= lo): pads batch dims so the jit cache
+    stays small — every distinct shape is a fresh XLA compile."""
+    b = lo
+    while b < n:
+        b <<= 1
+    return b

@@ -1,0 +1,60 @@
+"""Database: keyspace router over the per-type repos.
+
+Reference analog: database.pony:5-65 — routes cmd[0] to the matching repo
+manager (case sensitive), renders the data-type help for unknown first
+words, fans flush/converge to the repos, and joins shutdown.
+"""
+
+from __future__ import annotations
+
+from .help import DATATYPE_HELP, respond_help
+from .manager import RepoManager
+from .repo_counters import RepoGCOUNT, RepoPNCOUNT
+from .repo_system import RepoSYSTEM
+from .repo_treg import RepoTREG
+from .repo_tlog import RepoTLOG
+from .repo_ujson import RepoUJSON
+
+
+class Database:
+    def __init__(self, identity: int, system_repo: RepoSYSTEM | None = None):
+        self.system = system_repo if system_repo is not None else RepoSYSTEM(identity)
+        self._map: dict[bytes, RepoManager] = {}
+        for repo in (
+            RepoTREG(identity),
+            RepoTLOG(identity),
+            RepoGCOUNT(identity),
+            RepoPNCOUNT(identity),
+            RepoUJSON(identity),
+            self.system,
+        ):
+            self._map[repo.name.encode()] = RepoManager(repo.name, repo, repo.help)
+
+    def manager(self, name: str) -> RepoManager:
+        return self._map[name.encode()]
+
+    def apply(self, resp, cmd: list[bytes]) -> None:
+        mgr = self._map.get(cmd[0]) if cmd else None
+        if mgr is None:
+            respond_help(resp, DATATYPE_HELP)
+            return
+        mgr.apply(resp, cmd)
+
+    def flush_deltas(self, fn) -> None:
+        for mgr in self._map.values():
+            mgr.flush_deltas(fn)
+
+    def converge_deltas(self, deltas) -> None:
+        """deltas: (type-name: str, [(key: bytes, delta), ...])."""
+        name, batch = deltas
+        mgr = self._map.get(name.encode() if isinstance(name, str) else name)
+        if mgr is not None:
+            mgr.converge_deltas(batch)
+
+    def drain_all(self) -> None:
+        for mgr in self._map.values():
+            mgr.repo.drain()
+
+    def clean_shutdown(self) -> None:
+        for mgr in self._map.values():
+            mgr.clean_shutdown()

@@ -1,0 +1,79 @@
+"""Per-type repo manager: dispatch, help-on-failure, proactive flush.
+
+Reference analog: RepoManagerCore (repo_manager.pony:36-108). The actor
+boundary becomes the asyncio event loop (one loop = strict per-node command
+ordering, the same guarantee one Pony actor per type gave within a type);
+what this class keeps is the behavioral contract:
+
+* shutdown flag rejects new commands with the SHUTDOWN error (:49-55),
+* parse failure renders the repo's help text (:62-66),
+* a mutating command triggers a proactive delta flush, throttled to at
+  most once per 500 ms per repo (:68-84),
+* flush_deltas registers the delta sink and drains if non-empty (:86-90),
+* clean_shutdown stops intake and performs a final flush (:95-108).
+"""
+
+from __future__ import annotations
+
+import time
+
+from .base import ParseError
+from .help import respond_help
+
+PROACTIVE_FLUSH_INTERVAL = 0.5  # seconds; repo_manager.pony:80
+
+SHUTDOWN_ERR = "SHUTDOWN (server is shutting down, rejecting all requests)"
+
+
+class RepoManager:
+    def __init__(self, name: str, repo, help_obj, clock=time.monotonic):
+        self.name = name
+        self.repo = repo
+        self.help = help_obj
+        self._clock = clock
+        self._deltas_fn = None
+        self._last_proactive = None
+        self._shutdown = False
+
+    def apply(self, resp, cmd: list[bytes]) -> None:
+        """cmd includes the routing word (cmd[0] == data type name)."""
+        if self._shutdown:
+            resp.err(SHUTDOWN_ERR)
+            return
+        try:
+            changed = self.repo.apply(resp, cmd[1:])
+        except ParseError:
+            respond_help(resp, self.help.render(cmd[1:]))
+            return
+        if changed:
+            self._maybe_proactive_flush()
+
+    def _maybe_proactive_flush(self) -> None:
+        if self._deltas_fn is None:
+            return
+        now = self._clock()
+        if (
+            self._last_proactive is None
+            or now - self._last_proactive >= PROACTIVE_FLUSH_INTERVAL
+        ):
+            self._flush()
+            self._last_proactive = now
+
+    def _flush(self) -> None:
+        # unconditional, like the reference's proactive path (:81)
+        self._deltas_fn((self.name, self.repo.flush_deltas()))
+
+    def flush_deltas(self, fn) -> None:
+        """Heartbeat entry point: registers the sink, drains if non-empty."""
+        self._deltas_fn = fn
+        if self.repo.deltas_size() > 0:
+            self._deltas_fn((self.name, self.repo.flush_deltas()))
+
+    def converge_deltas(self, batch) -> None:
+        for key, delta in batch:
+            self.repo.converge(key, delta)
+
+    def clean_shutdown(self) -> None:
+        self._shutdown = True
+        if self._deltas_fn is not None:
+            self.flush_deltas(self._deltas_fn)

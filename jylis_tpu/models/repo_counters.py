@@ -1,0 +1,236 @@
+"""GCOUNT / PNCOUNT repos: device-resident counter keyspaces.
+
+Reference analog: repo_gcount.pony:11-60 and repo_pncount.pony:12-67, where
+each repo is a Map[key -> counter] and converge is a per-key loop. Here the
+whole keyspace is ONE (keys x replicas) tensor per polarity (ops/gcount,
+ops/pncount), and all mutations — local INCs and incoming anti-entropy
+deltas alike — funnel into a coalesced pending batch that drains as a
+single fused scatter-max + row-sum XLA call. The drain's row sums feed a
+host cache, so GET is a host dict lookup and the device only ever sees
+large batches (the BASELINE.json north-star structure).
+
+Delta wire shape: GCOUNT -> dict {replica_id: u64}; PNCOUNT -> a
+(p_dict, n_dict) pair. Outbound deltas carry only this node's own column
+(absolute values — joinable delta-state), which the host tracks exactly,
+so flushes never need a device read.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import numpy as np
+
+from ..ops import gcount, pncount
+from .base import PAD_ROW, ParseError, bucket, need, parse_u64, U64_MAX
+from .help import RepoHelp
+
+GCOUNT_HELP = RepoHelp("GCOUNT", {"GET": "key", "INC": "key value"})
+PNCOUNT_HELP = RepoHelp(
+    "PNCOUNT", {"GET": "key", "INC": "key value", "DEC": "key value"}
+)
+
+
+@partial(jax.jit, donate_argnums=0)
+def _drain_g(state, ki, deltas):
+    st = gcount.converge_batch(state, ki, deltas)
+    return st, gcount.read(st, ki)
+
+
+@partial(jax.jit, donate_argnums=0)
+def _drain_pn(state, ki, dp, dn):
+    st = pncount.converge_batch(state, ki, dp, dn)
+    return st, pncount.read(st, ki)
+
+
+class _CounterRepo:
+    """Shared machinery; subclasses bind the ops module and command set."""
+
+    def __init__(self, identity: int, key_cap: int = 1024, rep_cap: int = 8):
+        self._identity = identity
+        self._keys: dict[bytes, int] = {}  # key -> row
+        self._rids: dict[int, int] = {}  # replica id -> column
+        self._key_cap = key_cap
+        self._rep_cap = rep_cap
+        self._values: dict[int, int] = {}  # row -> cached serving value
+        self._dirty: set[bytes] = set()  # keys with unflushed deltas
+
+    def _row_for(self, key: bytes) -> int:
+        row = self._keys.get(key)
+        if row is None:
+            row = len(self._keys)
+            self._keys[key] = row
+        return row
+
+    def _col_for(self, rid: int) -> int:
+        col = self._rids.get(rid)
+        if col is None:
+            col = len(self._rids)
+            self._rids[rid] = col
+        return col
+
+    def _grow_to_fit(self) -> None:
+        k = bucket(max(len(self._keys), 1), self._key_cap)
+        r = bucket(max(len(self._rids), 1), self._rep_cap)
+        if k != self._key_cap or r != self._rep_cap:
+            self._key_cap, self._rep_cap = k, r
+            self._state = self._ops.grow(self._state, k, r)
+
+    def deltas_size(self) -> int:
+        return len(self._dirty)
+
+
+class RepoGCOUNT(_CounterRepo):
+    name = "GCOUNT"
+    help = GCOUNT_HELP
+    _ops = gcount
+
+    def __init__(self, identity: int, **kw):
+        super().__init__(identity, **kw)
+        self._state = gcount.init(self._key_cap, self._rep_cap)
+        self._own: dict[bytes, int] = {}  # my column, absolute (u64 wrap)
+        self._pending: dict[int, dict[int, int]] = {}  # row -> col -> max val
+
+    # -- commands (repo_gcount.pony:25-60) ---------------------------------
+
+    def apply(self, resp, args: list[bytes]) -> bool:
+        op = need(args, 0)
+        if op == b"GET":
+            self.drain()
+            row = self._keys.get(need(args, 1))
+            resp.u64(self._values.get(row, 0) if row is not None else 0)
+            return False
+        if op == b"INC":
+            key = need(args, 1)
+            amount = parse_u64(need(args, 2))
+            self._inc(key, amount)
+            resp.ok()
+            return True
+        raise ParseError()
+
+    def _inc(self, key: bytes, amount: int) -> None:
+        new = (self._own.get(key, 0) + amount) & U64_MAX
+        self._own[key] = new
+        col = self._col_for(self._identity)
+        p = self._pending.setdefault(self._row_for(key), {})
+        p[col] = max(p.get(col, 0), new)
+        self._dirty.add(key)
+
+    # -- lattice plumbing ---------------------------------------------------
+
+    def converge(self, key: bytes, delta: dict) -> None:
+        row = self._row_for(key)
+        p = self._pending.setdefault(row, {})
+        for rid, v in delta.items():
+            col = self._col_for(rid)
+            if v > p.get(col, 0):
+                p[col] = v
+
+    def drain(self) -> None:
+        if not self._pending:
+            return
+        self._grow_to_fit()
+        rows = list(self._pending)
+        b = bucket(len(rows))
+        ki = np.full(b, PAD_ROW, np.int32)
+        ki[: len(rows)] = rows
+        deltas = np.zeros((b, self._rep_cap), np.uint64)
+        for i, row in enumerate(rows):
+            for col, v in self._pending[row].items():
+                deltas[i, col] = v
+        self._state, sums = _drain_g(self._state, ki, deltas)
+        sums = np.asarray(sums)
+        for i, row in enumerate(rows):
+            self._values[row] = int(sums[i])
+        self._pending.clear()
+
+    def flush_deltas(self):
+        out = [
+            (k, {self._identity: self._own[k]}) for k in sorted(self._dirty)
+        ]
+        self._dirty.clear()
+        return out
+
+
+class RepoPNCOUNT(_CounterRepo):
+    name = "PNCOUNT"
+    help = PNCOUNT_HELP
+    _ops = pncount
+
+    def __init__(self, identity: int, **kw):
+        super().__init__(identity, **kw)
+        self._state = pncount.init(self._key_cap, self._rep_cap)
+        self._own_p: dict[bytes, int] = {}
+        self._own_n: dict[bytes, int] = {}
+        # row -> (col -> max val), one map per polarity
+        self._pending_p: dict[int, dict[int, int]] = {}
+        self._pending_n: dict[int, dict[int, int]] = {}
+
+    # -- commands (repo_pncount.pony:26-67) --------------------------------
+
+    def apply(self, resp, args: list[bytes]) -> bool:
+        op = need(args, 0)
+        if op == b"GET":
+            self.drain()
+            row = self._keys.get(need(args, 1))
+            resp.i64(self._values.get(row, 0) if row is not None else 0)
+            return False
+        if op in (b"INC", b"DEC"):
+            key = need(args, 1)
+            amount = parse_u64(need(args, 2))
+            own, pend = (
+                (self._own_p, self._pending_p)
+                if op == b"INC"
+                else (self._own_n, self._pending_n)
+            )
+            new = (own.get(key, 0) + amount) & U64_MAX
+            own[key] = new
+            col = self._col_for(self._identity)
+            p = pend.setdefault(self._row_for(key), {})
+            p[col] = max(p.get(col, 0), new)
+            self._dirty.add(key)
+            resp.ok()
+            return True
+        raise ParseError()
+
+    def converge(self, key: bytes, delta: tuple) -> None:
+        dp, dn = delta
+        row = self._row_for(key)
+        for pend, d in ((self._pending_p, dp), (self._pending_n, dn)):
+            p = pend.setdefault(row, {})
+            for rid, v in d.items():
+                col = self._col_for(rid)
+                if v > p.get(col, 0):
+                    p[col] = v
+
+    def drain(self) -> None:
+        if not self._pending_p and not self._pending_n:
+            return
+        self._grow_to_fit()
+        rows = sorted(set(self._pending_p) | set(self._pending_n))
+        b = bucket(len(rows))
+        ki = np.full(b, PAD_ROW, np.int32)
+        ki[: len(rows)] = rows
+        dp = np.zeros((b, self._rep_cap), np.uint64)
+        dn = np.zeros((b, self._rep_cap), np.uint64)
+        for i, row in enumerate(rows):
+            for col, v in self._pending_p.get(row, {}).items():
+                dp[i, col] = v
+            for col, v in self._pending_n.get(row, {}).items():
+                dn[i, col] = v
+        self._state, sums = _drain_pn(self._state, ki, dp, dn)
+        sums = np.asarray(sums)
+        for i, row in enumerate(rows):
+            self._values[row] = int(sums[i])
+        self._pending_p.clear()
+        self._pending_n.clear()
+
+    def flush_deltas(self):
+        out = []
+        for k in sorted(self._dirty):
+            dp = {self._identity: self._own_p[k]} if k in self._own_p else {}
+            dn = {self._identity: self._own_n[k]} if k in self._own_n else {}
+            out.append((k, (dp, dn)))
+        self._dirty.clear()
+        return out

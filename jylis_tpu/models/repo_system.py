@@ -1,0 +1,83 @@
+"""SYSTEM repo: the replicated server log.
+
+Reference analog: repo_system.pony:13-64. One TLog under the pseudo-key
+"_log"; GETLOG [count] reads it; the server itself appends via inslog()
+with wall-clock milliseconds (the only server-minted timestamps in the
+system, repo_system.pony:41-43) and trims via trimlog(). deltas_size() is
+hard-wired to 1, so the system-log delta ships on every heartbeat even when
+empty — a reference quirk we reproduce because peers rely on the periodic
+converge+Pong traffic it generates.
+
+The log is tiny (trimmed to ~200 entries) and host-resident by design; a
+device round-trip per log line would be absurd (SURVEY.md section 2.6).
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..ops import hostref
+from .base import ParseError, need, parse_opt_count
+from .help import LeafHelp
+
+SYSTEM_HELP = LeafHelp(
+    "The following are valid SYSTEM commands:\n  SYSTEM GETLOG [count]"
+)
+
+
+def _now_millis() -> int:
+    return time.time_ns() // 1_000_000
+
+
+class RepoSYSTEM:
+    name = "SYSTEM"
+    help = SYSTEM_HELP
+
+    def __init__(self, identity: int):
+        self._identity = identity
+        self._log = hostref.TLog()
+        self._delta = hostref.TLog()
+
+    def apply(self, resp, args: list[bytes]) -> bool:
+        op = need(args, 0)
+        if op == b"GETLOG":
+            count = parse_opt_count(args, 1)
+            n = min(count, self._log.size())
+            resp.array_start(n)
+            for value, ts in self._log.latest(n):
+                resp.array_start(2)
+                resp.string(value)
+                resp.u64(ts)
+            return False
+        raise ParseError()
+
+    # -- server-internal (repo_system.pony:56-64) --------------------------
+
+    def inslog(self, line: str) -> None:
+        ts = _now_millis()
+        value = line.encode()
+        self._log.insert(value, ts)
+        self._delta.insert(value, ts)
+
+    def trimlog(self, count: int) -> None:
+        self._log.trim(count)
+
+    # -- lattice plumbing ---------------------------------------------------
+
+    def deltas_size(self) -> int:
+        return 1  # quirk: always ship (repo_system.pony:21)
+
+    def flush_deltas(self):
+        out = [(b"_log", (self._delta.latest(), self._delta.cutoff))]
+        self._delta = hostref.TLog()
+        return out
+
+    def converge(self, key: bytes, delta: tuple) -> None:
+        if key != b"_log":
+            return
+        entries, cutoff = delta
+        other = hostref.TLog(entries=list(entries), cutoff=cutoff)
+        self._log.converge(other)
+
+    def drain(self) -> None:
+        pass

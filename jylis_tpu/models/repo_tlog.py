@@ -1,0 +1,251 @@
+"""TLOG repo: device-resident timestamped-log keyspace.
+
+Reference analog: repo_tlog.pony:16-111 (Map[key -> TLog], per-key list
+insertion). Here the keyspace is the padded ops/tlog block; local INS and
+incoming delta logs coalesce host-side per key and drain as one vmap'd
+merge kernel call. TRIM/TRIMAT/CLR are batched device ops whose returned
+(length, cutoff) pairs maintain the host serving cache, so SIZE/CUTOFF are
+host lookups; GET gathers the one requested row and renders with full
+strings (exact documented ordering even on rank-prefix collisions).
+
+Delta wire shape: (entries: list[(value: bytes, ts: u64)], cutoff: u64).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from ..ops import hostref, tlog
+from ..ops.interner import Interner, prefix_rank
+from .base import PAD_ROW, ParseError, bucket, need, parse_opt_count, parse_u64
+from .help import RepoHelp
+
+TLOG_HELP = RepoHelp(
+    "TLOG",
+    {
+        "GET": "key [count]",
+        "INS": "key value timestamp",
+        "SIZE": "key",
+        "CUTOFF": "key",
+        "TRIMAT": "key timestamp",
+        "TRIM": "key count",
+        "CLR": "key",
+    },
+)
+
+
+@jax.jit
+def _drain(state, ki, d_ts, d_rank, d_vid, d_cut):
+    # NOT donated: on overflow the caller retries from the pre-merge state
+    st, ovf = tlog.converge_batch(state, ki, d_ts, d_rank, d_vid, d_cut)
+    return st, ovf, st.length[ki], st.cutoff[ki]
+
+
+@jax.jit
+def _trim(state, ki, counts):
+    st = tlog.trim_batch(state, ki, counts)
+    return st, st.length[ki], st.cutoff[ki]
+
+
+@jax.jit
+def _get_row(state, k):
+    return state.ts[k], state.vid[k]
+
+
+class RepoTLOG:
+    name = "TLOG"
+    help = TLOG_HELP
+
+    def __init__(self, identity: int, key_cap: int = 1024, len_cap: int = 16):
+        # identity unused: log entries carry no replica identity
+        self._keys: dict[bytes, int] = {}
+        self._key_cap = key_cap
+        self._len_cap = len_cap
+        self._state = tlog.init(key_cap, len_cap)
+        self._interner = Interner()
+        self._len_cache: dict[int, int] = {}  # row -> length
+        self._cut_cache: dict[int, int] = {}  # row -> cutoff
+        # row -> (entries [(ts, value)], incoming-delta cutoff)
+        self._pend_entries: dict[int, list[tuple[int, bytes]]] = {}
+        self._pend_cutoff: dict[int, int] = {}
+        self._deltas: dict[bytes, hostref.TLog] = {}
+
+    def _row_for(self, key: bytes) -> int:
+        row = self._keys.get(key)
+        if row is None:
+            row = len(self._keys)
+            self._keys[key] = row
+        return row
+
+    def _delta_for(self, key: bytes) -> hostref.TLog:
+        d = self._deltas.get(key)
+        if d is None:
+            d = self._deltas[key] = hostref.TLog()
+        return d
+
+    # -- commands (repo_tlog.pony:29-111) ----------------------------------
+
+    def apply(self, resp, args: list[bytes]) -> bool:
+        op = need(args, 0)
+        if op == b"GET":
+            self._cmd_get(resp, need(args, 1), parse_opt_count(args, 2))
+            return False
+        if op == b"INS":
+            key = need(args, 1)
+            value = need(args, 2)
+            ts = parse_u64(need(args, 3))
+            row = self._row_for(key)
+            self._pend_entries.setdefault(row, []).append((ts, value))
+            if ts >= self._cut_cache.get(row, 0):
+                self._delta_for(key).insert(value, ts)
+            resp.ok()
+            return True
+        if op == b"SIZE":
+            self.drain()
+            row = self._keys.get(need(args, 1))
+            resp.u64(self._len_cache.get(row, 0) if row is not None else 0)
+            return False
+        if op == b"CUTOFF":
+            self.drain()
+            row = self._keys.get(need(args, 1))
+            resp.u64(self._cut_cache.get(row, 0) if row is not None else 0)
+            return False
+        if op == b"TRIMAT":
+            key = need(args, 1)
+            ts = parse_u64(need(args, 2))
+            self._device_trimat(key, ts)
+            resp.ok()
+            return True
+        if op == b"TRIM":
+            key = need(args, 1)
+            count = parse_u64(need(args, 2))
+            self._device_trim(key, count)
+            resp.ok()
+            return True
+        if op == b"CLR":
+            self._device_trim(need(args, 1), 0)
+            resp.ok()
+            return True
+        raise ParseError()
+
+    def _cmd_get(self, resp, key: bytes, count: int) -> None:
+        self.drain()
+        row = self._keys.get(key)
+        if row is None:
+            resp.array_start(0)
+            return
+        length = self._len_cache.get(row, 0)
+        ts_row, vid_row = _get_row(self._state, row)
+        ts_row = np.asarray(ts_row)
+        vid_row = np.asarray(vid_row)
+        ents = [
+            (int(ts_row[i]), self._interner.lookup(int(vid_row[i])))
+            for i in range(length)
+        ]
+        ents.sort(key=lambda e: (e[0], e[1]), reverse=True)
+        n = min(count, length)
+        resp.array_start(n)
+        for ts, value in ents[:n]:
+            resp.array_start(2)
+            resp.string(value)
+            resp.u64(ts)
+
+    def _device_trimat(self, key: bytes, ts: int) -> None:
+        """TRIMAT == TRIM with a direct cutoff target; implemented by
+        inserting-nothing and raising cutoff via a 1-row converge (cutoffs
+        merge by max, tlog.md:131)."""
+        self.drain()
+        row = self._row_for(key)
+        self._pend_cutoff[row] = max(self._pend_cutoff.get(row, 0), ts)
+        self.drain()
+        self._delta_for(key).raise_cutoff(self._cut_cache.get(row, 0))
+
+    def _device_trim(self, key: bytes, count: int) -> None:
+        self.drain()
+        row = self._row_for(key)
+        kcap = bucket(max(len(self._keys), 1), self._key_cap)
+        if kcap != self._key_cap:  # TRIM on a brand-new key grows the space
+            self._key_cap = kcap
+            self._state = tlog.grow(self._state, kcap, self._len_cap)
+        b = bucket(1)
+        ki = np.full(b, PAD_ROW, np.int32)  # padding drops on scatter
+        counts = np.full(b, 1 << 62, np.int64)
+        ki[0] = row
+        counts[0] = count
+        self._state, lens, cuts = _trim(self._state, ki, counts)
+        self._len_cache[row] = int(np.asarray(lens)[0])
+        self._cut_cache[row] = int(np.asarray(cuts)[0])
+        self._delta_for(key).raise_cutoff(self._cut_cache[row])
+
+    # -- lattice plumbing ---------------------------------------------------
+
+    def converge(self, key: bytes, delta: tuple) -> None:
+        entries, cutoff = delta
+        row = self._row_for(key)
+        if entries:
+            self._pend_entries.setdefault(row, []).extend(
+                (ts, value) for value, ts in entries
+            )
+        if cutoff:
+            self._pend_cutoff[row] = max(self._pend_cutoff.get(row, 0), cutoff)
+
+    def deltas_size(self) -> int:
+        return len(self._deltas)
+
+    def flush_deltas(self):
+        out = [
+            (k, (d.latest(), d.cutoff)) for k, d in sorted(self._deltas.items())
+        ]
+        self._deltas.clear()
+        return out
+
+    def drain(self) -> None:
+        if not self._pend_entries and not self._pend_cutoff:
+            return
+        rows = sorted(set(self._pend_entries) | set(self._pend_cutoff))
+        # capacity: keys, then entry slots (worst case current + pending)
+        kcap = bucket(max(len(self._keys), 1), self._key_cap)
+        need_len = max(
+            self._len_cache.get(r, 0) + len(self._pend_entries.get(r, ()))
+            for r in rows
+        )
+        lcap = bucket(max(need_len, 1), self._len_cap)
+        if kcap != self._key_cap or lcap != self._len_cap:
+            self._key_cap, self._len_cap = kcap, lcap
+            self._state = tlog.grow(self._state, kcap, lcap)
+        while True:
+            b = bucket(len(rows))
+            ld = bucket(
+                max((len(self._pend_entries.get(r, ())) for r in rows), default=1),
+                1,
+            )
+            ki = np.full(b, PAD_ROW, np.int32)
+            d_ts = np.zeros((b, ld), np.uint64)
+            d_rank = np.zeros((b, ld), np.uint64)
+            d_vid = np.full((b, ld), -1, np.int64)
+            d_cut = np.zeros(b, np.uint64)
+            for i, row in enumerate(rows):
+                ki[i] = row
+                for j, (ts, value) in enumerate(self._pend_entries.get(row, ())):
+                    d_ts[i, j] = ts
+                    d_rank[i, j] = prefix_rank(value)
+                    d_vid[i, j] = self._interner.intern(value)
+                d_cut[i] = self._pend_cutoff.get(row, 0)
+            new_state, ovf, lens, cuts = _drain(
+                self._state, ki, d_ts, d_rank, d_vid, d_cut
+            )
+            if bool(np.asarray(ovf)[: len(rows)].any()):
+                # retry from the retained pre-merge state with doubled slots
+                self._len_cap *= 2
+                self._state = tlog.grow(self._state, self._key_cap, self._len_cap)
+                continue
+            self._state = new_state
+            lens = np.asarray(lens)
+            cuts = np.asarray(cuts)
+            for i, row in enumerate(rows):
+                self._len_cache[row] = int(lens[i])
+                self._cut_cache[row] = int(cuts[i])
+            self._pend_entries.clear()
+            self._pend_cutoff.clear()
+            return

@@ -1,0 +1,155 @@
+"""TREG repo: device-resident last-writer-wins register keyspace.
+
+Reference analog: repo_treg.pony:11-68 (Map[key -> TRegString], per-key
+converge loop). Here the keyspace is the ops/treg struct-of-arrays; local
+SETs and incoming deltas coalesce host-side per key (exact LWW compare with
+full strings — the host has them), then drain in one fused
+compare-and-scatter call whose gathered results feed the host serving
+cache. Rank-prefix ties that the device cannot settle (flagged rows) are
+resolved here with full strings and patched with a tiny follow-up scatter.
+
+Delta wire shape: (value: bytes, ts: u64).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import numpy as np
+
+from ..ops import treg
+from ..ops.interner import Interner, prefix_rank
+from .base import PAD_ROW, ParseError, bucket, need, parse_u64
+from .help import RepoHelp
+
+TREG_HELP = RepoHelp("TREG", {"GET": "key", "SET": "key value timestamp"})
+
+
+@partial(jax.jit, donate_argnums=0)
+def _drain(state, ki, ts, rank, vid):
+    st, tie = treg.converge_batch(state, ki, ts, rank, vid)
+    return st, tie, st.ts[ki], st.vid[ki]
+
+
+@partial(jax.jit, donate_argnums=0)
+def _patch_vids(state, ki, vids):
+    return state._replace(vid=state.vid.at[ki].set(vids, mode="drop"))
+
+
+class RepoTREG:
+    name = "TREG"
+    help = TREG_HELP
+
+    def __init__(self, identity: int, key_cap: int = 1024):
+        # identity is ignored: LWW needs no replica identity (repo_treg.pony:15)
+        self._keys: dict[bytes, int] = {}
+        self._key_cap = key_cap
+        self._state = treg.init(key_cap)
+        self._interner = Interner()
+        self._cache: dict[int, tuple[int, int]] = {}  # row -> (ts, vid)
+        self._pending: dict[int, tuple[int, bytes]] = {}  # row -> (ts, value)
+        self._deltas: dict[bytes, tuple[bytes, int]] = {}  # key -> (value, ts)
+
+    def _row_for(self, key: bytes) -> int:
+        row = self._keys.get(key)
+        if row is None:
+            row = len(self._keys)
+            self._keys[key] = row
+        return row
+
+    # -- commands (repo_treg.pony:24-68) -----------------------------------
+
+    def apply(self, resp, args: list[bytes]) -> bool:
+        op = need(args, 0)
+        if op == b"GET":
+            self.drain()
+            row = self._keys.get(need(args, 1))
+            hit = self._cache.get(row) if row is not None else None
+            if hit is None or hit[1] < 0:
+                resp.null()
+            else:
+                ts, vid = hit
+                resp.array_start(2)
+                resp.string(self._interner.lookup(vid))
+                resp.u64(ts)
+            return False
+        if op == b"SET":
+            key = need(args, 1)
+            value = need(args, 2)
+            ts = parse_u64(need(args, 3))
+            self._write(key, value, ts)
+            # local delta coalesces by the same LWW rule (exact, host-side)
+            cur = self._deltas.get(key)
+            if cur is None or (ts, value) > (cur[1], cur[0]):
+                self._deltas[key] = (value, ts)
+            resp.ok()
+            return True
+        raise ParseError()
+
+    def _write(self, key: bytes, value: bytes, ts: int) -> None:
+        row = self._row_for(key)
+        cur = self._pending.get(row)
+        if cur is None or (ts, value) > cur:
+            self._pending[row] = (ts, value)
+
+    def converge(self, key: bytes, delta: tuple) -> None:
+        value, ts = delta
+        self._write(key, value, ts)
+
+    def deltas_size(self) -> int:
+        return len(self._deltas)
+
+    def flush_deltas(self):
+        out = sorted(self._deltas.items())
+        self._deltas.clear()
+        return out
+
+    # -- device drain -------------------------------------------------------
+
+    def drain(self) -> None:
+        if not self._pending:
+            return
+        cap = bucket(max(len(self._keys), 1), self._key_cap)
+        if cap != self._key_cap:
+            self._key_cap = cap
+            self._state = treg.grow(self._state, cap)
+        rows = list(self._pending)
+        b = bucket(len(rows))
+        ki = np.full(b, PAD_ROW, np.int32)
+        d_ts = np.zeros(b, np.uint64)
+        d_rank = np.zeros(b, np.uint64)
+        d_vid = np.full(b, -1, np.int64)
+        values = []
+        for i, row in enumerate(rows):
+            ts, value = self._pending[row]
+            ki[i] = row
+            d_ts[i] = ts
+            d_rank[i] = prefix_rank(value)
+            d_vid[i] = self._interner.intern(value)
+            values.append(value)
+        self._state, tie, out_ts, out_vid = _drain(
+            self._state, ki, d_ts, d_rank, d_vid
+        )
+        tie = np.asarray(tie)
+        out_ts = np.asarray(out_ts)
+        out_vid = np.asarray(out_vid).copy()
+        if tie[: len(rows)].any():
+            # prefix collision: full-string compare decides; patch losers
+            patch_ki, patch_vid = [], []
+            for i in np.nonzero(tie[: len(rows)])[0]:
+                cur_val = self._interner.lookup(int(out_vid[i]))
+                if values[i] > cur_val:
+                    patch_ki.append(rows[i])
+                    patch_vid.append(int(d_vid[i]))
+                    out_vid[i] = d_vid[i]
+            if patch_ki:
+                pb = bucket(len(patch_ki))
+                pk = np.full(pb, PAD_ROW, np.int32)  # padding drops
+                pv = np.full(pb, -1, np.int64)
+                pk[: len(patch_ki)] = patch_ki
+                pv[: len(patch_vid)] = patch_vid
+                self._state = _patch_vids(self._state, pk, pv)
+        for i, row in enumerate(rows):
+            self._cache[row] = (int(out_ts[i]), int(out_vid[i]))
+        self._pending.clear()

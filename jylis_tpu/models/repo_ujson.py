@@ -1,0 +1,129 @@
+"""UJSON repo: host-resident causal-document keyspace.
+
+Reference analog: repo_ujson.pony:14-110. Variadic argument shape: the
+first arg is the database key, the LAST arg is the value/document (for
+SET/INS/RM), and everything between is a path of nested-map keys
+(repo_ujson.pony:45-49). GET/CLR take key + optional path only.
+
+State lives on host (ops/ujson_host.py explains why this lattice is not
+tensorised in round 1); the repo surface, delta flow, and reply shapes are
+identical to the device-backed types.
+
+Delta wire shape: the UJSON object itself (entries + causal context).
+"""
+
+from __future__ import annotations
+
+from ..ops.ujson_host import UJSON
+from .base import ParseError, need
+from .help import RepoHelp
+
+UJSON_HELP = RepoHelp(
+    "UJSON",
+    {
+        "GET": "key [key...]",
+        "SET": "key [key...] ujson",
+        "CLR": "key [key...]",
+        "INS": "key [key...] value",
+        "RM": "key [key...] value",
+    },
+)
+
+
+def _decode_path(parts: list[bytes]) -> tuple[str, ...]:
+    return tuple(p.decode("utf-8", "replace") for p in parts)
+
+
+class RepoUJSON:
+    name = "UJSON"
+    help = UJSON_HELP
+
+    def __init__(self, identity: int):
+        self._identity = identity
+        self._data: dict[bytes, UJSON] = {}
+        self._deltas: dict[bytes, UJSON] = {}
+
+    def _data_for(self, key: bytes) -> UJSON:
+        d = self._data.get(key)
+        if d is None:
+            d = self._data[key] = UJSON()
+        return d
+
+    def _delta_for(self, key: bytes) -> UJSON:
+        d = self._deltas.get(key)
+        if d is None:
+            d = self._deltas[key] = UJSON()
+        return d
+
+    def _path_and_value(self, args: list[bytes]):
+        """key [path...] value — at least key and value (repo_ujson.pony:45-49)."""
+        if len(args) < 3:
+            raise ParseError()
+        return args[1], _decode_path(args[2:-1]), args[-1].decode("utf-8", "replace")
+
+    def apply(self, resp, args: list[bytes]) -> bool:
+        op = need(args, 0)
+        if op == b"GET":
+            key = need(args, 1)
+            path = _decode_path(args[2:])
+            doc = self._data.get(key)
+            resp.string(doc.render(path) if doc is not None else "")
+            return False
+        if op == b"SET":
+            key, path, value = self._path_and_value(args)
+            try:
+                self._data_for(key).set_doc(
+                    self._identity, path, value, self._delta_for(key)
+                )
+            except ValueError:
+                raise ParseError() from None
+            resp.ok()
+            return True
+        if op == b"CLR":
+            key = need(args, 1)
+            path = _decode_path(args[2:])
+            doc = self._data.get(key)
+            if doc is not None:
+                doc.clr(self._identity, path, self._delta_for(key))
+            resp.ok()
+            return True
+        if op == b"INS":
+            key, path, value = self._path_and_value(args)
+            try:
+                self._data_for(key).ins(
+                    self._identity, path, value, self._delta_for(key)
+                )
+            except ValueError:
+                raise ParseError() from None
+            resp.ok()
+            return True
+        if op == b"RM":
+            key, path, value = self._path_and_value(args)
+            doc = self._data.get(key)
+            try:
+                if doc is not None:
+                    doc.rm(self._identity, path, value, self._delta_for(key))
+                else:
+                    # still validates the value like the reference (:107)
+                    from ..ops.ujson_host import parse_value
+
+                    parse_value(value)
+            except ValueError:
+                raise ParseError() from None
+            resp.ok()
+            return True
+        raise ParseError()
+
+    def converge(self, key: bytes, delta: UJSON) -> None:
+        self._data_for(key).converge(delta)
+
+    def deltas_size(self) -> int:
+        return len(self._deltas)
+
+    def flush_deltas(self):
+        out = sorted(self._deltas.items())
+        self._deltas.clear()
+        return out
+
+    def drain(self) -> None:  # host-resident: nothing buffered
+        pass

@@ -26,8 +26,12 @@ class PNCountState(NamedTuple):
 
 
 def init(num_keys: int, num_replicas: int) -> PNCountState:
-    z = jnp.zeros((num_keys, num_replicas), UINT64)
-    return PNCountState(z, z)
+    # two distinct buffers: the drain path donates the state, and XLA
+    # rejects donating one aliased buffer twice
+    return PNCountState(
+        jnp.zeros((num_keys, num_replicas), UINT64),
+        jnp.zeros((num_keys, num_replicas), UINT64),
+    )
 
 
 def join(a: PNCountState, b: PNCountState) -> PNCountState:

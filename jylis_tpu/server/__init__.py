@@ -1,0 +1,7 @@
+"""Client API layer: RESP (Redis protocol) codec and asyncio TCP server.
+
+Reference analog: jylis/server.pony, server_notify.pony + the pony-resp
+dependency (SURVEY.md section 2.4).
+"""
+
+from .resp import Respond, RespParser, RespError  # noqa: F401

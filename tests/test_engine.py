@@ -1,0 +1,244 @@
+"""Engine-slice tests: command surface -> reply bytes, through the Respond
+seam (no sockets), exactly how the reference tests drive Database.apply with
+a fake Respond (test/test_cluster.pony:6-41, SURVEY.md section 4).
+
+Covers every repo's command surface (which the reference's own tests do
+NOT — SURVEY.md section 4 "what is not tested"), the help/error texts, the
+proactive-flush throttle, and two-node delta convergence through
+flush_deltas -> converge_deltas.
+"""
+
+import numpy as np
+import pytest
+
+import jylis_tpu  # noqa: F401
+from jylis_tpu.models import Database
+from jylis_tpu.server.resp import Respond
+
+
+class Out:
+    """Byte-collecting Respond sink (the reference's _ExpectRespond seam)."""
+
+    def __init__(self):
+        self.buf = bytearray()
+
+    def sink(self, data: bytes):
+        self.buf += data
+
+    def take(self) -> bytes:
+        out = bytes(self.buf)
+        self.buf.clear()
+        return out
+
+
+@pytest.fixture()
+def db():
+    return Database(identity=1)
+
+
+def run(db, *words) -> bytes:
+    out = Out()
+    db.apply(Respond(out.sink), [w.encode() if isinstance(w, str) else w for w in words])
+    return out.take()
+
+
+# -- GCOUNT ----------------------------------------------------------------
+
+
+def test_gcount_inc_get(db):
+    assert run(db, "GCOUNT", "GET", "k") == b":0\r\n"
+    assert run(db, "GCOUNT", "INC", "k", "10") == b"+OK\r\n"
+    assert run(db, "GCOUNT", "GET", "k") == b":10\r\n"
+    assert run(db, "GCOUNT", "INC", "k", "15") == b"+OK\r\n"
+    assert run(db, "GCOUNT", "GET", "k") == b":25\r\n"
+
+
+def test_gcount_bad_value_gets_help(db):
+    got = run(db, "GCOUNT", "INC", "k", "abc")
+    assert got.startswith(b"-BADCOMMAND (could not parse command)\n")
+    assert b"GCOUNT INC key value" in got
+
+
+# -- PNCOUNT ---------------------------------------------------------------
+
+
+def test_pncount_inc_dec(db):
+    assert run(db, "PNCOUNT", "GET", "k") == b":0\r\n"
+    run(db, "PNCOUNT", "INC", "k", "10")
+    run(db, "PNCOUNT", "DEC", "k", "15")
+    assert run(db, "PNCOUNT", "GET", "k") == b":-5\r\n"
+
+
+# -- TREG ------------------------------------------------------------------
+
+
+def test_treg_set_get(db):
+    assert run(db, "TREG", "GET", "mykey") == b"$-1\r\n"
+    assert run(db, "TREG", "SET", "mykey", "hello", "10") == b"+OK\r\n"
+    assert run(db, "TREG", "GET", "mykey") == b"*2\r\n$5\r\nhello\r\n:10\r\n"
+    run(db, "TREG", "SET", "mykey", "world", "15")
+    assert run(db, "TREG", "GET", "mykey") == b"*2\r\n$5\r\nworld\r\n:15\r\n"
+    run(db, "TREG", "SET", "mykey", "outdated", "5")
+    assert run(db, "TREG", "GET", "mykey") == b"*2\r\n$5\r\nworld\r\n:15\r\n"
+
+
+# -- TLOG ------------------------------------------------------------------
+
+
+def test_tlog_surface(db):
+    assert run(db, "TLOG", "GET", "chat") == b"*0\r\n"
+    run(db, "TLOG", "INS", "chat", "one", "100")
+    run(db, "TLOG", "INS", "chat", "two", "200")
+    run(db, "TLOG", "INS", "chat", "three", "150")
+    assert run(db, "TLOG", "SIZE", "chat") == b":3\r\n"
+    got = run(db, "TLOG", "GET", "chat")
+    assert got == (
+        b"*3\r\n"
+        b"*2\r\n$3\r\ntwo\r\n:200\r\n"
+        b"*2\r\n$5\r\nthree\r\n:150\r\n"
+        b"*2\r\n$3\r\none\r\n:100\r\n"
+    )
+    assert run(db, "TLOG", "GET", "chat", "1") == b"*1\r\n*2\r\n$3\r\ntwo\r\n:200\r\n"
+    # unparseable count means "all" (reference quirk, repo_tlog.pony:49-50)
+    assert run(db, "TLOG", "GET", "chat", "zzz").startswith(b"*3\r\n")
+    run(db, "TLOG", "TRIM", "chat", "2")
+    assert run(db, "TLOG", "SIZE", "chat") == b":2\r\n"
+    assert run(db, "TLOG", "CUTOFF", "chat") == b":150\r\n"
+    run(db, "TLOG", "TRIMAT", "chat", "200")
+    assert run(db, "TLOG", "SIZE", "chat") == b":1\r\n"
+    run(db, "TLOG", "CLR", "chat")
+    assert run(db, "TLOG", "SIZE", "chat") == b":0\r\n"
+    assert run(db, "TLOG", "CUTOFF", "chat") == b":201\r\n"
+    # re-inserting below cutoff is silently ignored
+    assert run(db, "TLOG", "INS", "chat", "old", "100") == b"+OK\r\n"
+    assert run(db, "TLOG", "SIZE", "chat") == b":0\r\n"
+
+
+# -- UJSON -----------------------------------------------------------------
+
+
+def test_ujson_surface(db):
+    assert run(db, "UJSON", "GET", "u") == b"$0\r\n\r\n"
+    run(db, "UJSON", "SET", "u", '{"a":1,"b":{"c":true}}')
+    assert run(db, "UJSON", "GET", "u", "a") == b"$1\r\n1\r\n"
+    assert run(db, "UJSON", "GET", "u", "b") == b'$10\r\n{"c":true}\r\n'
+    run(db, "UJSON", "INS", "u", "roles", '"admin"')
+    run(db, "UJSON", "INS", "u", "roles", '"user"')
+    assert run(db, "UJSON", "GET", "u", "roles") == b'$16\r\n["admin","user"]\r\n'
+    run(db, "UJSON", "RM", "u", "roles", '"admin"')
+    assert run(db, "UJSON", "GET", "u", "roles") == b'$6\r\n"user"\r\n'
+    run(db, "UJSON", "CLR", "u", "b")
+    assert run(db, "UJSON", "GET", "u", "b") == b"$0\r\n\r\n"
+    # invalid JSON -> help
+    got = run(db, "UJSON", "SET", "u", "{not json")
+    assert got.startswith(b"-BADCOMMAND")
+
+
+# -- SYSTEM ----------------------------------------------------------------
+
+
+def test_system_getlog(db):
+    db.system.inslog("node started")
+    db.system.inslog("something happened")
+    got = run(db, "SYSTEM", "GETLOG")
+    assert got.startswith(b"*2\r\n")
+    assert b"something happened" in got
+    got1 = run(db, "SYSTEM", "GETLOG", "1")
+    assert got1.startswith(b"*1\r\n")
+
+
+# -- routing / help --------------------------------------------------------
+
+
+def test_unknown_type_lists_datatypes(db):
+    got = run(db, "NOPE", "GET", "k")
+    assert got.startswith(b"-BADCOMMAND (could not parse command)\n")
+    for t in (b"TREG", b"TLOG", b"GCOUNT", b"PNCOUNT", b"UJSON", b"SYSTEM"):
+        assert t in got
+
+
+def test_unknown_op_lists_type_ops(db):
+    got = run(db, "TREG", "FROB", "k")
+    assert b"The following are valid operations for this data type:" in got
+    assert b"TREG GET key" in got
+    assert b"TREG SET key value timestamp" in got
+
+
+def test_known_op_bad_args_shows_usage(db):
+    got = run(db, "TREG", "SET", "k")
+    assert b"This operation expects the arguments in the following form:" in got
+    assert b"TREG SET key value timestamp" in got
+
+
+# -- delta flow ------------------------------------------------------------
+
+
+def collect_flush(db):
+    batches = []
+    db.flush_deltas(lambda named: batches.append(named))
+    return batches
+
+
+def test_two_node_convergence_all_types(db):
+    """Node A mutates every type; its flushed deltas converge node B to the
+    same observable state (the reference's TestCluster assertion, minus the
+    wire — that arrives with the cluster layer)."""
+    a = db
+    b = Database(identity=2)
+
+    run(a, "GCOUNT", "INC", "k", "7")
+    run(a, "PNCOUNT", "INC", "k", "10")
+    run(a, "PNCOUNT", "DEC", "k", "4")
+    run(a, "TREG", "SET", "r", "v1", "9")
+    run(a, "TLOG", "INS", "l", "entry", "50")
+    run(a, "UJSON", "SET", "u", '{"x":[1,2]}')
+    a.system.inslog("hello from a")
+
+    for named in collect_flush(a):
+        b.converge_deltas(named)
+
+    assert run(b, "GCOUNT", "GET", "k") == b":7\r\n"
+    assert run(b, "PNCOUNT", "GET", "k") == b":6\r\n"
+    assert run(b, "TREG", "GET", "r") == b"*2\r\n$2\r\nv1\r\n:9\r\n"
+    assert run(b, "TLOG", "GET", "l") == b"*1\r\n*2\r\n$5\r\nentry\r\n:50\r\n"
+    assert run(b, "UJSON", "GET", "u") == b'$11\r\n{"x":[1,2]}\r\n'
+    assert b"hello from a" in run(b, "SYSTEM", "GETLOG")
+
+    # cross-write: both nodes INC, both converge, both read the same total
+    run(b, "GCOUNT", "INC", "k", "3")
+    for named in collect_flush(b):
+        a.converge_deltas(named)
+    assert run(a, "GCOUNT", "GET", "k") == b":10\r\n"
+
+
+def test_proactive_flush_throttle():
+    clock = [100.0]
+    db = Database(identity=1)
+    mgr = db.manager("GCOUNT")
+    mgr._clock = lambda: clock[0]
+    sent = []
+    db.flush_deltas(lambda named: sent.append(named))
+    sent.clear()
+
+    run(db, "GCOUNT", "INC", "k", "1")  # first mutation flushes immediately
+    assert len(sent) == 1
+    run(db, "GCOUNT", "INC", "k", "1")  # throttled
+    assert len(sent) == 1
+    clock[0] += 0.6
+    run(db, "GCOUNT", "INC", "k", "1")  # past the window: flushes again
+    assert len(sent) == 2
+
+
+def test_shutdown_rejects_commands(db):
+    db.clean_shutdown()
+    got = run(db, "GCOUNT", "GET", "k")
+    assert got.startswith(b"-SHUTDOWN")
+
+
+def test_many_keys_growth(db):
+    """Push past the initial key capacity to exercise state growth."""
+    for i in range(100):
+        run(db, "GCOUNT", "INC", "key%d" % i, str(i + 1))
+    assert run(db, "GCOUNT", "GET", "key99") == b":100\r\n"
+    vals = [run(db, "GCOUNT", "GET", "key%d" % i) for i in range(0, 100, 17)]
+    assert vals == [b":%d\r\n" % (i + 1) for i in range(0, 100, 17)]
