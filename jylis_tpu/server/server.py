@@ -1,0 +1,77 @@
+"""RESP TCP server: the client API endpoint.
+
+Reference analog: server.pony + server_listen_notify.pony +
+server_notify.pony — accept clients on config.port (default 6379, same as
+Redis), feed their bytes through the incremental command parser, route
+complete commands into Database.apply, and on protocol errors reply with an
+error and drop the connection (server_notify.pony:19-22).
+
+Concurrency model: the asyncio loop replaces the per-connection Pony
+actors; Database.apply is synchronous, which serialises command application
+exactly like the reference's one-actor-per-type does, while socket IO
+overlaps. Device batches are drained inside apply when a read needs them.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..models.database import Database
+from .resp import Respond, RespError, RespParser
+
+
+class Server:
+    def __init__(self, config, database: Database):
+        self._config = config
+        self._database = database
+        self._log = config.log
+        self._server: asyncio.base_events.Server | None = None
+
+    async def start(self) -> None:
+        try:
+            self._server = await asyncio.start_server(
+                self._handle_client, host=None, port=int(self._config.port)
+            )
+        except OSError as e:
+            self._log.err() and self._log.e(f"server listen failed: {e}")
+            raise
+        self._log.info() and self._log.i("server listen ready")
+
+    @property
+    def port(self) -> int:
+        assert self._server is not None
+        return self._server.sockets[0].getsockname()[1]
+
+    async def _handle_client(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        parser = RespParser()
+        resp = Respond(writer.write)
+        try:
+            while True:
+                data = await reader.read(1 << 16)
+                if not data:
+                    break
+                parser.append(data)
+                try:
+                    for cmd in parser:
+                        self._database.apply(resp, cmd)
+                except RespError as e:
+                    resp.err(str(e))
+                    break
+                await writer.drain()
+        except (ConnectionResetError, BrokenPipeError, asyncio.CancelledError):
+            pass
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+
+    async def dispose(self) -> None:
+        """Stop listening (client connections wind down as they close —
+        the reference has the same posture, server.pony:16-20)."""
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()

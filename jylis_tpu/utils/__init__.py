@@ -1,0 +1,10 @@
+"""Infra/util layer: config/CLI, logging, node addresses, name generation.
+
+Reference analog: L0 (SURVEY.md section 1) — config.pony, log.pony,
+address.pony, name_generator.pony, logo.pony.
+"""
+
+from .address import Address  # noqa: F401
+from .config import Config, config_from_cli  # noqa: F401
+from .log import Log  # noqa: F401
+from .namegen import generate_name  # noqa: F401

@@ -1,0 +1,90 @@
+"""Config and CLI flag parsing.
+
+Reference analog: config.pony:5-97. Same flags and defaults:
+--addr/-a (host:port:name advertised to peers), --port/-p (RESP port),
+--seed-addrs/-s (space-separated), --heartbeat-time/-T (seconds, float),
+--system-log-trim (entries kept in SYSTEM GETLOG), --log-level/-L.
+
+One deliberate divergence: the reference assigns short flag 'T' to BOTH
+heartbeat-time and system-log-trim (config.pony:36,41 — a latent bug noted
+in SURVEY.md section 5.6); here system-log-trim has no short flag.
+"""
+
+from __future__ import annotations
+
+import argparse
+import random
+import sys
+import time
+from dataclasses import dataclass, field
+
+from .address import Address
+from .log import Log
+from .namegen import generate_name
+
+
+@dataclass
+class Config:
+    port: str = "6379"
+    addr: Address = field(default_factory=lambda: Address.from_string("127.0.0.1:9999:"))
+    seed_addrs: list[Address] = field(default_factory=list)
+    heartbeat_time: float = 10.0
+    system_log_trim: int = 200
+    log: Log = field(default_factory=Log.create_none)
+
+    def normalize(self) -> None:
+        if not self.addr.name:
+            rng = random.Random(time.time_ns())
+            self.addr = Address(self.addr.host, self.addr.port, generate_name(rng))
+
+
+def config_from_cli(argv: list[str] | None = None, log_out=None) -> Config:
+    parser = argparse.ArgumentParser(
+        prog="jylis-tpu",
+        description="TPU-native distributed in-memory database for CRDTs",
+    )
+    parser.add_argument(
+        "-a", "--addr", default="127.0.0.1:9999:",
+        help="The host:port:name to be advertised to other clustering nodes.",
+    )
+    parser.add_argument(
+        "-p", "--port", default="6379",
+        help="The port for accepting commands over RESP-protocol connections.",
+    )
+    parser.add_argument(
+        "-s", "--seed-addrs", default="",
+        help="A space-separated list of the host:port:name for other known nodes.",
+    )
+    parser.add_argument(
+        "-T", "--heartbeat-time", type=float, default=10.0,
+        help="The number of seconds between heartbeats in the clustering protocol.",
+    )
+    parser.add_argument(
+        "--system-log-trim", type=int, default=200,
+        help="The number of entries to retain in the distributed `SYSTEM GETLOG`.",
+    )
+    parser.add_argument(
+        "-L", "--log-level", default="info",
+        help="Maximum level of detail for logging (error, warn, info, or debug).",
+    )
+    args = parser.parse_args(argv)
+
+    config = Config()
+    config.port = args.port
+    config.addr = Address.from_string(args.addr)
+    config.seed_addrs = [
+        Address.from_string(s) for s in args.seed_addrs.split(" ") if s
+    ]
+    config.heartbeat_time = args.heartbeat_time
+    config.system_log_trim = args.system_log_trim
+
+    level = {"error": "err", "warn": "warn", "info": "info", "debug": "debug"}.get(
+        args.log_level
+    )
+    if level is None:
+        print(f"Unknown log-level: {args.log_level}")
+        sys.exit(1)
+    config.log = Log(level, log_out)
+
+    config.normalize()
+    return config

@@ -1,0 +1,125 @@
+"""RESP server integration tests: real TCP loopback sockets end to end.
+
+Reference analog: the wire assertions in test/test_cluster.pony:123-128
+(exact reply bytes through a real socket), extended to protocol errors and
+inline commands.
+"""
+
+import asyncio
+
+import pytest
+
+import jylis_tpu  # noqa: F401
+from jylis_tpu.models.database import Database
+from jylis_tpu.server.server import Server
+from jylis_tpu.utils.config import Config
+from jylis_tpu.utils.log import Log
+
+
+def make_server():
+    cfg = Config()
+    cfg.port = "0"  # ephemeral
+    cfg.log = Log.create_none()
+    db = Database(identity=1)
+    return Server(cfg, db), db
+
+
+async def send_recv(port: int, payload: bytes, expect_len: int | None = None) -> bytes:
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(payload)
+    await writer.drain()
+    out = b""
+    try:
+        while True:
+            chunk = await asyncio.wait_for(reader.read(1 << 16), timeout=2.0)
+            if not chunk:
+                break
+            out += chunk
+            if expect_len is None or len(out) >= expect_len:
+                break
+    except asyncio.TimeoutError:
+        pass
+    writer.close()
+    return out
+
+
+def test_resp_array_commands():
+    async def main():
+        server, _ = make_server()
+        await server.start()
+        port = server.port
+        inc = b"*4\r\n$6\r\nGCOUNT\r\n$3\r\nINC\r\n$3\r\nfoo\r\n$1\r\n9\r\n"
+        got = await send_recv(port, inc)
+        assert got == b"+OK\r\n"
+        get = b"*3\r\n$6\r\nGCOUNT\r\n$3\r\nGET\r\n$3\r\nfoo\r\n"
+        got = await send_recv(port, get)
+        assert got == b":9\r\n"  # the reference test's exact pinned bytes
+        await server.dispose()
+
+    asyncio.run(main())
+
+
+def test_inline_commands_and_pipelining():
+    async def main():
+        server, _ = make_server()
+        await server.start()
+        port = server.port
+        # inline (nc-style) + pipelined in one write
+        got = await send_recv(
+            port,
+            b"TREG SET k hello 5\r\nTREG GET k\r\n",
+            expect_len=len(b"+OK\r\n*2\r\n$5\r\nhello\r\n:5\r\n"),
+        )
+        assert got == b"+OK\r\n*2\r\n$5\r\nhello\r\n:5\r\n"
+        await server.dispose()
+
+    asyncio.run(main())
+
+
+def test_protocol_error_drops_connection():
+    async def main():
+        server, _ = make_server()
+        await server.start()
+        port = server.port
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(b"*2\r\n$abc\r\n")  # malformed bulk length
+        await writer.drain()
+        got = await asyncio.wait_for(reader.read(1 << 16), timeout=2.0)
+        assert got.startswith(b"-")
+        eof = await asyncio.wait_for(reader.read(1 << 16), timeout=2.0)
+        assert eof == b""  # server closed the connection
+        writer.close()
+        await server.dispose()
+
+    asyncio.run(main())
+
+
+def test_unknown_command_help_over_wire():
+    async def main():
+        server, _ = make_server()
+        await server.start()
+        got = await send_recv(server.port, b"WHAT\r\n")
+        assert got.startswith(b"-BADCOMMAND")
+        await server.dispose()
+
+    asyncio.run(main())
+
+
+def test_concurrent_clients():
+    async def main():
+        server, _ = make_server()
+        await server.start()
+        port = server.port
+
+        async def client(i):
+            return await send_recv(
+                port, b"*4\r\n$6\r\nGCOUNT\r\n$3\r\nINC\r\n$1\r\nc\r\n$1\r\n1\r\n"
+            )
+
+        results = await asyncio.gather(*[client(i) for i in range(8)])
+        assert all(r == b"+OK\r\n" for r in results)
+        got = await send_recv(port, b"*3\r\n$6\r\nGCOUNT\r\n$3\r\nGET\r\n$1\r\nc\r\n")
+        assert got == b":8\r\n"
+        await server.dispose()
+
+    asyncio.run(main())

@@ -1,0 +1,97 @@
+"""Tests for the infra layer: Address parsing/hashing, name generation,
+config CLI, log dual-sink (reference test analogs: test_address.pony,
+test_name_generator.pony)."""
+
+import random
+
+import pytest
+
+from jylis_tpu.utils.address import Address
+from jylis_tpu.utils.config import config_from_cli
+from jylis_tpu.utils.log import Log
+from jylis_tpu.utils.namegen import generate_name
+
+
+def test_address_roundtrip():
+    a = Address.from_string("127.0.0.1:9999:fancy-name")
+    assert (a.host, a.port, a.name) == ("127.0.0.1", "9999", "fancy-name")
+    assert str(a) == "127.0.0.1:9999:fancy-name"
+
+
+def test_address_degenerate_inputs():
+    # address.pony test pins: "", "::::", partial forms
+    assert Address.from_string("") == Address("", "", "")
+    assert Address.from_string("h") == Address("h", "", "")
+    assert Address.from_string("h:p") == Address("h", "p", "")
+    a = Address.from_string("::::")
+    assert (a.host, a.port, a.name) == ("", "", "::")
+
+
+def test_address_hash64_deterministic_and_distinct():
+    a = Address.from_string("127.0.0.1:9999:x")
+    b = Address.from_string("127.0.0.1:9999:y")
+    assert a.hash64() == Address.from_string("127.0.0.1:9999:x").hash64()
+    assert a.hash64() != b.hash64()
+    assert 0 <= a.hash64() < (1 << 64)
+
+
+def test_namegen_shape_and_determinism():
+    # golden: seeded rng must be stable across runs (determinism pin,
+    # mirroring test_name_generator.pony's seeded expectations)
+    names = [generate_name(random.Random(100 + i)) for i in range(4)]
+    assert names == [generate_name(random.Random(100 + i)) for i in range(4)]
+    for n in names:
+        adj, noun, hex12 = n.split("-")
+        assert len(hex12) == 12
+        assert all(c in "0123456789abcdef" for c in hex12)
+
+
+def test_config_defaults():
+    cfg = config_from_cli([])
+    assert cfg.port == "6379"
+    assert cfg.addr.host == "127.0.0.1"
+    assert cfg.addr.port == "9999"
+    assert cfg.addr.name != ""  # random name filled in
+    assert cfg.heartbeat_time == 10.0
+    assert cfg.system_log_trim == 200
+
+
+def test_config_flags():
+    cfg = config_from_cli(
+        ["-a", "10.0.0.1:7000:n1", "-p", "6380", "-s", "10.0.0.2:7000:n2 10.0.0.3:7000:n3",
+         "-T", "0.5", "--system-log-trim", "50", "-L", "debug"]
+    )
+    assert cfg.addr == Address("10.0.0.1", "7000", "n1")
+    assert cfg.port == "6380"
+    assert [str(s) for s in cfg.seed_addrs] == ["10.0.0.2:7000:n2", "10.0.0.3:7000:n3"]
+    assert cfg.heartbeat_time == 0.5
+    assert cfg.system_log_trim == 50
+    assert cfg.log.debug()
+
+
+def test_config_bad_log_level_exits():
+    with pytest.raises(SystemExit):
+        config_from_cli(["-L", "nope"])
+
+
+def test_log_levels_and_dual_sink():
+    lines = []
+
+    class FakeOut:
+        def write(self, s):
+            lines.append(s)
+
+        def flush(self):
+            pass
+
+    sys_lines = []
+    log = Log("warn", FakeOut())
+    log.set_sys(sys_lines.append)
+    assert not log.info()
+    assert log.warn() and log.w("careful")
+    assert log.err() and log.e("bad")
+    # idiom: level predicate short-circuits the emit call
+    log.info() and log.i("never")
+    text = "".join(lines)
+    assert "(W) careful" in text and "(E) bad" in text and "never" not in text
+    assert sys_lines == ["(W) careful", "(E) bad"]
