@@ -40,6 +40,13 @@ class Server:
     @property
     def port(self) -> int:
         assert self._server is not None
+        # With port 0 each address family gets its own ephemeral port;
+        # report the IPv4 one so loopback clients can reach it.
+        import socket
+
+        for sock in self._server.sockets:
+            if sock.family == socket.AF_INET:
+                return sock.getsockname()[1]
         return self._server.sockets[0].getsockname()[1]
 
     async def _handle_client(
