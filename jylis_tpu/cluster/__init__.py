@@ -1,0 +1,18 @@
+"""Cluster layer: gossip membership + anti-entropy replication (L5).
+
+Reference analog: cluster.pony + heart.pony + msg.pony + framing.pony +
+framed_notify.pony + cluster_notify.pony + _serialise.pony (SURVEY.md §2.5).
+"""
+
+from .cluster import Cluster
+from .heart import Heart
+from .msg import MsgAnnounceAddrs, MsgExchangeAddrs, MsgPong, MsgPushDeltas
+
+__all__ = [
+    "Cluster",
+    "Heart",
+    "MsgPong",
+    "MsgExchangeAddrs",
+    "MsgAnnounceAddrs",
+    "MsgPushDeltas",
+]

@@ -1,0 +1,355 @@
+"""Cluster engine: gossip membership + anti-entropy delta broadcast.
+
+Reference analog: cluster.pony:4-265 — the whole distributed backend:
+
+* **Topology: full mesh.** Every node dials an *active* connection to every
+  other known address (cluster.pony:51-71); inbound connections are
+  *passive*. The cluster listener binds the port from ``--addr``.
+* **Membership = CRDT gossip.** ``_known_addrs`` is a P2Set[Address] seeded
+  with self + ``--seed-addrs`` (cluster.pony:39-40); ``MsgExchangeAddrs``
+  full-syncs on establishment and after any membership change
+  (cluster.pony:154,236-238,244-246); ``MsgAnnounceAddrs`` goes to all
+  actives every 3rd tick (cluster.pony:123-128).
+* **Self-healing names:** any gossiped address with my host:port but a
+  different name is permanently blacklisted via P2Set removal
+  (cluster.pony:215-230).
+* **Failure detection:** per-connection activity tick; conns idle >= 10
+  ticks are closed (cluster.pony:118-121); dropped actives are re-dialed on
+  the next sync (cluster.pony:92-99), dropped passives are forgotten.
+* **Anti-entropy:** every tick ``database.flush_deltas(broadcast_deltas)``;
+  each repo's drained batch is serialised ONCE as ``MsgPushDeltas`` and
+  written to every active connection (cluster.pony:130-131,205-213) —
+  fire-and-forget, no acks, no retransmit; duplicate delivery is harmless
+  (idempotent lattice join). Receivers converge and reply ``MsgPong``
+  (liveness only).
+
+The Pony actor becomes an asyncio component: one read-task per connection,
+all state mutation on the single event loop (the same no-data-races
+guarantee the actor gave).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..ops.p2set import P2Set
+from ..utils.address import Address
+from ..utils.net import ipv4_port
+from . import codec
+from .framing import FrameReader, FramingError, frame
+from .heart import Heart
+from .msg import MsgAnnounceAddrs, MsgExchangeAddrs, MsgPong, MsgPushDeltas
+
+IDLE_TICKS_LIMIT = 10  # cluster.pony:118-121
+ANNOUNCE_EVERY = 3  # cluster.pony:123-128
+
+
+class _Conn:
+    """One cluster TCP connection (either role), with its read task."""
+
+    __slots__ = ("writer", "active_addr", "established", "task")
+
+    def __init__(self, writer, active_addr: Address | None):
+        self.writer = writer
+        self.active_addr = active_addr  # None for passive conns
+        self.established = False
+        self.task: asyncio.Task | None = None
+
+    def send_raw(self, data: bytes) -> bool:
+        # asyncio transports never raise from write(); a dead peer shows up
+        # as a closing transport, so check that to get working
+        # dead-connection detection on the broadcast path
+        if self.writer is None or self.writer.transport.is_closing():
+            return False
+        try:
+            self.writer.write(data)
+            return True
+        except (ConnectionError, RuntimeError):
+            return False
+
+    def close(self) -> None:
+        try:
+            self.writer.close()
+        except (ConnectionError, RuntimeError):
+            pass
+
+
+class Cluster:
+    def __init__(self, config, database):
+        self._config = config
+        self._database = database
+        self._log = config.log
+        self._addr: Address = config.addr
+        self._known_addrs: P2Set = P2Set([self._addr])
+        for seed in config.seed_addrs:
+            self._known_addrs.add(seed)
+        self._actives: dict[Address, _Conn] = {}
+        self._passives: set[_Conn] = set()
+        self._last_activity: dict[_Conn, int] = {}
+        self._tick = 0
+        self._serial = codec.signature()
+        self._server: asyncio.base_events.Server | None = None
+        self._heart = Heart(self, config.heartbeat_time)
+        self._disposed = False
+        # Deltas flushed while ZERO established connections exist would be
+        # pure loss (the reference loses them the same way — a known gap,
+        # SURVEY.md §2.5); holding them until a peer is reachable strictly
+        # reduces loss without changing fire-and-forget semantics. Bounded:
+        # oldest batches drop past the cap.
+        self._held: list[bytes] = []
+        self._held_cap = 1024
+
+    # ---- lifecycle --------------------------------------------------------
+
+    async def start(self) -> None:
+        try:
+            self._server = await asyncio.start_server(
+                self._accept, host=None, port=int(self._addr.port or 0)
+            )
+        except OSError as e:
+            self._log.err() and self._log.e(f"cluster listen failed: {e}")
+            raise
+        self._log.info() and self._log.i("cluster listen ready")
+        self._heart.start()
+        self._heartbeat()  # immediate first tick (cluster.pony:42)
+
+    @property
+    def listen_port(self) -> int:
+        assert self._server is not None
+        return ipv4_port(self._server)
+
+    def dispose(self) -> None:
+        """Stop listener, heart, and all connections (cluster.pony:44-49)."""
+        self._disposed = True
+        self._heart.dispose()
+        if self._server is not None:
+            self._server.close()
+        for conn in list(self._actives.values()) + list(self._passives):
+            self._drop(conn)
+
+    # ---- heartbeat --------------------------------------------------------
+
+    def _heartbeat(self) -> None:
+        if self._disposed:
+            return
+        self._tick += 1
+        self._evict_idle()
+        if self._tick % ANNOUNCE_EVERY == 0:
+            self._broadcast_msg(MsgAnnounceAddrs(self._known_addrs.copy()))
+        self._flush_held()
+        self._database.flush_deltas(self.broadcast_deltas)
+        self._sync_actives()
+
+    def _evict_idle(self) -> None:
+        for conn, last in list(self._last_activity.items()):
+            if self._tick - last >= IDLE_TICKS_LIMIT:
+                self._log.info() and self._log.i("evicting idle connection")
+                self._drop(conn)
+
+    def _sync_actives(self) -> None:
+        """Dial an active connection to every known peer we lack
+        (cluster.pony:51-71); failures retry next tick."""
+        for addr in self._known_addrs:
+            if addr == self._addr or addr in self._actives:
+                continue
+            loop = asyncio.get_event_loop()
+            task = loop.create_task(self._dial(addr))
+            conn = _Conn(writer=None, active_addr=addr)
+            conn.task = task
+            self._actives[addr] = conn
+
+    # ---- active (outbound) connections ------------------------------------
+
+    async def _dial(self, addr: Address) -> None:
+        try:
+            reader, writer = await asyncio.open_connection(addr.host, int(addr.port))
+        except (OSError, ValueError):
+            self._active_missed(addr)
+            return
+        conn = self._actives.get(addr)
+        if conn is None or self._disposed:
+            writer.close()
+            return
+        conn.writer = writer
+        self._mark_activity(conn)  # handshake counts against the idle clock
+        conn.send_raw(frame(self._serial))  # handshake: our schema signature
+        await self._read_loop(conn, reader, active=True)
+
+    def _active_missed(self, addr: Address) -> None:
+        """Connect failure: drop the placeholder; the address stays known and
+        is re-dialed on the next sync (cluster_notify.pony:19-20,
+        cluster.pony:157-161)."""
+        self._actives.pop(addr, None)
+
+    # ---- passive (inbound) connections -------------------------------------
+
+    async def _accept(self, reader, writer) -> None:
+        if self._disposed:
+            writer.close()
+            return
+        conn = _Conn(writer=writer, active_addr=None)
+        self._passives.add(conn)
+        self._mark_activity(conn)  # a never-handshaking conn must still age out
+        await self._read_loop(conn, reader, active=False)
+
+    # ---- shared read loop with handshake -----------------------------------
+
+    async def _read_loop(self, conn: _Conn, reader, active: bool) -> None:
+        frames = FrameReader()
+        try:
+            while True:
+                data = await reader.read(1 << 16)
+                if not data:
+                    break
+                frames.append(data)
+                for body in frames:
+                    if not conn.established:
+                        if body != self._serial:
+                            # wrong schema -> auth failure
+                            self._log.warn() and self._log.w(
+                                "cluster handshake signature mismatch"
+                            )
+                            self._drop(conn)
+                            return
+                        conn.established = True
+                        self._mark_activity(conn)
+                        if active:
+                            # we initiated: announce our membership view
+                            self._send(conn, MsgExchangeAddrs(self._known_addrs.copy()))
+                        else:
+                            # passive side echoes the signature back
+                            conn.send_raw(frame(self._serial))
+                        continue
+                    self._mark_activity(conn)
+                    try:
+                        msg = codec.decode(body)
+                    except codec.CodecError as e:
+                        self._log.err() and self._log.e(f"cluster codec error: {e}")
+                        self._drop(conn)
+                        return
+                    if active:
+                        self._active_msg(conn, msg)
+                    else:
+                        self._passive_msg(conn, msg)
+        except (ConnectionError, asyncio.CancelledError, FramingError):
+            pass
+        finally:
+            self._drop(conn)
+
+    # ---- message handling --------------------------------------------------
+
+    def _active_msg(self, conn: _Conn, msg) -> None:
+        if isinstance(msg, MsgPong):
+            return  # liveness only
+        if isinstance(msg, MsgExchangeAddrs):
+            self._converge_addrs(msg.known_addrs)
+            return
+        self._log.err() and self._log.e(
+            f"unexpected active message: {type(msg).__name__}"
+        )
+        self._drop(conn)
+
+    def _passive_msg(self, conn: _Conn, msg) -> None:
+        if isinstance(msg, MsgPong):
+            return
+        if isinstance(msg, MsgExchangeAddrs):
+            # full sync: converge then reply with our own set
+            self._converge_addrs(msg.known_addrs)
+            self._send(conn, MsgExchangeAddrs(self._known_addrs.copy()))
+            return
+        if isinstance(msg, MsgAnnounceAddrs):
+            self._converge_addrs(msg.known_addrs)
+            self._send(conn, MsgPong())
+            return
+        if isinstance(msg, MsgPushDeltas):
+            self._database.converge_deltas((msg.name, list(msg.batch)))
+            self._send(conn, MsgPong())
+            return
+        self._log.err() and self._log.e(
+            f"unexpected passive message: {type(msg).__name__}"
+        )
+        self._drop(conn)
+
+    def _converge_addrs(self, other: P2Set) -> None:
+        """Membership gossip convergence with stale-name self-healing
+        (cluster.pony:215-239)."""
+        changed = self._known_addrs.converge(other)
+        # any address claiming my host:port under another name is outdated;
+        # P2Set removal blacklists it permanently
+        for a in list(self._known_addrs):
+            if (
+                a.host == self._addr.host
+                and a.port == self._addr.port
+                and a.name != self._addr.name
+            ):
+                self._known_addrs.unset(a)
+                changed = True
+        if changed:
+            # drop actives to now-blacklisted addresses
+            for addr in list(self._actives):
+                if addr not in self._known_addrs:
+                    self._drop(self._actives[addr])
+            self._sync_actives()
+            self._broadcast_msg(MsgExchangeAddrs(self._known_addrs.copy()))
+
+    # ---- sending -----------------------------------------------------------
+
+    def broadcast_deltas(self, deltas) -> None:
+        """The _SendDeltasFn sink (cluster.pony:209-213): serialise the batch
+        once, write to every established active connection."""
+        name, batch = deltas
+        data = frame(codec.encode(MsgPushDeltas(name, tuple(batch))))
+        if not self._send_to_actives(data):
+            # nobody reachable right now (maybe nobody known yet): hold
+            # instead of losing, so a late-joining peer still converges on
+            # pre-join writes up to the cap
+            self._held.append(data)
+            del self._held[: -self._held_cap]
+            return
+        self._flush_held()
+
+    def _send_to_actives(self, data: bytes) -> bool:
+        """Write one pre-framed message to every established active conn;
+        True if it reached at least one."""
+        sent = False
+        for conn in list(self._actives.values()):
+            if conn.established:
+                if conn.send_raw(data):
+                    sent = True
+                else:
+                    self._drop(conn)
+        return sent
+
+    def _flush_held(self) -> None:
+        while self._held:
+            data = self._held[0]
+            if not self._send_to_actives(data):
+                return
+            self._held.pop(0)
+
+    def _broadcast_msg(self, msg) -> None:
+        self._send_to_actives(frame(codec.encode(msg)))
+
+    def _send(self, conn: _Conn, msg) -> None:
+        if not conn.send_raw(frame(codec.encode(msg))):
+            self._drop(conn)
+
+    # ---- connection teardown -----------------------------------------------
+
+    def _mark_activity(self, conn: _Conn) -> None:
+        self._last_activity[conn] = self._tick
+
+    def _drop(self, conn: _Conn) -> None:
+        """Close and untrack a connection. A dropped active's address stays
+        in _known_addrs (unless blacklisting removed it), so _sync_actives
+        re-dials it next tick; passives are simply forgotten."""
+        self._last_activity.pop(conn, None)
+        self._passives.discard(conn)
+        if conn.active_addr is not None:
+            cur = self._actives.get(conn.active_addr)
+            if cur is conn:
+                self._actives.pop(conn.active_addr, None)
+        if conn.task is not None and conn.task is not asyncio.current_task():
+            conn.task.cancel()
+        if conn.writer is not None:
+            conn.close()

@@ -1,0 +1,304 @@
+"""Versioned binary codec for cluster messages + schema signature.
+
+Reference analog: _serialise.pony:3-14. The reference ships whole Pony
+object graphs with the runtime serialiser and guards compatibility with a
+build-identity digest — "both peers must run the same binary". That is
+replaced here by the design SURVEY.md §5.8 calls for: an explicit schema
+with a versioned signature, so any two builds speaking the same *schema*
+interoperate. The handshake (cluster_notify.pony:37-61 analog) exchanges
+``signature()`` as the first frame; a byte mismatch drops the connection.
+
+Encoding: LEB128 varints for all integers, varint-length-prefixed byte
+strings, and a one-byte tag per message / per delta kind. Delta payloads
+are encoded per data type (the wire shapes documented in each repo module):
+
+    TREG           (value: bytes, ts: u64)
+    TLOG / SYSTEM  ([(value: bytes, ts: u64)...], cutoff: u64)
+    GCOUNT         {replica-id: u64}
+    PNCOUNT        ({rid: u64}, {rid: u64})
+    UJSON          dot-store entries + causal context (ops/ujson_host.py)
+
+A native C++ fast path for the same format lives in native/; this module
+is the always-available implementation and its correctness oracle.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from ..ops.p2set import P2Set
+from ..ops.ujson_host import UJSON
+from ..utils.address import Address
+from .msg import Msg, MsgAnnounceAddrs, MsgExchangeAddrs, MsgPong, MsgPushDeltas
+
+SCHEMA_VERSION = 1
+
+# The canonical schema text: any change to the wire format MUST change this
+# string (bump SCHEMA_VERSION), which changes the signature, which makes
+# incompatible peers refuse each other at handshake instead of corrupting.
+_SCHEMA_TEXT = f"""jylis-tpu cluster schema v{SCHEMA_VERSION}
+varint=LEB128 bytes=varint-len-prefixed str=utf8-bytes
+addr=(host:str port:str name:str)
+p2set=(adds:[addr] removes:[addr])
+msg0=Pong
+msg1=ExchangeAddrs(p2set)
+msg2=AnnounceAddrs(p2set)
+msg3=PushDeltas(name:str batch:[(key:bytes delta)])
+delta/TREG=(value:bytes ts:varint)
+delta/TLOG=delta/SYSTEM=(entries:[(value:bytes ts:varint)] cutoff:varint)
+delta/GCOUNT=[(rid:varint v:varint)]
+delta/PNCOUNT=(gcount gcount)
+delta/UJSON=(entries:[(rid seq path:[str] token:str)] vv:[(rid seq)] cloud:[(rid seq)])
+"""
+
+
+def signature() -> bytes:
+    """The handshake digest (the reference's _Serialise.signature analog,
+    _serialise.pony:7) — here a schema identity, not a binary identity."""
+    return hashlib.sha256(_SCHEMA_TEXT.encode()).digest()
+
+
+class CodecError(Exception):
+    pass
+
+
+# ---- primitive writers ----------------------------------------------------
+
+
+def _w_varint(out: bytearray, v: int) -> None:
+    if v < 0:
+        raise CodecError(f"negative varint: {v}")
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _w_bytes(out: bytearray, b: bytes) -> None:
+    _w_varint(out, len(b))
+    out.extend(b)
+
+
+def _w_str(out: bytearray, s: str) -> None:
+    _w_bytes(out, s.encode())
+
+
+# ---- primitive readers ----------------------------------------------------
+
+
+class _Reader:
+    __slots__ = ("buf", "pos")
+
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def varint(self) -> int:
+        shift = 0
+        v = 0
+        while True:
+            if self.pos >= len(self.buf):
+                raise CodecError("truncated varint")
+            b = self.buf[self.pos]
+            self.pos += 1
+            v |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return v
+            shift += 7
+            if shift > 70:
+                raise CodecError("varint too long")
+
+    def bytes_(self) -> bytes:
+        n = self.varint()
+        if self.pos + n > len(self.buf):
+            raise CodecError("truncated bytes")
+        b = self.buf[self.pos : self.pos + n]
+        self.pos += n
+        return b
+
+    def str_(self) -> str:
+        return self.bytes_().decode()
+
+    def done(self) -> bool:
+        return self.pos == len(self.buf)
+
+
+# ---- address / membership set ---------------------------------------------
+
+
+def _w_addr(out: bytearray, a: Address) -> None:
+    _w_str(out, a.host)
+    _w_str(out, a.port)
+    _w_str(out, a.name)
+
+
+def _r_addr(r: _Reader) -> Address:
+    return Address(r.str_(), r.str_(), r.str_())
+
+
+def _w_p2set(out: bytearray, s: P2Set) -> None:
+    for group in (s.adds, s.removes):
+        addrs = sorted(group, key=str)
+        _w_varint(out, len(addrs))
+        for a in addrs:
+            _w_addr(out, a)
+
+
+def _r_p2set(r: _Reader) -> P2Set:
+    s = P2Set()
+    s.adds = {_r_addr(r) for _ in range(r.varint())}
+    s.removes = {_r_addr(r) for _ in range(r.varint())}
+    return s
+
+
+# ---- per-type delta payloads ----------------------------------------------
+
+
+def _w_gcount_dict(out: bytearray, d: dict) -> None:
+    _w_varint(out, len(d))
+    for rid in sorted(d):
+        _w_varint(out, rid)
+        _w_varint(out, d[rid])
+
+
+def _r_gcount_dict(r: _Reader) -> dict:
+    return {r.varint(): r.varint() for _ in range(r.varint())}
+
+
+def _w_tlog(out: bytearray, delta: tuple) -> None:
+    entries, cutoff = delta
+    _w_varint(out, len(entries))
+    for value, ts in entries:
+        _w_bytes(out, value)
+        _w_varint(out, ts)
+    _w_varint(out, cutoff)
+
+
+def _r_tlog(r: _Reader) -> tuple:
+    entries = [(r.bytes_(), r.varint()) for _ in range(r.varint())]
+    return entries, r.varint()
+
+
+def _w_ujson(out: bytearray, u: UJSON) -> None:
+    _w_varint(out, len(u.entries))
+    for (rid, seq) in sorted(u.entries):
+        path, token = u.entries[(rid, seq)]
+        _w_varint(out, rid)
+        _w_varint(out, seq)
+        _w_varint(out, len(path))
+        for part in path:
+            _w_str(out, part)
+        _w_str(out, token)
+    vv = u.ctx.vv
+    _w_varint(out, len(vv))
+    for rid in sorted(vv):
+        _w_varint(out, rid)
+        _w_varint(out, vv[rid])
+    cloud = sorted(u.ctx.cloud)
+    _w_varint(out, len(cloud))
+    for rid, seq in cloud:
+        _w_varint(out, rid)
+        _w_varint(out, seq)
+
+
+def _r_ujson(r: _Reader) -> UJSON:
+    u = UJSON()
+    for _ in range(r.varint()):
+        rid, seq = r.varint(), r.varint()
+        path = tuple(r.str_() for _ in range(r.varint()))
+        u.entries[(rid, seq)] = (path, r.str_())
+    u.ctx.vv = {r.varint(): r.varint() for _ in range(r.varint())}
+    u.ctx.cloud = {(r.varint(), r.varint()) for _ in range(r.varint())}
+    return u
+
+
+def _w_delta(out: bytearray, name: str, delta) -> None:
+    if name == "TREG":
+        value, ts = delta
+        _w_bytes(out, value)
+        _w_varint(out, ts)
+    elif name in ("TLOG", "SYSTEM"):
+        _w_tlog(out, delta)
+    elif name == "GCOUNT":
+        _w_gcount_dict(out, delta)
+    elif name == "PNCOUNT":
+        dp, dn = delta
+        _w_gcount_dict(out, dp)
+        _w_gcount_dict(out, dn)
+    elif name == "UJSON":
+        _w_ujson(out, delta)
+    else:
+        raise CodecError(f"unknown data type: {name}")
+
+
+def _r_delta(r: _Reader, name: str):
+    if name == "TREG":
+        return r.bytes_(), r.varint()
+    if name in ("TLOG", "SYSTEM"):
+        return _r_tlog(r)
+    if name == "GCOUNT":
+        return _r_gcount_dict(r)
+    if name == "PNCOUNT":
+        return _r_gcount_dict(r), _r_gcount_dict(r)
+    if name == "UJSON":
+        return _r_ujson(r)
+    raise CodecError(f"unknown data type: {name}")
+
+
+# ---- messages --------------------------------------------------------------
+
+_TAG_PONG = 0
+_TAG_EXCHANGE = 1
+_TAG_ANNOUNCE = 2
+_TAG_PUSH = 3
+
+
+def encode(msg: Msg) -> bytes:
+    out = bytearray()
+    if isinstance(msg, MsgPong):
+        out.append(_TAG_PONG)
+    elif isinstance(msg, MsgExchangeAddrs):
+        out.append(_TAG_EXCHANGE)
+        _w_p2set(out, msg.known_addrs)
+    elif isinstance(msg, MsgAnnounceAddrs):
+        out.append(_TAG_ANNOUNCE)
+        _w_p2set(out, msg.known_addrs)
+    elif isinstance(msg, MsgPushDeltas):
+        out.append(_TAG_PUSH)
+        _w_str(out, msg.name)
+        _w_varint(out, len(msg.batch))
+        for key, delta in msg.batch:
+            _w_bytes(out, key)
+            _w_delta(out, msg.name, delta)
+    else:
+        raise CodecError(f"cannot encode {type(msg).__name__}")
+    return bytes(out)
+
+
+def decode(body: bytes) -> Msg:
+    r = _Reader(body)
+    if not body:
+        raise CodecError("empty message")
+    tag = body[0]
+    r.pos = 1
+    if tag == _TAG_PONG:
+        msg: Msg = MsgPong()
+    elif tag == _TAG_EXCHANGE:
+        msg = MsgExchangeAddrs(_r_p2set(r))
+    elif tag == _TAG_ANNOUNCE:
+        msg = MsgAnnounceAddrs(_r_p2set(r))
+    elif tag == _TAG_PUSH:
+        name = r.str_()
+        batch = tuple(
+            (r.bytes_(), _r_delta(r, name)) for _ in range(r.varint())
+        )
+        msg = MsgPushDeltas(name, batch)
+    else:
+        raise CodecError(f"unknown message tag: {tag}")
+    if not r.done():
+        raise CodecError("trailing bytes after message")
+    return msg

@@ -1,0 +1,48 @@
+"""Cluster protocol messages.
+
+Reference analog: msg.pony:3-24 — four message kinds cross the cluster
+wire: ``MsgPong`` (liveness ack), ``MsgExchangeAddrs`` (full membership
+sync: carries the sender's whole P2Set, receiver converges and replies in
+kind), ``MsgAnnounceAddrs`` (periodic membership gossip: receiver converges
+and replies Pong), and ``MsgPushDeltas`` (anti-entropy: one data type's
+drained delta batch).
+
+The reference serialises these with the Pony runtime's whole-object-graph
+``Serialise`` (_serialise.pony:3-14); here each message has an explicit
+versioned binary encoding (codec.py) with a schema signature replacing the
+reference's "same binary" handshake digest.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..ops.p2set import P2Set
+from ..utils.address import Address
+
+
+@dataclass(frozen=True)
+class MsgPong:
+    pass
+
+
+@dataclass(frozen=True)
+class MsgExchangeAddrs:
+    known_addrs: P2Set  # P2Set[Address]
+
+
+@dataclass(frozen=True)
+class MsgAnnounceAddrs:
+    known_addrs: P2Set  # P2Set[Address]
+
+
+@dataclass(frozen=True)
+class MsgPushDeltas:
+    """(data-type name, [(key, delta)]) — the _SendDeltasFn payload shape
+    (_send_deltas_fn.pony:1-2)."""
+
+    name: str
+    batch: tuple  # tuple[(key: bytes, delta), ...]
+
+
+Msg = MsgPong | MsgExchangeAddrs | MsgAnnounceAddrs | MsgPushDeltas

@@ -1,0 +1,80 @@
+"""Process entry point and signal-driven clean shutdown.
+
+Reference analog: main.pony:1-15 (wire Config -> System -> Database ->
+Server -> Cluster -> Dispose in that order, print the logo and listen
+addresses) and dispose.pony:3-33 (SIGINT/SIGTERM -> drain deltas to peers
+-> stop server and cluster -> exit). Run as ``python -m jylis_tpu``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import signal
+import sys
+
+from .cluster import Cluster
+from .models import database as database_mod
+from .models.database import Database
+from .server.server import Server
+from .system import System
+from .utils.config import config_from_cli
+from .utils.logo import LOGO
+
+
+class Dispose:
+    """Idempotent clean-shutdown driver (dispose.pony:12-19): first drain
+    every repo's remaining deltas to peers, then stop the listeners."""
+
+    def __init__(self, database: Database, server: Server, cluster: Cluster):
+        self._database = database
+        self._server = server
+        self._cluster = cluster
+        self._disposing = False
+        self.done = asyncio.Event()
+
+    def on_signal(self) -> None:
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, self.dispose)
+
+    def dispose(self) -> None:
+        if self._disposing:
+            return
+        self._disposing = True
+        self._database.clean_shutdown()  # final flush rides broadcast_deltas
+        self._cluster.dispose()
+        asyncio.get_running_loop().create_task(self._finish())
+
+    async def _finish(self) -> None:
+        await self._server.dispose()
+        self.done.set()
+
+
+async def run(argv: list[str] | None = None) -> None:
+    config = config_from_cli(argv)
+    system = System(config)
+    database_mod.warmup()  # compile serving kernels before going live
+    database = Database(identity=config.addr.hash64(), system_repo=system.repo)
+    server = Server(config, database)
+    cluster = Cluster(config, database)
+    await server.start()
+    await cluster.start()
+    dispose = Dispose(database, server, cluster)
+    dispose.on_signal()
+
+    print(LOGO)
+    log = config.log
+    log.info() and log.i(f"cluster address: {config.addr}")
+    log.info() and log.i(f"serving clients on port: {server.port}")
+    await dispose.done.wait()
+
+
+def main(argv: list[str] | None = None) -> None:
+    try:
+        asyncio.run(run(argv))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

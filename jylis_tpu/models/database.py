@@ -58,3 +58,39 @@ class Database:
     def clean_shutdown(self) -> None:
         for mgr in self._map.values():
             mgr.clean_shutdown()
+
+
+class _NullRespond:
+    """Discards replies; lets warmup drive the real command paths."""
+
+    def __getattr__(self, name):
+        return lambda *a, **k: None
+
+
+def warmup() -> None:
+    """Pre-compile every serving-path device kernel at the default bucket
+    shapes by driving a throwaway Database through one command of each
+    kind. Without this, the FIRST client read after a write blocks the
+    event loop for the XLA compile (seconds on a remote TPU) — long enough
+    for peers to hit the 10-tick idle eviction and drop our connections,
+    opening fire-and-forget delta-loss windows. jit caches are per-process,
+    so the throwaway instance warms the real repos' kernels."""
+    db = Database(identity=0)
+    resp = _NullRespond()
+    for line in (
+        b"GCOUNT INC k 1",
+        b"GCOUNT GET k",
+        b"PNCOUNT INC k 1",
+        b"PNCOUNT DEC k 1",
+        b"PNCOUNT GET k",
+        b"TREG SET k v 1",
+        b"TREG GET k",
+        b"TLOG INS k v 2",
+        b"TLOG GET k",
+        b"TLOG SIZE k",
+        b"TLOG TRIM k 1",
+        b"TLOG GET k",
+        b"UJSON SET k a 1",
+        b"UJSON GET k a",
+    ):
+        db.apply(resp, line.split(b" "))

@@ -50,6 +50,17 @@ class P2Set(Generic[T]):
         self.removes |= other.removes
         return (len(self.adds), len(self.removes)) != before
 
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, P2Set)
+            and self.adds == other.adds
+            and self.removes == other.removes
+        )
+
+    # mutable lattice: deliberately unhashable (messages carrying one are
+    # compared by value, never used as dict/set keys)
+    __hash__ = None
+
     def copy(self) -> "P2Set[T]":
         out = P2Set()
         out.adds = set(self.adds)

@@ -17,6 +17,7 @@ from __future__ import annotations
 import asyncio
 
 from ..models.database import Database
+from ..utils.net import ipv4_port
 from .resp import Respond, RespError, RespParser
 
 
@@ -40,14 +41,7 @@ class Server:
     @property
     def port(self) -> int:
         assert self._server is not None
-        # With port 0 each address family gets its own ephemeral port;
-        # report the IPv4 one so loopback clients can reach it.
-        import socket
-
-        for sock in self._server.sockets:
-            if sock.family == socket.AF_INET:
-                return sock.getsockname()[1]
-        return self._server.sockets[0].getsockname()[1]
+        return ipv4_port(self._server)
 
     async def _handle_client(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter

@@ -1,0 +1,15 @@
+"""Small shared networking helpers."""
+
+from __future__ import annotations
+
+import socket
+
+
+def ipv4_port(server) -> int:
+    """The listening port of an asyncio Server, preferring the IPv4 socket:
+    with port 0 each address family gets its OWN ephemeral port, and
+    loopback clients dial 127.0.0.1."""
+    for sock in server.sockets:
+        if sock.family == socket.AF_INET:
+            return sock.getsockname()[1]
+    return server.sockets[0].getsockname()[1]

@@ -1,0 +1,288 @@
+"""In-process multi-node cluster integration tests.
+
+Reference analog: test/test_cluster.pony:67-130 — three complete node
+stacks (System, Database, Server, Cluster) in one process on loopback, with
+the heartbeat dialed down to 50 ms; `bar` and `baz` know only seed `foo`,
+so full-mesh discovery through gossip is itself under test; each node INCs
+the same GCOUNT key with a different amount and the test asserts `foo`
+reads the converged total through the real wire path (codec -> framing ->
+TCP -> converge).
+"""
+
+import asyncio
+
+import pytest
+
+import jylis_tpu  # noqa: F401
+from jylis_tpu.cluster import Cluster
+from jylis_tpu.models.database import Database
+from jylis_tpu.server.server import Server
+from jylis_tpu.system import System
+from jylis_tpu.utils.address import Address
+from jylis_tpu.utils.config import Config
+from jylis_tpu.utils.log import Log
+
+TICK = 0.05  # the reference test's 50 ms heartbeat (test_cluster.pony:70)
+
+
+class Node:
+    """One full node stack on ephemeral loopback ports."""
+
+    def __init__(self, name: str, cluster_port: int, seeds=()):
+        self.config = Config()
+        self.config.port = "0"
+        self.config.addr = Address("127.0.0.1", str(cluster_port), name)
+        self.config.seed_addrs = list(seeds)
+        self.config.heartbeat_time = TICK
+        self.config.log = Log.create_none()
+        self.system = System(self.config)
+        self.database = Database(
+            identity=self.config.addr.hash64(), system_repo=self.system.repo
+        )
+        self.server = Server(self.config, self.database)
+        self.cluster = Cluster(self.config, self.database)
+
+    async def start(self):
+        await self.server.start()
+        await self.cluster.start()
+
+    async def stop(self):
+        self.cluster.dispose()
+        await self.server.dispose()
+
+
+async def resp_call(port: int, payload: bytes) -> bytes:
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(payload)
+    await writer.drain()
+    out = await asyncio.wait_for(reader.read(1 << 16), timeout=2.0)
+    writer.close()
+    return out
+
+
+def grab_ports(n: int) -> list[int]:
+    """Reserve n distinct ephemeral loopback ports (the reference test uses
+    fixed ports 9999/9998/9997; ephemeral keeps parallel CI runs safe)."""
+    import socket
+
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+async def make_three_nodes():
+    """bar and baz are seeded only with foo (test_cluster.pony:94-95)."""
+    p_foo, p_bar, p_baz = grab_ports(3)
+    foo_addr = Address("127.0.0.1", str(p_foo), "foo")
+    foo = Node("foo", p_foo)
+    bar = Node("bar", p_bar, seeds=[foo_addr])
+    baz = Node("baz", p_baz, seeds=[foo_addr])
+    await foo.start()
+    await bar.start()
+    await baz.start()
+    return foo, bar, baz
+
+
+@pytest.fixture()
+def three_nodes():
+    """Builds the cluster inside the test's own loop via a factory."""
+    return make_three_nodes
+
+
+def meshed(*nodes) -> bool:
+    """Full mesh with all active conns through handshake."""
+    return all(
+        len(n.cluster._actives) == len(nodes) - 1
+        and all(c.established for c in n.cluster._actives.values())
+        for n in nodes
+    )
+
+
+async def converge_wait(check, ticks: int = 40):
+    """Poll `check()` for up to `ticks` heartbeats (the reference uses a
+    fixed tick count; we poll to keep the test fast when convergence is
+    quicker)."""
+    for _ in range(ticks):
+        if check():
+            return True
+        await asyncio.sleep(TICK)
+    return check()
+
+
+def test_three_node_gcount_convergence(three_nodes):
+    async def main():
+        foo, bar, baz = await three_nodes()
+        try:
+            assert await converge_wait(lambda: meshed(foo, bar, baz))
+            # INC the same key on each node with a different amount
+            # (test_cluster.pony:122-130: 2 + 3 + 4 -> :9)
+            for node, amount in ((foo, b"2"), (bar, b"3"), (baz, b"4")):
+                got = await resp_call(
+                    node.server.port,
+                    b"*4\r\n$6\r\nGCOUNT\r\n$3\r\nINC\r\n$4\r\ntest\r\n$1\r\n"
+                    + amount
+                    + b"\r\n",
+                )
+                assert got == b"+OK\r\n"
+
+            async def converged():
+                out = await resp_call(
+                    foo.server.port, b"*3\r\n$6\r\nGCOUNT\r\n$3\r\nGET\r\n$4\r\ntest\r\n"
+                )
+                return out
+
+            deadline = asyncio.get_event_loop().time() + 40 * TICK
+            out = b""
+            while asyncio.get_event_loop().time() < deadline:
+                out = await converged()
+                if out == b":9\r\n":
+                    break
+                await asyncio.sleep(TICK)
+            assert out == b":9\r\n"  # the reference test's exact pinned bytes
+        finally:
+            for n in (foo, bar, baz):
+                await n.stop()
+
+    asyncio.run(main())
+
+
+def test_gossip_discovers_full_membership(three_nodes):
+    async def main():
+        foo, bar, baz = await three_nodes()
+        try:
+            # bar and baz never heard of each other directly; gossip via foo
+            # must produce a full mesh (cluster.pony:51-71,215-239)
+            def full_mesh():
+                return all(
+                    len(n.cluster._known_addrs) == 3 for n in (foo, bar, baz)
+                ) and all(
+                    len(n.cluster._actives) == 2 for n in (foo, bar, baz)
+                )
+
+            ok = await converge_wait(full_mesh)
+            assert ok, {
+                n.config.addr.name: (
+                    sorted(str(a) for a in n.cluster._known_addrs),
+                    len(n.cluster._actives),
+                )
+                for n in (foo, bar, baz)
+            }
+        finally:
+            for n in (foo, bar, baz):
+                await n.stop()
+
+    asyncio.run(main())
+
+
+def test_all_types_replicate(three_nodes):
+    """Every data type's deltas ride the anti-entropy path end to end."""
+
+    async def main():
+        foo, bar, baz = await three_nodes()
+        try:
+            # the reference test waits 3 ticks before writing
+            # (test_cluster.pony:122): deltas flushed before any active
+            # connection is established are fire-and-forget gone
+            assert await converge_wait(lambda: meshed(foo, bar, baz))
+            writes = [
+                b"*5\r\n$4\r\nTREG\r\n$3\r\nSET\r\n$1\r\nr\r\n$2\r\nhi\r\n$1\r\n5\r\n",
+                b"*5\r\n$4\r\nTLOG\r\n$3\r\nINS\r\n$1\r\nl\r\n$1\r\nx\r\n$1\r\n3\r\n",
+                b"*4\r\n$7\r\nPNCOUNT\r\n$3\r\nINC\r\n$1\r\np\r\n$1\r\n7\r\n",
+                b"*5\r\n$5\r\nUJSON\r\n$3\r\nSET\r\n$1\r\nu\r\n$1\r\na\r\n$2\r\n42\r\n",
+            ]
+            for w in writes:
+                got = await resp_call(bar.server.port, w)
+                assert got == b"+OK\r\n", (w, got)
+
+            reads = {
+                b"*3\r\n$4\r\nTREG\r\n$3\r\nGET\r\n$1\r\nr\r\n": b"*2\r\n$2\r\nhi\r\n:5\r\n",
+                b"*3\r\n$4\r\nTLOG\r\n$3\r\nGET\r\n$1\r\nl\r\n": b"*1\r\n*2\r\n$1\r\nx\r\n:3\r\n",
+                b"*3\r\n$7\r\nPNCOUNT\r\n$3\r\nGET\r\n$1\r\np\r\n": b":7\r\n",
+                b"*4\r\n$5\r\nUJSON\r\n$3\r\nGET\r\n$1\r\nu\r\n$1\r\na\r\n": b"$2\r\n42\r\n",
+            }
+
+            async def all_seen():
+                for req, want in reads.items():
+                    if await resp_call(baz.server.port, req) != want:
+                        return False
+                return True
+
+            deadline = asyncio.get_event_loop().time() + 60 * TICK
+            ok = False
+            while asyncio.get_event_loop().time() < deadline:
+                if await all_seen():
+                    ok = True
+                    break
+                await asyncio.sleep(TICK)
+            assert ok
+        finally:
+            for n in (foo, bar, baz):
+                await n.stop()
+
+    asyncio.run(main())
+
+
+def test_system_log_replicates(three_nodes):
+    """The SYSTEM log is itself a CRDT: lines logged on one node appear in
+    SYSTEM GETLOG on another (SURVEY.md §2.6)."""
+
+    async def main():
+        foo, bar, baz = await three_nodes()
+        try:
+            assert await converge_wait(lambda: meshed(foo, bar, baz))
+            foo.config.log._level = 1  # enable info on foo only
+            foo.config.log._out = None
+            foo.config.log.i("hello-from-foo")
+
+            async def seen():
+                out = await resp_call(
+                    baz.server.port, b"*2\r\n$6\r\nSYSTEM\r\n$6\r\nGETLOG\r\n"
+                )
+                return b"hello-from-foo" in out
+
+            deadline = asyncio.get_event_loop().time() + 60 * TICK
+            ok = False
+            while asyncio.get_event_loop().time() < deadline:
+                if await seen():
+                    ok = True
+                    break
+                await asyncio.sleep(TICK)
+            assert ok
+        finally:
+            for n in (foo, bar, baz):
+                await n.stop()
+
+    asyncio.run(main())
+
+
+def test_stale_name_blacklisted():
+    """An address gossiped with my host:port but another name is permanently
+    removed (cluster.pony:215-230)."""
+
+    async def main():
+        (port,) = grab_ports(1)
+        foo = Node("foo", port)
+        await foo.start()
+        addr = foo.config.addr
+        try:
+            from jylis_tpu.ops.p2set import P2Set
+
+            stale = Address(addr.host, addr.port, "old-name")
+            incoming = P2Set([stale, addr])
+            foo.cluster._converge_addrs(incoming)
+            assert stale not in foo.cluster._known_addrs
+            assert stale in foo.cluster._known_addrs.removes
+            # and it can never come back
+            again = P2Set([stale])
+            foo.cluster._converge_addrs(again)
+            assert stale not in foo.cluster._known_addrs
+        finally:
+            await foo.stop()
+
+    asyncio.run(main())

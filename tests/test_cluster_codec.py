@@ -1,0 +1,108 @@
+"""Framing + cluster codec unit tests.
+
+Reference analog: test/test_framing.pony:4-21 (header size, roundtrip,
+tampered magic must fail), extended with codec roundtrips for every message
+kind and every data type's delta payload.
+"""
+
+import pytest
+
+from jylis_tpu.cluster import codec, framing
+from jylis_tpu.cluster.msg import (
+    MsgAnnounceAddrs,
+    MsgExchangeAddrs,
+    MsgPong,
+    MsgPushDeltas,
+)
+from jylis_tpu.ops.p2set import P2Set
+from jylis_tpu.ops.ujson_host import UJSON
+from jylis_tpu.utils.address import Address
+
+
+def test_header_roundtrip():
+    h = framing.build_header(12345)
+    assert len(h) == framing.HEADER_SIZE == 9
+    assert framing.parse_header(h) == 12345
+
+
+def test_tampered_magic_fails():
+    h = bytearray(framing.build_header(5))
+    h[0] ^= 0xFF
+    with pytest.raises(framing.FramingError):
+        framing.parse_header(bytes(h))
+
+
+def test_frame_reader_reassembles_split_frames():
+    bodies = [b"alpha", b"", b"x" * 1000]
+    stream = b"".join(framing.frame(b) for b in bodies)
+    reader = framing.FrameReader()
+    got = []
+    # feed one byte at a time: worst-case fragmentation
+    for i in range(len(stream)):
+        reader.append(stream[i : i + 1])
+        got.extend(reader)
+    assert got == bodies
+
+
+def test_frame_reader_rejects_oversize():
+    reader = framing.FrameReader(max_frame=10)
+    reader.append(framing.frame(b"y" * 11))
+    with pytest.raises(framing.FramingError):
+        list(reader)
+
+
+def _roundtrip(msg):
+    out = codec.decode(codec.encode(msg))
+    assert out == msg
+    return out
+
+
+def test_pong_roundtrip():
+    _roundtrip(MsgPong())
+
+
+def test_membership_roundtrip():
+    s = P2Set([Address("127.0.0.1", "9999", "foo"), Address("h", "1", "bar")])
+    s.unset(Address("127.0.0.1", "9999", "stale"))
+    for cls in (MsgExchangeAddrs, MsgAnnounceAddrs):
+        got = _roundtrip(cls(s)).known_addrs
+        assert set(got) == set(s)
+        assert got.removes == s.removes
+
+
+def test_push_deltas_roundtrip_all_types():
+    cases = {
+        "TREG": ((b"k1", (b"hello", 7)), (b"k2", (b"", 0))),
+        "TLOG": ((b"k", ([(b"a", 3), (b"b", 2)], 1)),),
+        "SYSTEM": ((b"_log", ([(b"(I) line", 1234)], 0)),),
+        "GCOUNT": ((b"k", {1: 5, 99: 2**63}),),
+        "PNCOUNT": ((b"k", ({1: 5}, {2: 3})), (b"j", ({}, {}))),
+    }
+    for name, batch in cases.items():
+        _roundtrip(MsgPushDeltas(name, batch))
+
+
+def test_push_deltas_ujson_roundtrip():
+    u = UJSON()
+    u.set_doc(7, ("profile",), '{"name": "alice", "tags": [1, 2]}')
+    u.rm(7, ("profile", "tags"), "1")
+    msg = MsgPushDeltas("UJSON", ((b"doc", u),))
+    got = codec.decode(codec.encode(msg))
+    gu = got.batch[0][1]
+    assert gu.entries == u.entries
+    assert gu.ctx.vv == u.ctx.vv
+    assert gu.ctx.cloud == u.ctx.cloud
+
+
+def test_decode_rejects_garbage():
+    with pytest.raises(codec.CodecError):
+        codec.decode(b"")
+    with pytest.raises(codec.CodecError):
+        codec.decode(b"\xff")
+    with pytest.raises(codec.CodecError):
+        codec.decode(codec.encode(MsgPong()) + b"junk")
+
+
+def test_signature_is_stable_and_schema_bound():
+    assert codec.signature() == codec.signature()
+    assert len(codec.signature()) == 32
