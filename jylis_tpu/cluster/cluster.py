@@ -55,12 +55,18 @@ class _Conn:
         self.established = False
         self.task: asyncio.Task | None = None
 
+    # a peer that keeps ponging but stops reading would otherwise grow the
+    # transport write buffer without bound
+    WRITE_BUFFER_LIMIT = 16 << 20
+
     def send_raw(self, data: bytes) -> bool:
         # asyncio transports never raise from write(); a dead peer shows up
         # as a closing transport, so check that to get working
         # dead-connection detection on the broadcast path
         if self.writer is None or self.writer.transport.is_closing():
             return False
+        if self.writer.transport.get_write_buffer_size() > self.WRITE_BUFFER_LIMIT:
+            return False  # backpressure: treat as dead, caller drops us
         try:
             self.writer.write(data)
             return True
@@ -152,7 +158,7 @@ class Cluster:
         for addr in self._known_addrs:
             if addr == self._addr or addr in self._actives:
                 continue
-            loop = asyncio.get_event_loop()
+            loop = asyncio.get_running_loop()
             task = loop.create_task(self._dial(addr))
             conn = _Conn(writer=None, active_addr=addr)
             conn.task = task
@@ -194,8 +200,12 @@ class Cluster:
 
     # ---- shared read loop with handshake -----------------------------------
 
+    # before the handshake the only legal frame is the 32-byte signature;
+    # a tiny cap stops unauthenticated clients buffering big bodies
+    PRE_HANDSHAKE_MAX_FRAME = 1024
+
     async def _read_loop(self, conn: _Conn, reader, active: bool) -> None:
-        frames = FrameReader()
+        frames = FrameReader(max_frame=self.PRE_HANDSHAKE_MAX_FRAME)
         try:
             while True:
                 data = await reader.read(1 << 16)
@@ -212,6 +222,7 @@ class Cluster:
                             self._drop(conn)
                             return
                         conn.established = True
+                        frames.set_max_frame(1 << 30)  # authenticated peer
                         self._mark_activity(conn)
                         if active:
                             # we initiated: announce our membership view

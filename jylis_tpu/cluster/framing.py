@@ -65,6 +65,10 @@ class FrameReader:
     def append(self, data: bytes) -> None:
         self._buf.extend(data)
 
+    def set_max_frame(self, max_frame: int) -> None:
+        """Raise/lower the frame cap (used to widen after a handshake)."""
+        self._max = max_frame
+
     def __iter__(self):
         return self
 

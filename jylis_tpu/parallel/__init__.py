@@ -1,0 +1,31 @@
+"""Device-mesh parallelism for the CRDT keyspaces.
+
+The scaling design (SURVEY.md §5.8, §7.6): the keyspace tensor is sharded
+over a ``keys`` mesh axis — anti-entropy merge is embarrassingly parallel
+over keys, so convergence needs ZERO collectives once delta batches are
+routed to their shard. The lattice-join *collective* appears when full
+states arrive sharded over a ``rep`` (replica) axis: a join semilattice's
+all-reduce is ``lax.pmax`` over ICI — the CRDT analog of gradient psum.
+
+Inter-node (DCN) communication stays on the host cluster layer (gossip +
+delta push, jylis_tpu/cluster/) — collectives are the wrong tool for
+elastic membership; the mesh handles the dense intra-pod math.
+"""
+
+from .mesh import make_mesh
+from .sharded import (
+    converge_sharded,
+    join_replica_axis,
+    read_all_sharded,
+    route_batch,
+    shard_counts,
+)
+
+__all__ = [
+    "make_mesh",
+    "shard_counts",
+    "route_batch",
+    "converge_sharded",
+    "read_all_sharded",
+    "join_replica_axis",
+]

@@ -1,0 +1,32 @@
+"""Mesh construction helpers.
+
+One place decides how devices become a `jax.sharding.Mesh`, so tests (8
+virtual CPU devices), the driver's dryrun (N virtual devices), and real
+TPU pods all build meshes the same way.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    n_devices: int | None = None, rep: int = 1, axis_names=("rep", "keys")
+) -> Mesh:
+    """A (rep × keys) mesh over the first ``n_devices`` devices.
+
+    ``rep=1`` (the default) gives a pure keys-sharded mesh — the serving
+    layout, where anti-entropy needs no collectives. ``rep>1`` carves a
+    replica fan-in axis for `join_replica_axis` (the pmax join collective).
+    """
+    devs = jax.devices()
+    if n_devices is None:
+        n_devices = len(devs)
+    if n_devices > len(devs):
+        raise ValueError(f"need {n_devices} devices, have {len(devs)}")
+    if n_devices % rep != 0:
+        raise ValueError(f"n_devices {n_devices} not divisible by rep {rep}")
+    grid = np.array(devs[:n_devices]).reshape(rep, n_devices // rep)
+    return Mesh(grid, axis_names)

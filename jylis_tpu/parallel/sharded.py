@@ -1,0 +1,139 @@
+"""Key-sharded counter keyspaces: shard_map merge kernels + join collective.
+
+The north-star path (BASELINE.json): PNCOUNT/GCOUNT anti-entropy over a
+(keys × replicas) uint64 tensor, scaled over a device mesh:
+
+* **State layout:** ``counts[key, replica]`` sharded ``P("keys", None)`` —
+  each device owns a contiguous block of key rows with all replica columns
+  resident, so both the scatter-max join and the row-sum read are LOCAL.
+* **Routing:** the host assigns key rows round-robin-by-block to shards
+  (``row // rows_per_shard``); `route_batch` buckets a delta batch per
+  shard and pads to a common width, producing arrays whose leading axis is
+  sharded over ``keys``. This is the host-side analog of the reference's
+  per-type actor mailbox (repo_manager.pony:92-93) — batching is where the
+  reference's per-key loop became one device launch.
+* **Merge:** inside `shard_map`, each device runs the same scatter-max as
+  the single-chip kernel on its block — ZERO collectives on the serving
+  path; the mesh scales merges/sec linearly with chips.
+* **Join collective:** when full per-replica states arrive sharded over a
+  ``rep`` mesh axis (64 synthetic replicas spread over chips), the lattice
+  join across that axis is ``lax.pmax`` — a max-all-reduce over ICI, the
+  CRDT analog of data-parallel gradient psum (`join_replica_axis`).
+
+All functions are pure and jit/shard_map-composable; dynamic work arrives
+pre-padded (static shapes keep XLA's tiling on the MXU-friendly layouts
+and the jit cache small).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..models.base import PAD_ROW
+
+UINT64 = jnp.uint64
+
+
+def shard_counts(mesh, counts):
+    """Place a (K, R) counts tensor keys-sharded on the mesh. K must divide
+    evenly by the keys axis (pad capacity with zeros — the lattice
+    identity — before calling)."""
+    return jax.device_put(counts, NamedSharding(mesh, P("keys", None)))
+
+
+def route_batch(key_idx, deltas, n_shards: int, rows_per_shard: int):
+    """Host-side shard routing: global (B,) rows + (B, R) deltas become
+    ((n_shards * W,) local rows, (n_shards * W, R) deltas) with the leading
+    axis blockwise-sharded; W is the padded per-shard width. Padded slots
+    carry PAD_ROW, which the scatter drops (mode="drop").
+
+    Duplicate keys inside one batch are fine: max is the combiner.
+    """
+    key_idx = np.asarray(key_idx)
+    deltas = np.asarray(deltas)
+    shard_of = key_idx // rows_per_shard
+    order = np.argsort(shard_of, kind="stable")
+    counts = np.bincount(shard_of, minlength=n_shards)
+    width = max(int(counts.max()) if len(key_idx) else 0, 1)
+    local_rows = np.full((n_shards, width), PAD_ROW, np.int32)
+    local_deltas = np.zeros((n_shards, width, deltas.shape[-1]), deltas.dtype)
+    start = 0
+    for s in range(n_shards):
+        c = int(counts[s])
+        sel = order[start : start + c]
+        local_rows[s, :c] = key_idx[sel] % rows_per_shard
+        local_deltas[s, :c] = deltas[sel]
+        start += c
+    return (
+        local_rows.reshape(n_shards * width),
+        local_deltas.reshape(n_shards * width, deltas.shape[-1]),
+    )
+
+
+def _local_converge(counts_blk, rows_blk, deltas_blk):
+    """Per-shard scatter-max (same kernel as ops/gcount.converge_batch,
+    applied to this device's key block)."""
+    return counts_blk.at[rows_blk].max(deltas_blk, mode="drop")
+
+
+def converge_sharded(mesh, counts, local_rows, local_deltas):
+    """One anti-entropy merge step over the mesh: every device joins its
+    routed slice into its key block. No communication."""
+    fn = jax.jit(
+        jax.shard_map(
+            _local_converge,
+            mesh=mesh,
+            in_specs=(P("keys", None), P("keys"), P("keys", None)),
+            out_specs=P("keys", None),
+        ),
+        donate_argnums=0,
+    )
+    return fn(counts, local_rows, local_deltas)
+
+
+def read_all_sharded(mesh, counts):
+    """Row sums (GCOUNT values) for the whole keyspace; output stays
+    keys-sharded — only materialise on host what you need."""
+    fn = jax.jit(
+        jax.shard_map(
+            lambda blk: jnp.sum(blk, axis=-1, dtype=UINT64),
+            mesh=mesh,
+            in_specs=(P("keys", None),),
+            out_specs=P("keys"),
+        )
+    )
+    return fn(counts)
+
+
+def _local_then_pmax(blk):
+    # reduce the shard's own replica rows first, then all-reduce across the
+    # mesh axis: pmax alone only joins row-for-row across devices
+    local = jnp.max(blk, axis=0, keepdims=True)
+    joined = jax.lax.pmax(local, "rep")
+    return jnp.broadcast_to(joined, blk.shape)
+
+
+@partial(jax.jit, static_argnames=("mesh",))
+def _pmax_join(mesh, counts):
+    return jax.shard_map(
+        _local_then_pmax,
+        mesh=mesh,
+        in_specs=(P("rep", "keys"),),
+        out_specs=P("rep", "keys"),
+    )(counts)
+
+
+def join_replica_axis(mesh, counts_stacked):
+    """Lattice-join full states sharded over the ``rep`` mesh axis.
+
+    counts_stacked: (S, K) or (S, K*R-flattened) sharded P("rep", "keys") —
+    S per-replica full states. The join semilattice's all-reduce is a local
+    max followed by pmax over ICI (the CRDT analog of gradient psum);
+    afterwards every row of every rep-shard holds the converged state.
+    """
+    return _pmax_join(mesh, counts_stacked)

@@ -86,6 +86,7 @@ async def make_three_nodes():
     await foo.start()
     await bar.start()
     await baz.start()
+    assert foo.cluster.listen_port == p_foo  # bound the advertised port
     return foo, bar, baz
 
 
