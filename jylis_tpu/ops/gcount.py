@@ -6,13 +6,15 @@ sum over replicas. Driven by the reference repo at
 jylis/repo_gcount.pony:25-60 (INC adds to this node's entry, GET sums).
 
 TPU-native layout: the whole keyspace for the type is ONE dense tensor
-``counts[key, replica] : uint64`` (replica ids are interned to columns on the
-host). The per-key sequential converge loop of the reference
-(repo_manager.pony:92-93) becomes a single scatter-max over the batch — one
-XLA op regardless of batch size, which is the BASELINE.json north star.
+``counts[key, replica]`` stored as hi/lo u32 planes (ops/planes.py — XLA's
+u64 emulation is 4-25x slower on exactly the scatter/reduce ops this path
+lives on). The per-key sequential converge loop of the reference
+(repo_manager.pony:92-93) becomes a single gather -> joint-max -> scatter
+composite over the batch — one fused XLA launch regardless of batch size,
+which is the BASELINE.json north star.
 
-All functions are pure and jittable; duplicate keys inside one batch are safe
-because max/add are commutative-associative combiners.
+Batches must carry UNIQUE key rows (the serving repos' pending dicts
+guarantee it; `planes.coalesce` is the host helper otherwise).
 """
 
 from __future__ import annotations
@@ -21,35 +23,56 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-UINT64 = jnp.uint64
+from . import planes
+
+U32 = jnp.uint32
+U64 = jnp.uint64
 
 
 class GCountState(NamedTuple):
-    """Dense grow-only counter keyspace: ``counts[key, replica]``."""
+    """Dense grow-only counter keyspace: u64 ``counts[key, replica]`` as
+    two u32 planes."""
 
-    counts: jax.Array  # (K, R) uint64
+    hi: jax.Array  # (K, R) uint32
+    lo: jax.Array  # (K, R) uint32
 
 
 def init(num_keys: int, num_replicas: int) -> GCountState:
-    return GCountState(jnp.zeros((num_keys, num_replicas), UINT64))
+    # distinct buffers: the drain path donates the state, and XLA rejects
+    # donating one aliased buffer twice
+    return GCountState(
+        jnp.zeros((num_keys, num_replicas), U32),
+        jnp.zeros((num_keys, num_replicas), U32),
+    )
+
+
+def from_counts(counts) -> GCountState:
+    """Build from a u64 ndarray (tests / interop)."""
+    hi, lo = planes.split64_np(np.asarray(counts))
+    return GCountState(jnp.asarray(hi), jnp.asarray(lo))
+
+
+def to_counts(state: GCountState) -> np.ndarray:
+    return planes.combine64_np(np.asarray(state.hi), np.asarray(state.lo))
 
 
 def join(a: GCountState, b: GCountState) -> GCountState:
-    """Full-state lattice join: elementwise per-replica max."""
-    return GCountState(jnp.maximum(a.counts, b.counts))
+    """Full-state lattice join: elementwise per-replica u64 max."""
+    return GCountState(*planes.join_max(a.hi, a.lo, b.hi, b.lo))
 
 
 def converge_batch(
-    state: GCountState, key_idx: jax.Array, deltas: jax.Array
+    state: GCountState, key_idx: jax.Array, d_hi: jax.Array, d_lo: jax.Array
 ) -> GCountState:
-    """Join a batch of per-key deltas into the keyspace in one scatter-max.
+    """Join a batch of per-key deltas in one fused composite.
 
-    key_idx: (B,) int32 rows to merge into; deltas: (B, R) uint64 joinable
-    delta states (absolute per-replica values, delta-CRDT style). Out-of-range
-    rows are dropped, matching fire-and-forget delivery (SURVEY.md section 2.5).
+    key_idx: (B,) int32 UNIQUE rows; d_hi/d_lo: (B, R) u32 delta planes
+    (absolute per-replica values, delta-CRDT style). Out-of-range rows are
+    dropped, matching fire-and-forget delivery (SURVEY.md section 2.5).
     """
-    return GCountState(state.counts.at[key_idx].max(deltas, mode="drop"))
+    return GCountState(*planes.scatter_join(state.hi, state.lo, key_idx, d_hi, d_lo))
 
 
 def increment(
@@ -58,24 +81,35 @@ def increment(
     replica_idx: jax.Array,
     amount: jax.Array,
 ) -> GCountState:
-    """Local INC: add amounts at (key, replica) coordinates (u64 wraparound,
-    same overflow posture as the reference's Pony u64)."""
-    return GCountState(state.counts.at[key_idx, replica_idx].add(amount, mode="drop"))
+    """Local INC at UNIQUE (key, replica) coordinates: carry-propagating
+    u64 add with wraparound (the reference's Pony u64 overflow posture).
+    amount: (B,) uint64 (small host batches — split on device is cheap)."""
+    a_hi = (amount >> jnp.uint64(32)).astype(U32)
+    a_lo = amount.astype(U32)
+    cur_hi = state.hi[key_idx, replica_idx]
+    cur_lo = state.lo[key_idx, replica_idx]
+    new_hi, new_lo = planes.add_carry(cur_hi, cur_lo, a_hi, a_lo)
+    return GCountState(
+        state.hi.at[key_idx, replica_idx].set(new_hi, mode="drop", unique_indices=True),
+        state.lo.at[key_idx, replica_idx].set(new_lo, mode="drop", unique_indices=True),
+    )
 
 
 def read(state: GCountState, key_idx: jax.Array) -> jax.Array:
-    """GET for a batch of keys: row sums, uint64."""
-    return jnp.sum(state.counts[key_idx], axis=-1, dtype=UINT64)
+    """GET for a batch of keys: row sums, u64 with wraparound."""
+    return planes.rowsum64(state.hi[key_idx], state.lo[key_idx])
 
 
 def read_all(state: GCountState) -> jax.Array:
-    return jnp.sum(state.counts, axis=-1, dtype=UINT64)
+    return planes.rowsum64(state.hi, state.lo)
 
 
 def grow(state: GCountState, num_keys: int, num_replicas: int) -> GCountState:
     """Host-side capacity growth (zeros are the lattice identity)."""
-    k, r = state.counts.shape
+    k, r = state.hi.shape
     if num_keys == k and num_replicas == r:
         return state
-    out = jnp.zeros((num_keys, num_replicas), UINT64)
-    return GCountState(out.at[:k, :r].set(state.counts))
+    z = jnp.zeros((num_keys, num_replicas), U32)
+    return GCountState(
+        z.at[:k, :r].set(state.hi), z.at[:k, :r].set(state.lo)
+    )

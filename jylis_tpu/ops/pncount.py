@@ -5,9 +5,11 @@ maps, P and N, converged independently by per-replica max; the value is
 sum(P) - sum(N) as a signed 64-bit integer. Reference repo:
 jylis/repo_pncount.pony:26-67 (INC grows P, DEC grows N, GET nets them).
 
-Layout mirrors gcount: two (K, R) uint64 tensors; batched converge is two
-scatter-max ops. This type is the north-star benchmark target
-(BASELINE.json: 1M-key, 64-replica anti-entropy).
+Layout mirrors gcount: each polarity is a (K, R) u64 tensor stored as
+hi/lo u32 planes (ops/planes.py); batched converge is two gather->joint
+max->scatter composites. This type is the north-star benchmark target
+(BASELINE.json: 1M-key, 64-replica anti-entropy). Batches must carry
+UNIQUE key rows (serving repos guarantee it via their pending dicts).
 """
 
 from __future__ import annotations
@@ -16,55 +18,84 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-UINT64 = jnp.uint64
+from . import planes
+
+U32 = jnp.uint32
+U64 = jnp.uint64
+I64 = jnp.int64
 
 
 class PNCountState(NamedTuple):
-    p: jax.Array  # (K, R) uint64 — increments per replica
-    n: jax.Array  # (K, R) uint64 — decrements per replica
+    p_hi: jax.Array  # (K, R) uint32
+    p_lo: jax.Array
+    n_hi: jax.Array
+    n_lo: jax.Array
 
 
 def init(num_keys: int, num_replicas: int) -> PNCountState:
-    # two distinct buffers: the drain path donates the state, and XLA
-    # rejects donating one aliased buffer twice
+    # distinct buffers: the drain path donates the state, and XLA rejects
+    # donating one aliased buffer twice
     return PNCountState(
-        jnp.zeros((num_keys, num_replicas), UINT64),
-        jnp.zeros((num_keys, num_replicas), UINT64),
+        *(jnp.zeros((num_keys, num_replicas), U32) for _ in range(4))
+    )
+
+
+def from_counts(p, n) -> PNCountState:
+    p_hi, p_lo = planes.split64_np(np.asarray(p))
+    n_hi, n_lo = planes.split64_np(np.asarray(n))
+    return PNCountState(
+        jnp.asarray(p_hi), jnp.asarray(p_lo), jnp.asarray(n_hi), jnp.asarray(n_lo)
     )
 
 
 def join(a: PNCountState, b: PNCountState) -> PNCountState:
-    return PNCountState(jnp.maximum(a.p, b.p), jnp.maximum(a.n, b.n))
+    p = planes.join_max(a.p_hi, a.p_lo, b.p_hi, b.p_lo)
+    n = planes.join_max(a.n_hi, a.n_lo, b.n_hi, b.n_lo)
+    return PNCountState(p[0], p[1], n[0], n[1])
 
 
 def converge_batch(
     state: PNCountState,
     key_idx: jax.Array,
-    delta_p: jax.Array,
-    delta_n: jax.Array,
+    dp_hi: jax.Array,
+    dp_lo: jax.Array,
+    dn_hi: jax.Array,
+    dn_lo: jax.Array,
 ) -> PNCountState:
-    """Join a delta batch: (B,) key rows, (B, R) joinable P and N deltas."""
-    return PNCountState(
-        state.p.at[key_idx].max(delta_p, mode="drop"),
-        state.n.at[key_idx].max(delta_n, mode="drop"),
+    """Join a delta batch at UNIQUE (B,) key rows; (B, R) u32 planes per
+    polarity."""
+    p = planes.scatter_join(state.p_hi, state.p_lo, key_idx, dp_hi, dp_lo)
+    n = planes.scatter_join(state.n_hi, state.n_lo, key_idx, dn_hi, dn_lo)
+    return PNCountState(p[0], p[1], n[0], n[1])
+
+
+def _bump(hi, lo, key_idx, replica_idx, amount):
+    a_hi = (amount >> jnp.uint64(32)).astype(U32)
+    a_lo = amount.astype(U32)
+    new_hi, new_lo = planes.add_carry(
+        hi[key_idx, replica_idx], lo[key_idx, replica_idx], a_hi, a_lo
+    )
+    return (
+        hi.at[key_idx, replica_idx].set(new_hi, mode="drop", unique_indices=True),
+        lo.at[key_idx, replica_idx].set(new_lo, mode="drop", unique_indices=True),
     )
 
 
 def increment(
     state: PNCountState, key_idx: jax.Array, replica_idx: jax.Array, amount: jax.Array
 ) -> PNCountState:
-    return PNCountState(
-        state.p.at[key_idx, replica_idx].add(amount, mode="drop"), state.n
-    )
+    """INC at UNIQUE (key, replica) coordinates; amount (B,) uint64."""
+    p_hi, p_lo = _bump(state.p_hi, state.p_lo, key_idx, replica_idx, amount)
+    return PNCountState(p_hi, p_lo, state.n_hi, state.n_lo)
 
 
 def decrement(
     state: PNCountState, key_idx: jax.Array, replica_idx: jax.Array, amount: jax.Array
 ) -> PNCountState:
-    return PNCountState(
-        state.p, state.n.at[key_idx, replica_idx].add(amount, mode="drop")
-    )
+    n_hi, n_lo = _bump(state.n_hi, state.n_lo, key_idx, replica_idx, amount)
+    return PNCountState(state.p_hi, state.p_lo, n_hi, n_lo)
 
 
 def read(state: PNCountState, key_idx: jax.Array) -> jax.Array:
@@ -74,20 +105,25 @@ def read(state: PNCountState, key_idx: jax.Array) -> jax.Array:
     reference's Pony (p_sum - n_sum).i64() modular behavior
     (repo_pncount.pony:55-57).
     """
-    p = jnp.sum(state.p[key_idx], axis=-1, dtype=UINT64)
-    n = jnp.sum(state.n[key_idx], axis=-1, dtype=UINT64)
-    return jax.lax.bitcast_convert_type(p - n, jnp.int64)
+    p = planes.rowsum64(state.p_hi[key_idx], state.p_lo[key_idx])
+    n = planes.rowsum64(state.n_hi[key_idx], state.n_lo[key_idx])
+    return jax.lax.bitcast_convert_type(p - n, I64)
 
 
 def read_all(state: PNCountState) -> jax.Array:
-    p = jnp.sum(state.p, axis=-1, dtype=UINT64)
-    n = jnp.sum(state.n, axis=-1, dtype=UINT64)
-    return jax.lax.bitcast_convert_type(p - n, jnp.int64)
+    p = planes.rowsum64(state.p_hi, state.p_lo)
+    n = planes.rowsum64(state.n_hi, state.n_lo)
+    return jax.lax.bitcast_convert_type(p - n, I64)
 
 
 def grow(state: PNCountState, num_keys: int, num_replicas: int) -> PNCountState:
-    k, r = state.p.shape
+    k, r = state.p_hi.shape
     if num_keys == k and num_replicas == r:
         return state
-    z = jnp.zeros((num_keys, num_replicas), UINT64)
-    return PNCountState(z.at[:k, :r].set(state.p), z.at[:k, :r].set(state.n))
+    z = jnp.zeros((num_keys, num_replicas), U32)
+    return PNCountState(
+        z.at[:k, :r].set(state.p_hi),
+        z.at[:k, :r].set(state.p_lo),
+        z.at[:k, :r].set(state.n_hi),
+        z.at[:k, :r].set(state.n_lo),
+    )

@@ -18,12 +18,12 @@ from .sharded import (
     join_replica_axis,
     read_all_sharded,
     route_batch,
-    shard_counts,
+    shard_plane,
 )
 
 __all__ = [
     "make_mesh",
-    "shard_counts",
+    "shard_plane",
     "route_batch",
     "converge_sharded",
     "read_all_sharded",

@@ -1,28 +1,33 @@
 """Key-sharded counter keyspaces: shard_map merge kernels + join collective.
 
 The north-star path (BASELINE.json): PNCOUNT/GCOUNT anti-entropy over a
-(keys × replicas) uint64 tensor, scaled over a device mesh:
+(keys × replicas) u64 tensor — stored as hi/lo u32 planes (ops/planes.py;
+XLA's u64 emulation is 4-25x slower on scatters/reduces) — scaled over a
+device mesh:
 
-* **State layout:** ``counts[key, replica]`` sharded ``P("keys", None)`` —
-  each device owns a contiguous block of key rows with all replica columns
-  resident, so both the scatter-max join and the row-sum read are LOCAL.
-* **Routing:** the host assigns key rows round-robin-by-block to shards
-  (``row // rows_per_shard``); `route_batch` buckets a delta batch per
-  shard and pads to a common width, producing arrays whose leading axis is
+* **State layout:** each plane sharded ``P("keys", None)`` — a device owns
+  a contiguous block of key rows with all replica columns resident, so
+  both the join composite and the row-sum read are LOCAL.
+* **Routing:** the host assigns key rows blockwise to shards
+  (``row // rows_per_shard``); `route_batch` coalesces duplicate keys
+  (max-combine — the join composite needs unique rows), buckets per shard,
+  and pads to a common width, producing arrays whose leading axis is
   sharded over ``keys``. This is the host-side analog of the reference's
   per-type actor mailbox (repo_manager.pony:92-93) — batching is where the
   reference's per-key loop became one device launch.
-* **Merge:** inside `shard_map`, each device runs the same scatter-max as
-  the single-chip kernel on its block — ZERO collectives on the serving
-  path; the mesh scales merges/sec linearly with chips.
+* **Merge:** inside `shard_map`, each device runs the same gather ->
+  joint-max -> scatter-set composite as the single-chip kernel on its
+  block — ZERO collectives on the serving path; the mesh scales
+  merges/sec linearly with chips.
 * **Join collective:** when full per-replica states arrive sharded over a
-  ``rep`` mesh axis (64 synthetic replicas spread over chips), the lattice
-  join across that axis is ``lax.pmax`` — a max-all-reduce over ICI, the
-  CRDT analog of data-parallel gradient psum (`join_replica_axis`).
+  ``rep`` mesh axis (synthetic replicas spread over chips), the lattice
+  join across that axis is a local fold + a two-phase u32 pmax (hi plane
+  first, then the lo plane masked to hi-winners) — a max-all-reduce over
+  ICI, the CRDT analog of data-parallel gradient psum.
 
 All functions are pure and jit/shard_map-composable; dynamic work arrives
-pre-padded (static shapes keep XLA's tiling on the MXU-friendly layouts
-and the jit cache small).
+pre-padded (static shapes keep XLA's tiling friendly and the jit cache
+small).
 """
 
 from __future__ import annotations
@@ -35,33 +40,36 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..models.base import PAD_ROW
+from ..ops import planes
 
-UINT64 = jnp.uint64
+U32 = jnp.uint32
 
 
-def shard_counts(mesh, counts):
-    """Place a (K, R) counts tensor keys-sharded on the mesh. K must divide
+def shard_plane(mesh, arr):
+    """Place one (K, ...) plane keys-sharded on the mesh. K must divide
     evenly by the keys axis (pad capacity with zeros — the lattice
     identity — before calling)."""
-    return jax.device_put(counts, NamedSharding(mesh, P("keys", None)))
+    return jax.device_put(arr, NamedSharding(mesh, P("keys", None)))
 
 
 def route_batch(key_idx, deltas, n_shards: int, rows_per_shard: int):
-    """Host-side shard routing: global (B,) rows + (B, R) deltas become
-    ((n_shards * W,) local rows, (n_shards * W, R) deltas) with the leading
-    axis blockwise-sharded; W is the padded per-shard width. Padded slots
-    carry PAD_ROW, which the scatter drops (mode="drop").
-
-    Duplicate keys inside one batch are fine: max is the combiner.
+    """Host-side shard routing: global (B,) rows + (B, R) u64 deltas become
+    ((n_shards * W,) local rows, hi/lo (n_shards * W, R) u32 planes) with
+    the leading axis blockwise-sharded; W is the padded per-shard width.
+    Duplicate keys are max-combined here (the device composite requires
+    unique rows); padded slots carry PAD_ROW, which the scatter drops.
     """
-    key_idx = np.asarray(key_idx)
-    deltas = np.asarray(deltas)
+    key_idx, deltas = planes.coalesce(key_idx, deltas)
     shard_of = key_idx // rows_per_shard
     order = np.argsort(shard_of, kind="stable")
     counts = np.bincount(shard_of, minlength=n_shards)
     width = max(int(counts.max()) if len(key_idx) else 0, 1)
-    local_rows = np.full((n_shards, width), PAD_ROW, np.int32)
-    local_deltas = np.zeros((n_shards, width, deltas.shape[-1]), deltas.dtype)
+    # distinct out-of-range pads per shard: each device's scatter keeps an
+    # honestly-unique index vector (see models/base.pad_rows)
+    local_rows = np.broadcast_to(
+        (PAD_ROW - np.arange(width, dtype=np.int32)), (n_shards, width)
+    ).copy()
+    local_deltas = np.zeros((n_shards, width, deltas.shape[-1]), np.uint64)
     start = 0
     for s in range(n_shards):
         c = int(counts[s])
@@ -69,71 +77,102 @@ def route_batch(key_idx, deltas, n_shards: int, rows_per_shard: int):
         local_rows[s, :c] = key_idx[sel] % rows_per_shard
         local_deltas[s, :c] = deltas[sel]
         start += c
-    return (
-        local_rows.reshape(n_shards * width),
-        local_deltas.reshape(n_shards * width, deltas.shape[-1]),
+    d_hi, d_lo = planes.split64_np(
+        local_deltas.reshape(n_shards * width, deltas.shape[-1])
     )
+    return local_rows.reshape(n_shards * width), d_hi, d_lo
 
 
-def _local_converge(counts_blk, rows_blk, deltas_blk):
-    """Per-shard scatter-max (same kernel as ops/gcount.converge_batch,
+def _local_converge(hi_blk, lo_blk, rows_blk, dhi_blk, dlo_blk):
+    """Per-shard join composite (same kernel as ops/gcount.converge_batch,
     applied to this device's key block)."""
-    return counts_blk.at[rows_blk].max(deltas_blk, mode="drop")
+    return planes.scatter_join(hi_blk, lo_blk, rows_blk, dhi_blk, dlo_blk)
 
 
-def converge_sharded(mesh, counts, local_rows, local_deltas):
+# jit hoisted to module level with the mesh static: rebuilding the
+# jit(shard_map) wrapper per call would retrace and recompile every merge
+@partial(jax.jit, static_argnames=("mesh",), donate_argnums=(1, 2))
+def _converge_sharded(mesh, hi, lo, local_rows, d_hi, d_lo):
+    return jax.shard_map(
+        _local_converge,
+        mesh=mesh,
+        in_specs=(
+            P("keys", None),
+            P("keys", None),
+            P("keys"),
+            P("keys", None),
+            P("keys", None),
+        ),
+        out_specs=(P("keys", None), P("keys", None)),
+    )(hi, lo, local_rows, d_hi, d_lo)
+
+
+def converge_sharded(mesh, hi, lo, local_rows, d_hi, d_lo):
     """One anti-entropy merge step over the mesh: every device joins its
     routed slice into its key block. No communication."""
-    fn = jax.jit(
-        jax.shard_map(
-            _local_converge,
-            mesh=mesh,
-            in_specs=(P("keys", None), P("keys"), P("keys", None)),
-            out_specs=P("keys", None),
-        ),
-        donate_argnums=0,
-    )
-    return fn(counts, local_rows, local_deltas)
-
-
-def read_all_sharded(mesh, counts):
-    """Row sums (GCOUNT values) for the whole keyspace; output stays
-    keys-sharded — only materialise on host what you need."""
-    fn = jax.jit(
-        jax.shard_map(
-            lambda blk: jnp.sum(blk, axis=-1, dtype=UINT64),
-            mesh=mesh,
-            in_specs=(P("keys", None),),
-            out_specs=P("keys"),
-        )
-    )
-    return fn(counts)
-
-
-def _local_then_pmax(blk):
-    # reduce the shard's own replica rows first, then all-reduce across the
-    # mesh axis: pmax alone only joins row-for-row across devices
-    local = jnp.max(blk, axis=0, keepdims=True)
-    joined = jax.lax.pmax(local, "rep")
-    return jnp.broadcast_to(joined, blk.shape)
+    return _converge_sharded(mesh, hi, lo, local_rows, d_hi, d_lo)
 
 
 @partial(jax.jit, static_argnames=("mesh",))
-def _pmax_join(mesh, counts):
+def _read_all_sharded(mesh, hi, lo):
+    return jax.shard_map(
+        planes.rowsum64,
+        mesh=mesh,
+        in_specs=(P("keys", None), P("keys", None)),
+        out_specs=P("keys"),
+    )(hi, lo)
+
+
+def read_all_sharded(mesh, hi, lo):
+    """Row sums (counter values, u64 wrapping) for the whole keyspace;
+    output stays keys-sharded — only materialise on host what you need."""
+    return _read_all_sharded(mesh, hi, lo)
+
+
+def _tree_join(hi_blk, lo_blk):
+    """Log-depth joint fold over the leading axis."""
+    while hi_blk.shape[0] > 1:
+        s = hi_blk.shape[0]
+        half = s // 2
+        fhi, flo = planes.join_max(
+            hi_blk[:half], lo_blk[:half], hi_blk[half : 2 * half], lo_blk[half : 2 * half]
+        )
+        if s % 2:  # odd leftover rides along
+            fhi = jnp.concatenate([fhi, hi_blk[-1:]])
+            flo = jnp.concatenate([flo, lo_blk[-1:]])
+        hi_blk, lo_blk = fhi, flo
+    return hi_blk, lo_blk
+
+
+def _local_then_pmax(hi_blk, lo_blk):
+    # fold the shard's own replica rows jointly first (pmax alone only
+    # joins row-for-row across devices), then two-phase u32 all-reduce:
+    # hi decides; lo competes only where hi is the winner
+    fhi, flo = _tree_join(hi_blk, lo_blk)
+    jhi = jax.lax.pmax(fhi, "rep")
+    lo_cand = jnp.where(fhi == jhi, flo, jnp.uint32(0))
+    jlo = jax.lax.pmax(lo_cand, "rep")
+    return (
+        jnp.broadcast_to(jhi, hi_blk.shape),
+        jnp.broadcast_to(jlo, lo_blk.shape),
+    )
+
+
+@partial(jax.jit, static_argnames=("mesh",))
+def _pmax_join(mesh, hi, lo):
     return jax.shard_map(
         _local_then_pmax,
         mesh=mesh,
-        in_specs=(P("rep", "keys"),),
-        out_specs=P("rep", "keys"),
-    )(counts)
+        in_specs=(P("rep", "keys"), P("rep", "keys")),
+        out_specs=(P("rep", "keys"), P("rep", "keys")),
+    )(hi, lo)
 
 
-def join_replica_axis(mesh, counts_stacked):
+def join_replica_axis(mesh, hi_stacked, lo_stacked):
     """Lattice-join full states sharded over the ``rep`` mesh axis.
 
-    counts_stacked: (S, K) or (S, K*R-flattened) sharded P("rep", "keys") —
-    S per-replica full states. The join semilattice's all-reduce is a local
-    max followed by pmax over ICI (the CRDT analog of gradient psum);
-    afterwards every row of every rep-shard holds the converged state.
+    hi/lo_stacked: (S, K) u32 planes sharded P("rep", "keys") — S
+    per-replica full u64 states. Afterwards every row of every rep-shard
+    holds the converged state.
     """
-    return _pmax_join(mesh, counts_stacked)
+    return _pmax_join(mesh, hi_stacked, lo_stacked)

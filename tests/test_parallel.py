@@ -10,13 +10,14 @@ import numpy as np
 import pytest
 
 import jylis_tpu  # noqa: F401
+from jylis_tpu.ops import planes
 from jylis_tpu.parallel import (
     converge_sharded,
     join_replica_axis,
     make_mesh,
     read_all_sharded,
     route_batch,
-    shard_counts,
+    shard_plane,
 )
 
 
@@ -31,16 +32,23 @@ def test_mesh_shapes():
         make_mesh(1000)
 
 
-def test_route_batch_blocks_and_pads():
-    rows = np.array([0, 5, 17, 18, 33], np.int32)
-    deltas = np.arange(5 * 2, dtype=np.uint64).reshape(5, 2)
-    local_rows, local_deltas = route_batch(rows, deltas, n_shards=4, rows_per_shard=16)
-    # shard 0 gets rows 0,5; shard 1 gets 17,18 (local 1,2); shard 2 gets 33
+def test_route_batch_blocks_pads_and_coalesces():
+    rows = np.array([0, 5, 17, 18, 33, 5], np.int32)  # 5 duplicated
+    deltas = np.arange(6 * 2, dtype=np.uint64).reshape(6, 2)
+    local_rows, d_hi, d_lo = route_batch(rows, deltas, n_shards=4, rows_per_shard=16)
     lr = local_rows.reshape(4, -1)
     assert lr.shape[1] == 2  # padded to the max shard load
     assert list(lr[0]) == [0, 5]
     assert list(lr[1]) == [1, 2]
-    assert lr[2][0] == 1 and lr[3][0] == lr[2][1]  # PAD_ROW fills
+    assert lr[2][0] == 1
+    # pad slots: far out of range AND unique within each shard's slice, so
+    # the device-side unique_indices hint stays honest
+    assert all(p > 1 << 20 for p in (lr[2][1], lr[3][0], lr[3][1]))
+    for shard in lr:
+        assert len(set(map(int, shard))) == len(shard)
+    # duplicate row 5 max-combined: deltas[1]=[2,3], deltas[5]=[10,11]
+    dl = d_lo.reshape(4, 2, 2)
+    np.testing.assert_array_equal(dl[0, 1], [10, 11])
 
 
 def test_sharded_converge_matches_single_chip():
@@ -48,29 +56,37 @@ def test_sharded_converge_matches_single_chip():
     K, R, B = 128, 8, 64
     n = 8
     mesh = make_mesh(n)
-    counts = np.zeros((K, R), np.uint64)
-    sharded = shard_counts(mesh, counts)
-    reference = counts.copy()
+    reference = np.zeros((K, R), np.uint64)
+    hi = shard_plane(mesh, np.zeros((K, R), np.uint32))
+    lo = shard_plane(mesh, np.zeros((K, R), np.uint32))
     for _ in range(3):
         rows = rng.integers(0, K, B).astype(np.int32)
-        deltas = rng.integers(0, 1 << 32, (B, R)).astype(np.uint64)
+        deltas = rng.integers(0, 1 << 48, (B, R)).astype(np.uint64)
         np.maximum.at(reference, rows, deltas)
-        lr, ld = route_batch(rows, deltas, n, K // n)
-        sharded = converge_sharded(mesh, sharded, lr, ld)
-    got = np.asarray(jax.device_get(sharded))
+        lr, dh, dl = route_batch(rows, deltas, n, K // n)
+        hi, lo = converge_sharded(mesh, hi, lo, lr, dh, dl)
+    got = planes.combine64_np(
+        np.asarray(jax.device_get(hi)), np.asarray(jax.device_get(lo))
+    )
     np.testing.assert_array_equal(got, reference)
-    sums = np.asarray(jax.device_get(read_all_sharded(mesh, sharded)))
+    sums = np.asarray(jax.device_get(read_all_sharded(mesh, hi, lo)))
     np.testing.assert_array_equal(sums, reference.sum(axis=1, dtype=np.uint64))
 
 
 def test_join_replica_axis_is_lattice_join():
     rng = np.random.default_rng(1)
-    S, K = 4, 64
+    S, K = 8, 64  # 2 local rows per rep shard: exercises the local fold
     mesh = make_mesh(8, rep=4)
-    states = rng.integers(0, 1 << 40, (S, K)).astype(np.uint64)
+    states = rng.integers(0, 1 << 62, (S, K)).astype(np.uint64)
+    s_hi, s_lo = planes.split64_np(states)
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    placed = jax.device_put(states, NamedSharding(mesh, P("rep", "keys")))
-    joined = np.asarray(jax.device_get(join_replica_axis(mesh, placed)))
+    sh = NamedSharding(mesh, P("rep", "keys"))
+    jhi, jlo = join_replica_axis(
+        mesh, jax.device_put(s_hi, sh), jax.device_put(s_lo, sh)
+    )
+    joined = planes.combine64_np(
+        np.asarray(jax.device_get(jhi)), np.asarray(jax.device_get(jlo))
+    )
     want = np.broadcast_to(states.max(axis=0), (S, K))
     np.testing.assert_array_equal(joined, want)
