@@ -1,0 +1,93 @@
+"""Native (C++) fast paths, loaded via ctypes with pure-Python fallbacks.
+
+The reference's hot codecs are compiled Pony (SURVEY.md §2: pony-resp's
+CommandParser, the framing/serialise codec); their rebuild equivalents are
+C++ under native/, built into ``libjylis_native.so`` by `make native` (or
+lazily here on first import when a toolchain is available — the build is
+two translation units and takes well under a second).
+
+``lib()`` returns the loaded CDLL or None; callers must keep working
+without it (the Python implementations are the semantic oracles).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC_DIR = os.path.join(_REPO_ROOT, "native")
+_SO_PATH = os.path.join(_SRC_DIR, "libjylis_native.so")
+
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _build() -> bool:
+    sources = [
+        os.path.join(_SRC_DIR, f)
+        for f in sorted(os.listdir(_SRC_DIR))
+        if f.endswith(".cpp")
+    ]
+    if not sources:
+        return False
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", _SO_PATH]
+            + sources,
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def _stale() -> bool:
+    so_mtime = os.path.getmtime(_SO_PATH)
+    return any(
+        os.path.getmtime(os.path.join(_SRC_DIR, f)) > so_mtime
+        for f in os.listdir(_SRC_DIR)
+        if f.endswith(".cpp")
+    )
+
+
+def lib() -> ctypes.CDLL | None:
+    """The native library, building it on first use if needed/possible."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    try:
+        if not os.path.exists(_SO_PATH) or _stale():
+            if not _build():
+                return None
+        cdll = ctypes.CDLL(_SO_PATH)
+        cdll.resp_scan.restype = ctypes.c_int32
+        cdll.resp_scan.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        cdll.resp_scan_many.restype = ctypes.c_int32
+        cdll.resp_scan_many.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        _lib = cdll
+    except OSError:
+        _lib = None
+    return _lib

@@ -39,7 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..models.base import PAD_ROW
+from ..models.base import pad_rows
 from ..ops import planes
 
 U32 = jnp.uint32
@@ -65,10 +65,8 @@ def route_batch(key_idx, deltas, n_shards: int, rows_per_shard: int):
     counts = np.bincount(shard_of, minlength=n_shards)
     width = max(int(counts.max()) if len(key_idx) else 0, 1)
     # distinct out-of-range pads per shard: each device's scatter keeps an
-    # honestly-unique index vector (see models/base.pad_rows)
-    local_rows = np.broadcast_to(
-        (PAD_ROW - np.arange(width, dtype=np.int32)), (n_shards, width)
-    ).copy()
+    # honestly-unique index vector
+    local_rows = np.broadcast_to(pad_rows(width), (n_shards, width)).copy()
     local_deltas = np.zeros((n_shards, width, deltas.shape[-1]), np.uint64)
     start = 0
     for s in range(n_shards):

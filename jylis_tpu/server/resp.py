@@ -119,11 +119,10 @@ class RespParser:
         line, pos = self._find_line(0)
         if line is None:
             return None
-        try:
-            n = int(line[1:])
-        except ValueError:
-            raise RespError("protocol error: bad array header") from None
-        if n < 0 or n > 1024 * 1024:
+        if not line[1:].isdigit():  # strict: no +, no whitespace (as native)
+            raise RespError("protocol error: bad array header")
+        n = int(line[1:])
+        if n > 1024 * 1024:
             raise RespError("protocol error: bad array length")
         items: list[bytes] = []
         for _ in range(n):
@@ -132,11 +131,10 @@ class RespParser:
                 return None
             if header[0:1] != b"$":
                 raise RespError("protocol error: expected bulk string")
-            try:
-                blen = int(header[1:])
-            except ValueError:
-                raise RespError("protocol error: bad bulk length") from None
-            if blen < 0 or blen > self._MAX_BULK:
+            if not header[1:].isdigit():  # strict, matching the native scanner
+                raise RespError("protocol error: bad bulk length")
+            blen = int(header[1:])
+            if blen > self._MAX_BULK:
                 raise RespError("protocol error: bad bulk length")
             if len(self._buf) < pos2 + blen + 2:
                 return None

@@ -17,8 +17,9 @@ from __future__ import annotations
 import asyncio
 
 from ..models.database import Database
+from ..native.resp import make_parser
 from ..utils.net import ipv4_port
-from .resp import Respond, RespError, RespParser
+from .resp import Respond, RespError
 
 
 class Server:
@@ -46,7 +47,7 @@ class Server:
     async def _handle_client(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
-        parser = RespParser()
+        parser = make_parser()  # native scanner when built, Python fallback
         resp = Respond(writer.write)
         try:
             while True:

@@ -1,0 +1,122 @@
+"""Native RESP scanner: build check + differential tests vs the Python
+parser (the semantic oracle).
+
+The native library is built lazily by jylis_tpu.native.lib() with g++ (in
+this environment the toolchain is baked in); if a build is genuinely
+impossible the suite must still reveal that, so the build test is a hard
+assertion, not a skip.
+"""
+
+import numpy as np
+import pytest
+
+from jylis_tpu.native import lib
+from jylis_tpu.native.resp import NativeRespParser, make_parser
+from jylis_tpu.server.resp import RespError, RespParser
+
+
+def test_native_lib_builds_and_loads():
+    assert lib() is not None
+
+
+def make_native() -> NativeRespParser:
+    cdll = lib()
+    assert cdll is not None
+    return NativeRespParser(cdll)
+
+
+def drain(parser, data: bytes):
+    parser.append(data)
+    return list(parser)
+
+
+CASES = [
+    b"*4\r\n$6\r\nGCOUNT\r\n$3\r\nINC\r\n$3\r\nfoo\r\n$1\r\n9\r\n",
+    b"*1\r\n$0\r\n\r\n",
+    b"*0\r\n",
+    b"TREG SET k hello 5\r\n",
+    b"  spaced   out\tcmd  \r\n",
+    b"\r\n*2\r\n$1\r\na\r\n$1\r\nb\r\n",  # blank inline line, then array
+    b"PING\r\nPING\r\n*1\r\n$4\r\nPING\r\n",  # pipelined mix
+]
+
+
+@pytest.mark.parametrize("data", CASES)
+def test_matches_python_parser(data):
+    want = drain(RespParser(), data)
+    got = drain(make_native(), data)
+    assert got == want
+
+
+@pytest.mark.parametrize("data", CASES)
+def test_matches_python_parser_byte_at_a_time(data):
+    py, nat = RespParser(), make_native()
+    want, got = [], []
+    for i in range(len(data)):
+        py.append(data[i : i + 1])
+        nat.append(data[i : i + 1])
+        want.extend(py)
+        got.extend(nat)
+    assert got == want
+
+
+ERROR_CASES = [
+    b"*2\r\n$abc\r\n",
+    b"*x\r\n",
+    b"*+2\r\n$1\r\na\r\n$1\r\nb\r\n",  # strict: no leading +
+    b"*1\r\n:5\r\n",  # not a bulk string
+    b"*1\r\n$3\r\nabcX\r\n",  # bad terminator
+    b"*-1\r\n",  # negative array
+    b"*1\r\n$-1\r\n",  # negative bulk
+]
+
+
+@pytest.mark.parametrize("data", ERROR_CASES)
+def test_protocol_errors_agree(data):
+    with pytest.raises(RespError):
+        drain(RespParser(), data)
+    with pytest.raises(RespError):
+        drain(make_native(), data)
+
+
+def test_truncated_input_agrees_with_oracle():
+    for data in CASES:
+        py, nat = RespParser(), make_native()
+        assert drain(nat, data[:-1]) == drain(py, data[:-1])
+
+
+def test_arg_array_growth():
+    from jylis_tpu.native.resp import _INITIAL_ARGS
+
+    n = _INITIAL_ARGS * 2  # forces the rc == -2 grow-and-rescan branch
+    parts = b"".join(b"$1\r\nx\r\n" for _ in range(n))
+    got = drain(make_native(), b"*%d\r\n" % n + parts)
+    assert got == [[b"x"] * n]
+
+
+def test_fuzz_differential():
+    rng = np.random.default_rng(0)
+    tokens = [
+        b"*", b"$", b"\r\n", b"1", b"3", b"9", b"a", b"GCOUNT", b" ",
+        b"INC", b"\r", b"\n", b"-", b"x" * 17,
+    ]
+    for _ in range(300):
+        blob = b"".join(
+            tokens[i] for i in rng.integers(0, len(tokens), rng.integers(1, 12))
+        )
+        py, nat = RespParser(), make_native()
+        try:
+            want = drain(py, blob)
+            perr = None
+        except RespError:
+            want, perr = None, True
+        try:
+            got = drain(nat, blob)
+            nerr = None
+        except RespError:
+            got, nerr = None, True
+        assert (perr, want) == (nerr, got), blob
+
+
+def test_make_parser_returns_native_here():
+    assert isinstance(make_parser(), NativeRespParser)
