@@ -69,6 +69,11 @@ class FrameReader:
         """Raise/lower the frame cap (used to widen after a handshake)."""
         self._max = max_frame
 
+    def pending(self) -> int:
+        """Bytes buffered but not yet yielded as a complete frame (useful
+        for end-of-stream truncation checks)."""
+        return len(self._buf) + (0 if self._need is None else HEADER_SIZE)
+
     def __iter__(self):
         return self
 

@@ -9,9 +9,11 @@ addresses) and dispose.pony:3-33 (SIGINT/SIGTERM -> drain deltas to peers
 from __future__ import annotations
 
 import asyncio
+import os
 import signal
 import sys
 
+from . import persist
 from .cluster import Cluster
 from .models import database as database_mod
 from .models.database import Database
@@ -23,12 +25,22 @@ from .utils.logo import LOGO
 
 class Dispose:
     """Idempotent clean-shutdown driver (dispose.pony:12-19): first drain
-    every repo's remaining deltas to peers, then stop the listeners."""
+    every repo's remaining deltas to peers, snapshot if configured, then
+    stop the listeners."""
 
-    def __init__(self, database: Database, server: Server, cluster: Cluster):
+    def __init__(
+        self,
+        database: Database,
+        server: Server,
+        cluster: Cluster,
+        snapshot_path: str = "",
+        log=None,
+    ):
         self._database = database
         self._server = server
         self._cluster = cluster
+        self._snapshot_path = snapshot_path
+        self._log = log
         self._disposing = False
         self.done = asyncio.Event()
 
@@ -42,6 +54,12 @@ class Dispose:
             return
         self._disposing = True
         self._database.clean_shutdown()  # final flush rides broadcast_deltas
+        if self._snapshot_path:
+            try:
+                persist.save_snapshot(self._database, self._snapshot_path)
+            except OSError as e:
+                if self._log is not None:
+                    self._log.err() and self._log.e(f"snapshot failed: {e}")
         self._cluster.dispose()
         asyncio.get_running_loop().create_task(self._finish())
 
@@ -55,11 +73,33 @@ async def run(argv: list[str] | None = None) -> None:
     system = System(config)
     database_mod.warmup()  # compile serving kernels before going live
     database = Database(identity=config.addr.hash64(), system_repo=system.repo)
+    log = config.log
+
+    snapshot_path = ""
+    if config.data_dir:
+        os.makedirs(config.data_dir, exist_ok=True)
+        snapshot_path = os.path.join(config.data_dir, "snapshot.jylis")
+        if os.path.exists(snapshot_path):
+            try:
+                n = persist.load_snapshot(database, snapshot_path)
+                log.info() and log.i(f"snapshot restored ({n} type batches)")
+            except persist.SnapshotError as e:
+                log.err() and log.e(f"snapshot not restored: {e}")
+                # preserve the unreadable file: the next clean shutdown will
+                # write snapshot_path fresh, and overwriting the only copy
+                # of un-restored data would destroy it
+                aside = snapshot_path + ".unreadable"
+                try:
+                    os.replace(snapshot_path, aside)
+                    log.err() and log.e(f"moved aside to {aside}")
+                except OSError:
+                    pass
+
     server = Server(config, database)
     cluster = Cluster(config, database)
     await server.start()
     await cluster.start()
-    dispose = Dispose(database, server, cluster)
+    dispose = Dispose(database, server, cluster, snapshot_path, log)
     dispose.on_signal()
 
     print(LOGO)

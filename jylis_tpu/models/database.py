@@ -33,6 +33,9 @@ class Database:
     def manager(self, name: str) -> RepoManager:
         return self._map[name.encode()]
 
+    def managers(self):
+        return self._map.values()
+
     def apply(self, resp, cmd: list[bytes]) -> None:
         mgr = self._map.get(cmd[0]) if cmd else None
         if mgr is None:

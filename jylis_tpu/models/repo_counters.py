@@ -153,6 +153,33 @@ class RepoGCOUNT(_CounterRepo):
         self._dirty.clear()
         return out
 
+    # -- snapshot (persist.py): full state in the wire-delta shape ----------
+
+    def dump_state(self):
+        self.drain()
+        counts = gcount.to_counts(self._state)
+        cols = {col: rid for rid, col in self._rids.items()}
+        out = []
+        for key, row in sorted(self._keys.items()):
+            d = {
+                cols[c]: int(v)
+                for c, v in enumerate(counts[row, : len(cols)])
+                if v
+            }
+            if d:
+                out.append((key, d))
+        return out
+
+    def load_state(self, batch) -> None:
+        for key, delta in batch:
+            self.converge(key, delta)
+            # my own column is my private monotonic state: losing it would
+            # make future INCs disappear under the pending max
+            if self._identity in delta:
+                self._own[key] = max(
+                    self._own.get(key, 0), delta[self._identity]
+                )
+
 
 class RepoPNCOUNT(_CounterRepo):
     name = "PNCOUNT"
@@ -237,3 +264,34 @@ class RepoPNCOUNT(_CounterRepo):
             out.append((k, (dp, dn)))
         self._dirty.clear()
         return out
+
+    # -- snapshot (persist.py): full state in the wire-delta shape ----------
+
+    def dump_state(self):
+        self.drain()
+        cols = {col: rid for rid, col in self._rids.items()}
+        p = planes.combine64_np(
+            np.asarray(self._state.p_hi), np.asarray(self._state.p_lo)
+        )
+        n = planes.combine64_np(
+            np.asarray(self._state.n_hi), np.asarray(self._state.n_lo)
+        )
+        out = []
+        for key, row in sorted(self._keys.items()):
+            dp = {cols[c]: int(v) for c, v in enumerate(p[row, : len(cols)]) if v}
+            dn = {cols[c]: int(v) for c, v in enumerate(n[row, : len(cols)]) if v}
+            if dp or dn:
+                out.append((key, (dp, dn)))
+        return out
+
+    def load_state(self, batch) -> None:
+        for key, (dp, dn) in batch:
+            self.converge(key, (dp, dn))
+            if self._identity in dp:
+                self._own_p[key] = max(
+                    self._own_p.get(key, 0), dp[self._identity]
+                )
+            if self._identity in dn:
+                self._own_n[key] = max(
+                    self._own_n.get(key, 0), dn[self._identity]
+                )

@@ -79,5 +79,14 @@ class RepoSYSTEM:
         other = hostref.TLog(entries=list(entries), cutoff=cutoff)
         self._log.converge(other)
 
+    # -- snapshot (persist.py): full state in the wire-delta shape ----------
+
+    def dump_state(self):
+        return [(b"_log", (self._log.latest(), self._log.cutoff))]
+
+    def load_state(self, batch) -> None:
+        for key, delta in batch:
+            self.converge(key, delta)
+
     def drain(self) -> None:
         pass

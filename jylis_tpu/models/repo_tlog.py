@@ -200,6 +200,30 @@ class RepoTLOG:
         self._deltas.clear()
         return out
 
+    # -- snapshot (persist.py): full state in the wire-delta shape ----------
+
+    def dump_state(self):
+        self.drain()
+        # one bulk device->host pull, then slice rows locally (a per-key
+        # jitted gather would be O(keys) dispatches inside shutdown)
+        all_ts = np.asarray(self._state.ts)
+        all_vid = np.asarray(self._state.vid)
+        out = []
+        for key, row in sorted(self._keys.items()):
+            length = self._len_cache.get(row, 0)
+            cutoff = self._cut_cache.get(row, 0)
+            entries = [
+                (self._interner.lookup(int(all_vid[row, i])), int(all_ts[row, i]))
+                for i in range(length)
+            ]
+            if entries or cutoff:
+                out.append((key, (entries, cutoff)))
+        return out
+
+    def load_state(self, batch) -> None:
+        for key, delta in batch:
+            self.converge(key, delta)
+
     def drain(self) -> None:
         if not self._pend_entries and not self._pend_cutoff:
             return

@@ -105,6 +105,22 @@ class RepoTREG:
         self._deltas.clear()
         return out
 
+    # -- snapshot (persist.py): full state in the wire-delta shape ----------
+
+    def dump_state(self):
+        self.drain()
+        out = []
+        for key, row in sorted(self._keys.items()):
+            hit = self._cache.get(row)
+            if hit is not None and hit[1] >= 0:
+                ts, vid = hit
+                out.append((key, (self._interner.lookup(vid), ts)))
+        return out
+
+    def load_state(self, batch) -> None:
+        for key, delta in batch:
+            self.converge(key, delta)
+
     # -- device drain -------------------------------------------------------
 
     def drain(self) -> None:

@@ -117,6 +117,21 @@ class RepoUJSON:
     def converge(self, key: bytes, delta: UJSON) -> None:
         self._data_for(key).converge(delta)
 
+    # -- snapshot (persist.py): full state in the wire-delta shape ----------
+
+    def dump_state(self):
+        # keep docs whose causal context is non-trivial even when empty of
+        # entries: the tombstone knowledge is what makes removals stick
+        return [
+            (key, doc)
+            for key, doc in sorted(self._data.items())
+            if doc.entries or doc.ctx.vv or doc.ctx.cloud
+        ]
+
+    def load_state(self, batch) -> None:
+        for key, delta in batch:
+            self.converge(key, delta)
+
     def deltas_size(self) -> int:
         return len(self._deltas)
 

@@ -30,6 +30,7 @@ class Config:
     seed_addrs: list[Address] = field(default_factory=list)
     heartbeat_time: float = 10.0
     system_log_trim: int = 200
+    data_dir: str = ""  # extension: snapshot/restore (persist.py)
     log: Log = field(default_factory=Log.create_none)
 
     def normalize(self) -> None:
@@ -64,6 +65,12 @@ def config_from_cli(argv: list[str] | None = None, log_out=None) -> Config:
         help="The number of entries to retain in the distributed `SYSTEM GETLOG`.",
     )
     parser.add_argument(
+        "--data-dir", default="",
+        help="Directory for state snapshots: restored on boot, written on "
+        "clean shutdown. Empty (default) disables persistence, like the "
+        "reference.",
+    )
+    parser.add_argument(
         "-L", "--log-level", default="info",
         help="Maximum level of detail for logging (error, warn, info, or debug).",
     )
@@ -77,6 +84,7 @@ def config_from_cli(argv: list[str] | None = None, log_out=None) -> Config:
     ]
     config.heartbeat_time = args.heartbeat_time
     config.system_log_trim = args.system_log_trim
+    config.data_dir = args.data_dir
 
     level = {"error": "err", "warn": "warn", "info": "info", "debug": "debug"}.get(
         args.log_level

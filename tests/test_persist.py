@@ -1,0 +1,148 @@
+"""Snapshot / restore tests.
+
+The snapshot is a full-state delta dump in the cluster wire format
+(persist.py), so restore is plain lattice convergence — exercised here per
+data type, across identities, and for the join-with-live-state property
+that makes stale snapshots safe.
+"""
+
+import numpy as np  # noqa: F401
+
+import jylis_tpu  # noqa: F401
+import pytest
+
+from jylis_tpu import persist
+from jylis_tpu.models.database import Database
+from jylis_tpu.server.resp import Respond
+
+
+class Cap:
+    def __init__(self):
+        self.buf = b""
+
+    def __call__(self, b):
+        self.buf += b
+
+
+def call(db, *args):
+    cap = Cap()
+    db.apply(Respond(cap), [a if isinstance(a, bytes) else a.encode() for a in args])
+    return cap.buf
+
+
+def populate(db):
+    call(db, "GCOUNT", "INC", "g", "7")
+    call(db, "PNCOUNT", "INC", "p", "40")
+    call(db, "PNCOUNT", "DEC", "p", "2")
+    call(db, "TREG", "SET", "r", "hello", "9")
+    call(db, "TLOG", "INS", "l", "a", "3")
+    call(db, "TLOG", "INS", "l", "b", "5")
+    call(db, "TLOG", "TRIMAT", "l", "4")
+    call(db, "UJSON", "SET", "u", "name", '"alice"')
+    call(db, "UJSON", "RM", "u", "name", '"alice"')
+    call(db, "UJSON", "INS", "u", "tag", "1")
+    db.system.inslog("a log line")
+
+
+READS = {
+    ("GCOUNT", "GET", "g"): b":7\r\n",
+    ("PNCOUNT", "GET", "p"): b":38\r\n",
+    ("TREG", "GET", "r"): b"*2\r\n$5\r\nhello\r\n:9\r\n",
+    ("TLOG", "GET", "l"): b"*1\r\n*2\r\n$1\r\nb\r\n:5\r\n",
+    ("UJSON", "GET", "u", "tag"): b"$1\r\n1\r\n",
+    ("UJSON", "GET", "u", "name"): b"$0\r\n\r\n",  # removed stays removed
+}
+
+
+def test_roundtrip_all_types(tmp_path):
+    db = Database(identity=1)
+    populate(db)
+    path = str(tmp_path / "snap.jylis")
+    persist.save_snapshot(db, path)
+
+    db2 = Database(identity=1)
+    n = persist.load_snapshot(db2, path)
+    assert n == 6  # one batch per data type
+    for req, want in READS.items():
+        assert call(db2, *req) == want, req
+    # the restored SYSTEM log still has the line
+    assert b"a log line" in call(db2, "SYSTEM", "GETLOG")
+
+
+def test_own_counter_state_survives(tmp_path):
+    """Post-restore INCs must still advance the counter — the node's own
+    column is private monotonic state."""
+    db = Database(identity=1)
+    call(db, "GCOUNT", "INC", "g", "7")
+    call(db, "PNCOUNT", "INC", "p", "5")
+    path = str(tmp_path / "snap.jylis")
+    persist.save_snapshot(db, path)
+
+    db2 = Database(identity=1)
+    persist.load_snapshot(db2, path)
+    call(db2, "GCOUNT", "INC", "g", "3")
+    assert call(db2, "GCOUNT", "GET", "g") == b":10\r\n"
+    call(db2, "PNCOUNT", "DEC", "p", "1")
+    assert call(db2, "PNCOUNT", "GET", "p") == b":4\r\n"
+
+
+def test_stale_snapshot_joins_with_live_state(tmp_path):
+    """Loading an OLD snapshot into a node that moved on must be a no-op
+    for anything newer (lattice join, not replay)."""
+    db = Database(identity=1)
+    call(db, "TREG", "SET", "r", "old", "5")
+    path = str(tmp_path / "snap.jylis")
+    persist.save_snapshot(db, path)
+    call(db, "TREG", "SET", "r", "new", "8")
+    persist.load_snapshot(db, path)
+    assert call(db, "TREG", "GET", "r") == b"*2\r\n$3\r\nnew\r\n:8\r\n"
+
+
+def test_restore_under_other_identity(tmp_path):
+    """A snapshot from node A restored on node B keeps A's counter columns
+    (it is replicated state, not B's own)."""
+    db = Database(identity=1)
+    call(db, "GCOUNT", "INC", "g", "7")
+    path = str(tmp_path / "snap.jylis")
+    persist.save_snapshot(db, path)
+    db2 = Database(identity=2)
+    persist.load_snapshot(db2, path)
+    call(db2, "GCOUNT", "INC", "g", "1")
+    assert call(db2, "GCOUNT", "GET", "g") == b":8\r\n"
+
+
+def test_corrupt_and_mismatched_files(tmp_path):
+    db = Database(identity=1)
+    bad = tmp_path / "bad"
+    bad.write_bytes(b"not a snapshot at all")
+    with pytest.raises(persist.SnapshotError):
+        persist.load_snapshot(db, str(bad))
+    sig = tmp_path / "sig"
+    sig.write_bytes(persist.MAGIC + b"\x00" * 32)
+    with pytest.raises(persist.SnapshotError):
+        persist.load_snapshot(db, str(sig))
+    trunc = tmp_path / "trunc"
+    populate(db)
+    ok = tmp_path / "ok"
+    persist.save_snapshot(db, str(ok))
+    trunc.write_bytes(ok.read_bytes()[:-10])
+    with pytest.raises(persist.SnapshotError):
+        persist.load_snapshot(Database(identity=1), str(trunc))
+
+
+def test_truncation_at_frame_boundary_detected(tmp_path):
+    """A file cut exactly between frames parses cleanly but must still be
+    rejected (it restores only a subset of the data types)."""
+    from jylis_tpu.cluster.framing import HEADER_SIZE, parse_header
+
+    db = Database(identity=1)
+    populate(db)
+    path = tmp_path / "snap.jylis"
+    persist.save_snapshot(db, str(path))
+    blob = path.read_bytes()
+    sig_end = len(persist.MAGIC) + 32
+    first_len = parse_header(blob[sig_end : sig_end + HEADER_SIZE])
+    cut = tmp_path / "cut.jylis"
+    cut.write_bytes(blob[: sig_end + HEADER_SIZE + first_len])
+    with pytest.raises(persist.SnapshotError, match="type batches"):
+        persist.load_snapshot(Database(identity=1), str(cut))
