@@ -1,0 +1,30 @@
+# jylis-tpu build/test targets (reference analog: the upstream Makefile's
+# test/build/debug targets, SURVEY.md section 2.8)
+
+PY ?= python
+
+.PHONY: test bench native run clean check-graft
+
+test:
+	$(PY) -m pytest tests/ -x -q
+
+bench:
+	$(PY) bench.py
+
+# build the native codecs explicitly (they also build lazily on import)
+native:
+	g++ -O2 -std=c++17 -shared -fPIC -o native/libjylis_native.so native/*.cpp
+
+run:
+	$(PY) -m jylis_tpu
+
+# what the driver does: single-chip compile check + virtual multi-chip dryrun
+check-graft:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	$(PY) -c "import jax; jax.config.update('jax_platforms','cpu'); \
+	import __graft_entry__ as g; fn, a = g.entry(); \
+	jax.jit(fn).lower(*a).compile(); g.dryrun_multichip(8); print('OK')"
+
+clean:
+	rm -f native/libjylis_native.so
+	find . -name __pycache__ -type d -exec rm -rf {} +

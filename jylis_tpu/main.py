@@ -14,6 +14,7 @@ import signal
 import sys
 
 from . import persist
+from .utils import metrics
 from .cluster import Cluster
 from .models import database as database_mod
 from .models.database import Database
@@ -53,6 +54,9 @@ class Dispose:
         if self._disposing:
             return
         self._disposing = True
+        if self._log is not None:
+            self._log.info() and self._log.i(f"merge metrics: {metrics.report()}")
+        metrics.stop_profiling()
         self._database.clean_shutdown()  # final flush rides broadcast_deltas
         if self._snapshot_path:
             try:
@@ -72,6 +76,7 @@ async def run(argv: list[str] | None = None) -> None:
     config = config_from_cli(argv)
     system = System(config)
     database_mod.warmup()  # compile serving kernels before going live
+    metrics.counters.clear()  # don't count warmup compiles as serving drains
     database = Database(identity=config.addr.hash64(), system_repo=system.repo)
     log = config.log
 

@@ -24,6 +24,7 @@ import numpy as np
 
 from ..ops import gcount, planes, pncount
 from .base import ParseError, bucket, need, pad_rows, parse_u64, U64_MAX
+from ..utils.metrics import timed_drain
 from .help import RepoHelp
 
 GCOUNT_HELP = RepoHelp("GCOUNT", {"GET": "key", "INC": "key value"})
@@ -127,6 +128,7 @@ class RepoGCOUNT(_CounterRepo):
             if v > p.get(col, 0):
                 p[col] = v
 
+    @timed_drain("GCOUNT", lambda self: len(self._pending))
     def drain(self) -> None:
         if not self._pending:
             return
@@ -232,6 +234,10 @@ class RepoPNCOUNT(_CounterRepo):
                 if v > p.get(col, 0):
                     p[col] = v
 
+    @timed_drain(
+        "PNCOUNT",
+        lambda self: len(set(self._pending_p) | set(self._pending_n)),
+    )
     def drain(self) -> None:
         if not self._pending_p and not self._pending_n:
             return

@@ -19,6 +19,7 @@ import numpy as np
 from ..ops import hostref, tlog
 from ..ops.interner import Interner, prefix_rank
 from .base import PAD_ROW, ParseError, bucket, need, parse_opt_count, parse_u64
+from ..utils.metrics import timed_drain
 from .help import RepoHelp
 
 TLOG_HELP = RepoHelp(
@@ -224,6 +225,10 @@ class RepoTLOG:
         for key, delta in batch:
             self.converge(key, delta)
 
+    @timed_drain(
+        "TLOG",
+        lambda self: len(set(self._pend_entries) | set(self._pend_cutoff)),
+    )
     def drain(self) -> None:
         if not self._pend_entries and not self._pend_cutoff:
             return

@@ -21,6 +21,7 @@ import numpy as np
 from ..ops import treg
 from ..ops.interner import Interner, prefix_rank
 from .base import ParseError, bucket, need, pad_rows, parse_u64
+from ..utils.metrics import timed_drain
 from .help import RepoHelp
 
 TREG_HELP = RepoHelp("TREG", {"GET": "key", "SET": "key value timestamp"})
@@ -123,6 +124,7 @@ class RepoTREG:
 
     # -- device drain -------------------------------------------------------
 
+    @timed_drain("TREG", lambda self: len(self._pending))
     def drain(self) -> None:
         if not self._pending:
             return

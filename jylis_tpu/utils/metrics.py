@@ -1,0 +1,94 @@
+"""Merge-path metrics + opt-in JAX profiler tracing.
+
+The reference's only observability is the replicated SYSTEM log
+(SURVEY.md §2.6 — no tracing, no profiler, no metrics endpoint); §5.1
+directs the rebuild to add profiler hooks around merge batches with
+per-batch timing counters. Two pieces:
+
+* every device drain runs under `timed_drain`, accumulating per-type
+  batch counts / batched-key counts / device seconds — dumped into the
+  (replicated, queryable) SYSTEM log at clean shutdown and available any
+  time via `report()`;
+* set ``JYLIS_PROFILE_DIR=/some/dir`` to wrap each drain in a
+  ``jax.profiler.trace`` step so the XLA timeline of the merge path can
+  be inspected in TensorBoard/XProf.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import os
+import time
+from collections import defaultdict
+
+_PROFILE_DIR = os.environ.get("JYLIS_PROFILE_DIR", "")
+_profiling = False
+
+
+def _drain_scope(name: str):
+    """One long-lived profiler session (started lazily at the first drain),
+    with a StepTraceAnnotation per drain — per-drain start/stop would dump
+    a whole trace directory per batch and distort the timings."""
+    global _profiling
+    if not _PROFILE_DIR:
+        return contextlib.nullcontext()
+    import jax
+
+    if not _profiling:
+        jax.profiler.start_trace(_PROFILE_DIR)
+        _profiling = True
+    return jax.profiler.StepTraceAnnotation(f"drain_{name}")
+
+counters: dict[str, dict[str, float]] = defaultdict(
+    lambda: {"batches": 0, "keys": 0, "seconds": 0.0}
+)
+
+
+def note_drain(name: str, n_keys: int, seconds: float) -> None:
+    c = counters[name]
+    c["batches"] += 1
+    c["keys"] += n_keys
+    c["seconds"] += seconds
+
+
+def timed_drain(name: str, key_count):
+    """Decorator for repo drain() methods: per-batch counters + optional
+    profiler trace. ``key_count(self)`` returns the pending batch size."""
+
+    def wrap(fn):
+        @functools.wraps(fn)
+        def inner(self):
+            n = key_count(self)
+            if n == 0:
+                return fn(self)
+            with _drain_scope(name):
+                t0 = time.perf_counter()
+                out = fn(self)
+                note_drain(name, n, time.perf_counter() - t0)
+            return out
+
+        return inner
+
+    return wrap
+
+
+def stop_profiling() -> None:
+    """Flush the long-lived profiler session (called at clean shutdown)."""
+    global _profiling
+    if _profiling:
+        import jax
+
+        jax.profiler.stop_trace()
+        _profiling = False
+
+
+def report() -> str:
+    parts = []
+    for name in sorted(counters):
+        c = counters[name]
+        parts.append(
+            f"{name}: {int(c['batches'])} drains, {int(c['keys'])} keys, "
+            f"{c['seconds'] * 1e3:.1f}ms device"
+        )
+    return "; ".join(parts) if parts else "no drains"
