@@ -90,19 +90,237 @@ def bench_cpu() -> float:
     return K * CPU_ROUNDS / dt
 
 
-def main() -> None:
+# ---- additional BASELINE.json configs (run with --config NAME / --all) -----
+
+
+def config_gcount_smoke() -> dict:
+    """Config 1: GCOUNT single-key INC/GET smoke through the engine seam
+    (repo_gcount.pony) — commands/sec including host dispatch + device
+    serving reads."""
+    from jylis_tpu.models.database import Database, _NullRespond
+
+    db = Database(identity=1)
+    resp = _NullRespond()
+    db.apply(resp, [b"GCOUNT", b"INC", b"k", b"1"])
+    db.apply(resp, [b"GCOUNT", b"GET", b"k"])  # compile
+    n = 2000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        db.apply(resp, [b"GCOUNT", b"INC", b"k", b"1"])
+        db.apply(resp, [b"GCOUNT", b"GET", b"k"])
+    dt = time.perf_counter() - t0
+    return {
+        "metric": "GCOUNT INC+GET smoke, one node (config 1)",
+        "value": round(2 * n / dt, 1),
+        "unit": "commands/sec",
+        "vs_baseline": 0,
+    }
+
+
+def config_pncount_100k() -> dict:
+    """Config 2: PNCOUNT 100k keys, 8 replica columns, batched INC/DEC +
+    converge (repo_pncount.pony) — same kernel as the north star at the
+    smaller shape."""
+    import jax
+    import jax.numpy as jnp
+
+    from jylis_tpu.ops import pncount
+
+    K2, R2, rounds = 100_000, 8, 16
+    perm = np.random.default_rng(0).permutation(K2).astype(np.int32)
+    ki = jnp.asarray(perm)
+
+    @jax.jit
+    def sweep(state, ki):
+        def body(state, i):
+            def bits(j):
+                return jax.random.bits(jax.random.key(j), (K2, R2), jnp.uint32)
+
+            return (
+                pncount.converge_batch(
+                    state, ki, bits(i * 4), bits(i * 4 + 1),
+                    bits(i * 4 + 2), bits(i * 4 + 3),
+                ),
+                None,
+            )
+
+        state, _ = jax.lax.scan(body, state, jnp.arange(rounds, dtype=jnp.uint32))
+        return state
+
+    state = pncount.init(K2, R2)
+    s1 = sweep(state, ki)
+    _ = np.asarray(jax.device_get(s1.p_hi.ravel()[0:1]))
+    t0 = time.perf_counter()
+    s1 = sweep(state, ki)
+    _ = np.asarray(jax.device_get(s1.p_hi.ravel()[0:1]))
+    dt = time.perf_counter() - t0
+    return {
+        "metric": "PNCOUNT 100k-key x 8-replica converge (config 2)",
+        "value": round(K2 * rounds / dt, 1),
+        "unit": "merges/sec",
+        "vs_baseline": 0,
+    }
+
+
+def config_treg_1m() -> dict:
+    """Config 3: TREG 1M-key random-timestamp SET merge (repo_treg.pony)
+    vs a vectorised numpy LWW baseline."""
+    import jax
+    import jax.numpy as jnp
+
+    from jylis_tpu.ops import treg
+
+    K3, rounds = 1_000_000, 8
+    perm = np.random.default_rng(0).permutation(K3).astype(np.int32)
+    ki = jnp.asarray(perm)
+
+    @jax.jit
+    def sweep(state, ki):
+        def body(state, i):
+            ts = jax.random.bits(jax.random.key(i * 3), (K3,), jnp.uint32).astype(jnp.uint64)
+            rank = jax.random.bits(jax.random.key(i * 3 + 1), (K3,), jnp.uint32).astype(jnp.uint64)
+            vid = jax.random.randint(jax.random.key(i * 3 + 2), (K3,), 0, 1 << 31, jnp.int64)
+            st, _tie = treg.converge_batch(state, ki, ts, rank, vid)
+            return st, None
+
+        state, _ = jax.lax.scan(body, state, jnp.arange(rounds, dtype=jnp.uint32))
+        return state
+
+    state = treg.init(K3)
+    s1 = sweep(state, ki)
+    _ = np.asarray(jax.device_get(s1.ts.ravel()[0:1]))
+    t0 = time.perf_counter()
+    s1 = sweep(state, ki)
+    _ = np.asarray(jax.device_get(s1.ts.ravel()[0:1]))
+    dt = time.perf_counter() - t0
+    dev = K3 * rounds / dt
+
+    # numpy LWW baseline: same (ts, rank) lexicographic take
+    rng = np.random.default_rng(0)
+    c_ts = np.zeros(K3, np.uint64)
+    c_rank = np.zeros(K3, np.uint64)
+    d_ts = rng.integers(0, 1 << 32, K3).astype(np.uint64)
+    d_rank = rng.integers(0, 1 << 32, K3).astype(np.uint64)
+    t0 = time.perf_counter()
+    for _ in range(3):
+        cur_ts = c_ts[perm]
+        take = (d_ts > cur_ts) | ((d_ts == cur_ts) & (d_rank > c_rank[perm]))
+        c_ts[perm] = np.where(take, d_ts, cur_ts)
+        c_rank[perm] = np.where(take, d_rank, c_rank[perm])
+    cpu = K3 * 3 / (time.perf_counter() - t0)
+    return {
+        "metric": "TREG 1M-key LWW SET merge (config 3)",
+        "value": round(dev, 1),
+        "unit": "merges/sec",
+        "vs_baseline": round(dev / cpu, 2),
+    }
+
+
+def config_tlog_trim() -> dict:
+    """Config 4: TLOG 10k keys x 1k entries, merge + TRIM
+    (repo_tlog.pony) — entries merged/sec through the segment-sort join."""
+    import jax
+    import jax.numpy as jnp
+
+    from jylis_tpu.ops import tlog
+
+    K4, L, chunk, rounds = 10_000, 1024, 128, 8
+    state = tlog.init(K4, L + chunk)
+    ki = jnp.arange(K4, dtype=jnp.int32)
+
+    @jax.jit
+    def merge_chunk(state, i):
+        ts = jax.random.bits(jax.random.key(i * 2), (K4, chunk), jnp.uint32).astype(jnp.uint64) | jnp.uint64(1)
+        rank = jax.random.bits(jax.random.key(i * 2 + 1), (K4, chunk), jnp.uint32).astype(jnp.uint64)
+        vid = (ts & jnp.uint64(0x7FFFFFFF)).astype(jnp.int64)
+        cut = jnp.zeros((K4,), jnp.uint64)
+        st, _ovf = tlog.converge_batch(state, ki, ts, rank, vid, cut)
+        return st
+
+    counts = jnp.full((K4,), 512, jnp.int64)
+    s = merge_chunk(state, 0)  # compile both kernels before timing
+    s = tlog.trim_batch(s, ki, counts)
+    _ = np.asarray(jax.device_get(s.length[0:1]))
+    t0 = time.perf_counter()
+    s = state
+    for i in range(rounds):  # 8 x 128 = 1k entries per key
+        s = merge_chunk(s, i)
+    s = tlog.trim_batch(s, ki, counts)  # TRIM every key to 512 entries
+    _ = np.asarray(jax.device_get(s.length[0:1]))
+    dt = time.perf_counter() - t0
+    merged = K4 * chunk * rounds
+    return {
+        "metric": "TLOG 10k-key x 1k-entry merge+TRIM (config 4)",
+        "value": round(merged / dt, 1),
+        "unit": "entries/sec",
+        "vs_baseline": 0,
+    }
+
+
+def config_ujson_32() -> dict:
+    """Config 5: UJSON concurrent field edits across 32 replicas
+    (repo_ujson.pony) — host-resident lattice (see parallel/PLAN.md),
+    measured as field-edit merges/sec with full convergence checking."""
+    from jylis_tpu.ops.ujson_host import UJSON
+
+    n_rep, edits = 32, 40
+    replicas = [UJSON() for _ in range(n_rep)]
+    deltas = []
+    for r, doc in enumerate(replicas):
+        for e in range(edits):
+            d = UJSON()
+            doc.set_doc(r, (f"field{e % 8}",), str(r * 1000 + e), delta=d)
+            deltas.append(d)
+    t0 = time.perf_counter()
+    for doc in replicas:
+        for d in deltas:
+            doc.converge(d)
+    dt = time.perf_counter() - t0
+    renders = {doc.render() for doc in replicas}
+    assert len(renders) == 1, "replicas diverged"
+    return {
+        "metric": "UJSON 32-replica concurrent edits (config 5)",
+        "value": round(n_rep * len(deltas) / dt, 1),
+        "unit": "delta merges/sec",
+        "vs_baseline": 0,
+    }
+
+
+CONFIGS = {
+    "gcount-smoke": config_gcount_smoke,
+    "pncount-100k": config_pncount_100k,
+    "treg-1m": config_treg_1m,
+    "tlog-trim": config_tlog_trim,
+    "ujson-32": config_ujson_32,
+}
+
+
+def north_star() -> dict:
     device = bench_device()
     cpu = bench_cpu()
-    print(
-        json.dumps(
-            {
-                "metric": "PNCOUNT anti-entropy merges/sec/chip (1M keys x 64 replicas)",
-                "value": round(device, 1),
-                "unit": "merges/sec",
-                "vs_baseline": round(device / cpu, 2),
-            }
-        )
-    )
+    return {
+        "metric": "PNCOUNT anti-entropy merges/sec/chip (1M keys x 64 replicas)",
+        "value": round(device, 1),
+        "unit": "merges/sec",
+        "vs_baseline": round(device / cpu, 2),
+    }
+
+
+def main() -> None:
+    import sys
+
+    args = sys.argv[1:]
+    if not args:
+        print(json.dumps(north_star()))  # the driver's ONE line
+    elif args[0] == "--all":
+        print(json.dumps(north_star()))
+        for fn in CONFIGS.values():
+            print(json.dumps(fn()))
+    elif args[0] == "--config" and len(args) > 1 and args[1] in CONFIGS:
+        print(json.dumps(CONFIGS[args[1]]()))
+    else:
+        print(f"usage: bench.py [--all | --config {'|'.join(CONFIGS)}]")
+        sys.exit(2)
 
 
 if __name__ == "__main__":

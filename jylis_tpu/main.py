@@ -54,9 +54,6 @@ class Dispose:
         if self._disposing:
             return
         self._disposing = True
-        if self._log is not None:
-            self._log.info() and self._log.i(f"merge metrics: {metrics.report()}")
-        metrics.stop_profiling()
         self._database.clean_shutdown()  # final flush rides broadcast_deltas
         if self._snapshot_path:
             try:
@@ -64,6 +61,11 @@ class Dispose:
             except OSError as e:
                 if self._log is not None:
                     self._log.err() and self._log.e(f"snapshot failed: {e}")
+        # after the final drains (snapshot dump included) so the report
+        # covers them and no profiler trace restarts behind our back
+        if self._log is not None:
+            self._log.info() and self._log.i(f"merge metrics: {metrics.report()}")
+        metrics.stop_profiling()
         self._cluster.dispose()
         asyncio.get_running_loop().create_task(self._finish())
 
