@@ -97,3 +97,10 @@ def warmup() -> None:
         b"UJSON GET k a",
     ):
         db.apply(resp, line.split(b" "))
+    # counter GETs after purely-local INCs serve from the host cache and
+    # never touch the device; a foreign delta forces the drain kernels
+    # (_drain_g/_drain_pn) through their XLA compile here, not mid-serving
+    db.manager("GCOUNT").repo.converge(b"k", {7: 1})
+    db.apply(resp, [b"GCOUNT", b"GET", b"k"])
+    db.manager("PNCOUNT").repo.converge(b"k", ({7: 1}, {7: 1}))
+    db.apply(resp, [b"PNCOUNT", b"GET", b"k"])

@@ -45,6 +45,11 @@ def _drain_pn(state, ki, dp_hi, dp_lo, dn_hi, dn_lo):
     return st, pncount.read(st, ki)
 
 
+def _wrap_i64(v: int) -> int:
+    """Wrap into signed-64 range (the reference's modular (p-n).i64())."""
+    return ((v + (1 << 63)) & U64_MAX) - (1 << 63)
+
+
 class _CounterRepo:
     """Shared machinery; subclasses bind the ops module and command set."""
 
@@ -56,6 +61,20 @@ class _CounterRepo:
         self._rep_cap = rep_cap
         self._values: dict[int, int] = {}  # row -> cached serving value
         self._dirty: set[bytes] = set()  # keys with unflushed deltas
+        # rows whose pending batch contains FOREIGN deltas: only those make
+        # the host value cache stale. Local INC/DEC adjust the cache
+        # eagerly and exactly (own columns are private and monotone), so a
+        # GET after purely-local writes never needs a device round-trip —
+        # the read-your-writes host shadow from SURVEY.md section 7(c).
+        self._foreign: set[int] = set()
+
+    def _get_value(self, key: bytes) -> int:
+        row = self._keys.get(key)
+        if row is None:
+            return 0
+        if row in self._foreign:
+            self.drain()
+        return self._values.get(row, 0)
 
     def _row_for(self, key: bytes) -> int:
         row = self._keys.get(key)
@@ -98,9 +117,7 @@ class RepoGCOUNT(_CounterRepo):
     def apply(self, resp, args: list[bytes]) -> bool:
         op = need(args, 0)
         if op == b"GET":
-            self.drain()
-            row = self._keys.get(need(args, 1))
-            resp.u64(self._values.get(row, 0) if row is not None else 0)
+            resp.u64(self._get_value(need(args, 1)))
             return False
         if op == b"INC":
             key = need(args, 1)
@@ -114,9 +131,12 @@ class RepoGCOUNT(_CounterRepo):
         new = (self._own.get(key, 0) + amount) & U64_MAX
         self._own[key] = new
         col = self._col_for(self._identity)
-        p = self._pending.setdefault(self._row_for(key), {})
+        row = self._row_for(key)
+        p = self._pending.setdefault(row, {})
         p[col] = max(p.get(col, 0), new)
         self._dirty.add(key)
+        # own column grew by exactly `amount`: adjust the value cache
+        self._values[row] = (self._values.get(row, 0) + amount) & U64_MAX
 
     # -- lattice plumbing ---------------------------------------------------
 
@@ -127,6 +147,7 @@ class RepoGCOUNT(_CounterRepo):
             col = self._col_for(rid)
             if v > p.get(col, 0):
                 p[col] = v
+        self._foreign.add(row)
 
     @timed_drain("GCOUNT", lambda self: len(self._pending))
     def drain(self) -> None:
@@ -147,6 +168,7 @@ class RepoGCOUNT(_CounterRepo):
         for i, row in enumerate(rows):
             self._values[row] = int(sums[i])
         self._pending.clear()
+        self._foreign.clear()
 
     def flush_deltas(self):
         out = [
@@ -202,9 +224,7 @@ class RepoPNCOUNT(_CounterRepo):
     def apply(self, resp, args: list[bytes]) -> bool:
         op = need(args, 0)
         if op == b"GET":
-            self.drain()
-            row = self._keys.get(need(args, 1))
-            resp.i64(self._values.get(row, 0) if row is not None else 0)
+            resp.i64(self._get_value(need(args, 1)))
             return False
         if op in (b"INC", b"DEC"):
             key = need(args, 1)
@@ -217,9 +237,13 @@ class RepoPNCOUNT(_CounterRepo):
             new = (own.get(key, 0) + amount) & U64_MAX
             own[key] = new
             col = self._col_for(self._identity)
-            p = pend.setdefault(self._row_for(key), {})
+            row = self._row_for(key)
+            p = pend.setdefault(row, {})
             p[col] = max(p.get(col, 0), new)
             self._dirty.add(key)
+            # exact eager adjust, wrapped to the signed-64 read domain
+            signed = amount if op == b"INC" else -amount
+            self._values[row] = _wrap_i64(self._values.get(row, 0) + signed)
             resp.ok()
             return True
         raise ParseError()
@@ -233,6 +257,7 @@ class RepoPNCOUNT(_CounterRepo):
                 col = self._col_for(rid)
                 if v > p.get(col, 0):
                     p[col] = v
+        self._foreign.add(row)
 
     @timed_drain(
         "PNCOUNT",
@@ -261,6 +286,7 @@ class RepoPNCOUNT(_CounterRepo):
             self._values[row] = int(sums[i])
         self._pending_p.clear()
         self._pending_n.clear()
+        self._foreign.clear()
 
     def flush_deltas(self):
         out = []

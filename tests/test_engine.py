@@ -242,3 +242,32 @@ def test_many_keys_growth(db):
     assert run(db, "GCOUNT", "GET", "key99") == b":100\r\n"
     vals = [run(db, "GCOUNT", "GET", "key%d" % i) for i in range(0, 100, 17)]
     assert vals == [b":%d\r\n" % (i + 1) for i in range(0, 100, 17)]
+
+
+def test_counter_gets_skip_device_when_local_only(db):
+    """Read-your-writes host shadow: GETs after purely-local INC/DEC are
+    served from the exact host value cache with NO device drain; a foreign
+    delta makes exactly the next GET drain."""
+    from jylis_tpu.utils import metrics
+
+    metrics.counters.pop("GCOUNT", None)
+    for i in range(5):
+        run(db, "GCOUNT", "INC", "k", "3")
+        assert run(db, "GCOUNT", "GET", "k") == b":%d\r\n" % (3 * (i + 1))
+    assert metrics.counters["GCOUNT"]["batches"] == 0  # no drains
+
+    mgr = db.manager("GCOUNT")
+    mgr.repo.converge(b"k", {999: 100})
+    assert run(db, "GCOUNT", "GET", "k") == b":115\r\n"
+    assert metrics.counters["GCOUNT"]["batches"] == 1  # exactly one drain
+
+    # and PNCOUNT wraps its eager adjust into the signed read domain
+    run(db, "PNCOUNT", "DEC", "pk", "5")
+    assert run(db, "PNCOUNT", "GET", "pk") == b":-5\r\n"
+    # a DEC past the i64 boundary must wrap exactly like the device's
+    # modular bitcast read: -(2^63+5) -> 2^63-5
+    run(db, "PNCOUNT", "DEC", "pk2", str(2**63 + 5))
+    want = b":%d\r\n" % (2**63 - 5)
+    assert run(db, "PNCOUNT", "GET", "pk2") == want  # eager host path
+    db.manager("PNCOUNT").repo.converge(b"pk2", ({}, {}))  # force a drain
+    assert run(db, "PNCOUNT", "GET", "pk2") == want  # device path agrees
