@@ -177,10 +177,16 @@ def config_treg_1m() -> dict:
     @jax.jit
     def sweep(state, ki):
         def body(state, i):
-            ts = jax.random.bits(jax.random.key(i * 3), (K3,), jnp.uint32).astype(jnp.uint64)
-            rank = jax.random.bits(jax.random.key(i * 3 + 1), (K3,), jnp.uint32).astype(jnp.uint64)
-            vid = jax.random.randint(jax.random.key(i * 3 + 2), (K3,), 0, 1 << 31, jnp.int64)
-            st, _tie = treg.converge_batch(state, ki, ts, rank, vid)
+            def bits(j):
+                return jax.random.bits(jax.random.key(j), (K3,), jnp.uint32)
+
+            vid = jax.random.randint(
+                jax.random.key(i * 5 + 4), (K3,), 0, 1 << 30, jnp.int32
+            )
+            st, _tie = treg.converge_batch(
+                state, ki, bits(i * 5), bits(i * 5 + 1),
+                bits(i * 5 + 2), bits(i * 5 + 3), vid,
+            )
             return st, None
 
         state, _ = jax.lax.scan(body, state, jnp.arange(rounds, dtype=jnp.uint32))
@@ -188,10 +194,10 @@ def config_treg_1m() -> dict:
 
     state = treg.init(K3)
     s1 = sweep(state, ki)
-    _ = np.asarray(jax.device_get(s1.ts.ravel()[0:1]))
+    _ = np.asarray(jax.device_get(s1.ts_hi.ravel()[0:1]))
     t0 = time.perf_counter()
     s1 = sweep(state, ki)
-    _ = np.asarray(jax.device_get(s1.ts.ravel()[0:1]))
+    _ = np.asarray(jax.device_get(s1.ts_hi.ravel()[0:1]))
     dt = time.perf_counter() - t0
     dev = K3 * rounds / dt
 

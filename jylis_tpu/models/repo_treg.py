@@ -18,7 +18,7 @@ from functools import partial
 import jax
 import numpy as np
 
-from ..ops import treg
+from ..ops import planes, treg
 from ..ops.interner import Interner, prefix_rank
 from .base import ParseError, bucket, need, pad_rows, parse_u64
 from ..utils.metrics import timed_drain
@@ -28,9 +28,9 @@ TREG_HELP = RepoHelp("TREG", {"GET": "key", "SET": "key value timestamp"})
 
 
 @partial(jax.jit, donate_argnums=0)
-def _drain(state, ki, ts, rank, vid):
-    st, tie = treg.converge_batch(state, ki, ts, rank, vid)
-    return st, tie, st.ts[ki], st.vid[ki]
+def _drain(state, ki, ts_hi, ts_lo, rank_hi, rank_lo, vid):
+    st, tie = treg.converge_batch(state, ki, ts_hi, ts_lo, rank_hi, rank_lo, vid)
+    return st, tie, st.ts_hi[ki], st.ts_lo[ki], st.vid[ki]
 
 
 @partial(jax.jit, donate_argnums=0)
@@ -137,7 +137,7 @@ class RepoTREG:
         ki = pad_rows(b)
         d_ts = np.zeros(b, np.uint64)
         d_rank = np.zeros(b, np.uint64)
-        d_vid = np.full(b, -1, np.int64)
+        d_vid = np.full(b, -1, np.int32)
         values = []
         for i, row in enumerate(rows):
             ts, value = self._pending[row]
@@ -146,11 +146,13 @@ class RepoTREG:
             d_rank[i] = prefix_rank(value)
             d_vid[i] = self._interner.intern(value)
             values.append(value)
-        self._state, tie, out_ts, out_vid = _drain(
-            self._state, ki, d_ts, d_rank, d_vid
+        ts_hi, ts_lo = planes.split64_np(d_ts)
+        rank_hi, rank_lo = planes.split64_np(d_rank)
+        self._state, tie, out_ts_hi, out_ts_lo, out_vid = _drain(
+            self._state, ki, ts_hi, ts_lo, rank_hi, rank_lo, d_vid
         )
         tie = np.asarray(tie)
-        out_ts = np.asarray(out_ts)
+        out_ts = planes.combine64_np(np.asarray(out_ts_hi), np.asarray(out_ts_lo))
         out_vid = np.asarray(out_vid).copy()
         if tie[: len(rows)].any():
             # prefix collision: full-string compare decides; patch losers
@@ -164,7 +166,7 @@ class RepoTREG:
             if patch_ki:
                 pb = bucket(len(patch_ki))
                 pk = pad_rows(pb)  # distinct out-of-range pads drop
-                pv = np.full(pb, -1, np.int64)
+                pv = np.full(pb, -1, np.int32)
                 pk[: len(patch_ki)] = patch_ki
                 pv[: len(patch_vid)] = patch_vid
                 self._state = _patch_vids(self._state, pk, pv)

@@ -5,13 +5,15 @@ Semantics (docs/_docs/types/treg.md:56-63): a register keeps one
 timestamps are equal and value_A > value_B by string sorting rules.
 Reference repo: jylis/repo_treg.pony:24-68.
 
-TPU-native layout: the keyspace is three parallel vectors —
-``ts[key] : uint64``, ``rank[key] : uint64`` (order-preserving 8-byte value
-prefix, see ops/interner.py), and ``vid[key] : int64`` (interned value id,
--1 = unset). The value tie-break runs on-device via the rank; batches where
-ts and rank are equal but vids differ (a prefix collision) are flagged and
-resolved on host with full strings — correctness is exact, the device just
-fast-paths the overwhelmingly common case.
+TPU-native layout: the keyspace is parallel vectors — the u64 timestamp
+and the u64 order-preserving value-prefix rank (ops/interner.py) each
+stored as hi/lo u32 planes (XLA's u64 scatter emulation costs ~150 ms per
+1M indices regardless of row width — measured; u32 scatters are ~15x
+cheaper), plus ``vid[key] : int32`` (interned value id, -1 = unset). The
+value tie-break runs on-device via the rank; batches where ts and rank are
+equal but vids differ (a prefix collision) are flagged and resolved on
+host with full strings — correctness is exact, the device just fast-paths
+the overwhelmingly common case.
 
 Contract: one batch must contain at most one delta per key (the reference
 coalesces per-key deltas per flush window, repo_gcount.pony:43-48 pattern);
@@ -25,46 +27,65 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-UINT64 = jnp.uint64
+U32 = jnp.uint32
+I32 = jnp.int32
 
 
 class TRegState(NamedTuple):
-    ts: jax.Array  # (K,) uint64; 0 when unset
-    rank: jax.Array  # (K,) uint64 value-prefix rank; 0 when unset
-    vid: jax.Array  # (K,) int64 interned value id; -1 when unset
+    ts_hi: jax.Array  # (K,) uint32; 0 when unset
+    ts_lo: jax.Array
+    rank_hi: jax.Array  # (K,) uint32 value-prefix rank planes; 0 when unset
+    rank_lo: jax.Array
+    vid: jax.Array  # (K,) int32 interned value id; -1 when unset
 
 
 def init(num_keys: int) -> TRegState:
+    # distinct buffers: drains donate the state
     return TRegState(
-        jnp.zeros((num_keys,), UINT64),
-        jnp.zeros((num_keys,), UINT64),
-        jnp.full((num_keys,), -1, jnp.int64),
+        jnp.zeros((num_keys,), U32),
+        jnp.zeros((num_keys,), U32),
+        jnp.zeros((num_keys,), U32),
+        jnp.zeros((num_keys,), U32),
+        jnp.full((num_keys,), -1, I32),
     )
 
 
-def _b_wins(
-    ts_a: jax.Array, rank_a: jax.Array, vid_a: jax.Array,
-    ts_b: jax.Array, rank_b: jax.Array, vid_b: jax.Array,
-):
+def _gt64(a_hi, a_lo, b_hi, b_lo):
+    """a > b over hi/lo u32 planes."""
+    return (a_hi > b_hi) | ((a_hi == b_hi) & (a_lo > b_lo))
+
+
+def _eq64(a_hi, a_lo, b_hi, b_lo):
+    return (a_hi == b_hi) & (a_lo == b_lo)
+
+
+def _b_wins(a, b):
     """Where pair B strictly beats pair A, plus an on-host-tie flag.
 
-    An unset register (vid -1, ts 0, rank 0) loses to any set pair: a set
-    pair has either ts > 0 or a real value whose presence beats absence —
-    encoded by treating vid >= 0 as a final presence tie-break.
+    a/b: tuples (ts_hi, ts_lo, rank_hi, rank_lo, vid). An unset register
+    (vid -1, zeros) loses to any set pair: a set pair has either ts > 0 or
+    a real value whose presence beats absence — encoded by treating
+    vid >= 0 as a final presence tie-break.
     """
-    wins = (ts_b > ts_a) | (
-        (ts_b == ts_a)
-        & ((rank_b > rank_a) | ((rank_b == rank_a) & (vid_a < 0) & (vid_b >= 0)))
+    a_th, a_tl, a_rh, a_rl, a_v = a
+    b_th, b_tl, b_rh, b_rl, b_v = b
+    ts_eq = _eq64(a_th, a_tl, b_th, b_tl)
+    rank_eq = _eq64(a_rh, a_rl, b_rh, b_rl)
+    wins = _gt64(b_th, b_tl, a_th, a_tl) | (
+        ts_eq
+        & (_gt64(b_rh, b_rl, a_rh, a_rl) | (rank_eq & (a_v < 0) & (b_v >= 0)))
     )
-    tie = (ts_b == ts_a) & (rank_b == rank_a) & (vid_a >= 0) & (vid_b >= 0) & (vid_a != vid_b)
+    tie = ts_eq & rank_eq & (a_v >= 0) & (b_v >= 0) & (a_v != b_v)
     return wins, tie
 
 
 def converge_batch(
     state: TRegState,
     key_idx: jax.Array,
-    d_ts: jax.Array,
-    d_rank: jax.Array,
+    d_ts_hi: jax.Array,
+    d_ts_lo: jax.Array,
+    d_rank_hi: jax.Array,
+    d_rank_lo: jax.Array,
     d_vid: jax.Array,
 ) -> tuple[TRegState, jax.Array]:
     """Join one delta batch (unique keys): gather rows, compare, scatter.
@@ -72,18 +93,16 @@ def converge_batch(
     Returns (new_state, tie_mask); tie_mask (B,) bool marks rows whose
     winner must be decided on host by full string comparison.
     """
-    cur_ts = state.ts[key_idx]
-    cur_rank = state.rank[key_idx]
-    cur_vid = state.vid[key_idx]
-    wins, tie = _b_wins(cur_ts, cur_rank, cur_vid, d_ts, d_rank, d_vid)
-    new_ts = jnp.where(wins, d_ts, cur_ts)
-    new_rank = jnp.where(wins, d_rank, cur_rank)
-    new_vid = jnp.where(wins, d_vid, cur_vid)
+    cur = tuple(plane[key_idx] for plane in state)
+    d = (d_ts_hi, d_ts_lo, d_rank_hi, d_rank_lo, d_vid)
+    wins, tie = _b_wins(cur, d)
+    new = [jnp.where(wins, dv, cv) for dv, cv in zip(d, cur)]
     return (
         TRegState(
-            state.ts.at[key_idx].set(new_ts, mode="drop"),
-            state.rank.at[key_idx].set(new_rank, mode="drop"),
-            state.vid.at[key_idx].set(new_vid, mode="drop"),
+            *(
+                plane.at[key_idx].set(nv, mode="drop", unique_indices=True)
+                for plane, nv in zip(state, new)
+            )
         ),
         tie,
     )
@@ -92,8 +111,10 @@ def converge_batch(
 def converge_many(
     state: TRegState,
     key_idx: jax.Array,
-    d_ts: jax.Array,
-    d_rank: jax.Array,
+    d_ts_hi: jax.Array,
+    d_ts_lo: jax.Array,
+    d_rank_hi: jax.Array,
+    d_rank_lo: jax.Array,
     d_vid: jax.Array,
 ) -> tuple[TRegState, jax.Array]:
     """Fold several replica batches: inputs are (N, B)-shaped; scans over N.
@@ -103,35 +124,33 @@ def converge_many(
     """
 
     def step(st, batch):
-        ki, ts, rk, vd = batch
-        st, tie = converge_batch(st, ki, ts, rk, vd)
+        ki, th, tl, rh, rl, vd = batch
+        st, tie = converge_batch(st, ki, th, tl, rh, rl, vd)
         return st, tie
 
-    return jax.lax.scan(step, state, (key_idx, d_ts, d_rank, d_vid))
+    return jax.lax.scan(
+        step, state, (key_idx, d_ts_hi, d_ts_lo, d_rank_hi, d_rank_lo, d_vid)
+    )
 
 
-def set_batch(
-    state: TRegState,
-    key_idx: jax.Array,
-    ts: jax.Array,
-    rank: jax.Array,
-    vid: jax.Array,
-) -> tuple[TRegState, jax.Array]:
+def set_batch(state, key_idx, ts_hi, ts_lo, rank_hi, rank_lo, vid):
     """Local SET is lattice-identical to converging a delta (LWW join)."""
-    return converge_batch(state, key_idx, ts, rank, vid)
+    return converge_batch(state, key_idx, ts_hi, ts_lo, rank_hi, rank_lo, vid)
 
 
 def read(state: TRegState, key_idx: jax.Array):
-    """GET for a batch of keys -> (ts, vid); vid -1 means nil reply."""
-    return state.ts[key_idx], state.vid[key_idx]
+    """GET for a batch of keys -> (ts_hi, ts_lo, vid); vid -1 = nil reply."""
+    return state.ts_hi[key_idx], state.ts_lo[key_idx], state.vid[key_idx]
 
 
 def grow(state: TRegState, num_keys: int) -> TRegState:
-    k = state.ts.shape[0]
+    k = state.vid.shape[0]
     if num_keys == k:
         return state
     return TRegState(
-        jnp.zeros((num_keys,), UINT64).at[:k].set(state.ts),
-        jnp.zeros((num_keys,), UINT64).at[:k].set(state.rank),
-        jnp.full((num_keys,), -1, jnp.int64).at[:k].set(state.vid),
+        jnp.zeros((num_keys,), U32).at[:k].set(state.ts_hi),
+        jnp.zeros((num_keys,), U32).at[:k].set(state.ts_lo),
+        jnp.zeros((num_keys,), U32).at[:k].set(state.rank_hi),
+        jnp.zeros((num_keys,), U32).at[:k].set(state.rank_lo),
+        jnp.full((num_keys,), -1, I32).at[:k].set(state.vid),
     )

@@ -2,17 +2,27 @@
 
 Semantics oracle: docs/_docs/types/treg.md:56-63 via hostref.TReg. Exercises
 the rank-prefix tie-break and the host tie-resolution contract (prefix
-collisions surface as a tie mask, never as a wrong silent winner).
+collisions surface as a tie mask, never as a wrong silent winner). The
+kernel stores ts/rank as hi/lo u32 planes (ops/planes.py), so tests split
+u64 inputs the same way the repo layer does.
 """
 
 import numpy as np
 import pytest
 
 import jylis_tpu  # noqa: F401
-from jylis_tpu.ops import treg, hostref
+from jylis_tpu.ops import hostref, planes, treg
 from jylis_tpu.ops.interner import Interner, prefix_rank
 
 K = 32
+
+
+def get_ts(state, k) -> int:
+    return int(
+        planes.combine64_np(
+            np.asarray(state.ts_hi[k]), np.asarray(state.ts_lo[k])
+        )
+    )
 
 
 def test_prefix_rank_order_preserving():
@@ -26,31 +36,31 @@ def test_prefix_rank_order_preserving():
                 assert x > y
 
 
+def split_batch(d_ts, d_rank):
+    th, tl = planes.split64_np(d_ts)
+    rh, rl = planes.split64_np(d_rank)
+    return th, tl, rh, rl
+
+
 def apply_ops(state, interner, ops):
     """ops: list of (key, value, ts). Applies one batch per op (unique-key
     contract trivially satisfied); resolves tie rows on host like the repo
     layer does."""
-    values = {}  # vid -> bytes, for tie resolution
     for key, value, ts in ops:
         vid = interner.intern(value)
-        values[vid] = value
         ki = np.array([key], dtype=np.int32)
         d_ts = np.array([ts], dtype=np.uint64)
         d_rank = np.array([prefix_rank(value)], dtype=np.uint64)
-        d_vid = np.array([vid], dtype=np.int64)
+        d_vid = np.array([vid], dtype=np.int32)
         prev_vid = int(np.asarray(state.vid[ki])[0])
-        state, tie = treg.set_batch(state, ki, d_ts, d_rank, d_vid)
+        state, tie = treg.set_batch(
+            state, ki, *split_batch(d_ts, d_rank), d_vid
+        )
         if bool(np.asarray(tie)[0]):
             # host resolves: full string comparison decides the winner
             cur = interner.lookup(prev_vid)
-            if value > cur:
-                state = treg.TRegState(
-                    state.ts, state.rank, state.vid.at[ki].set(d_vid)
-                )
-            else:
-                state = treg.TRegState(
-                    state.ts, state.rank, state.vid.at[ki].set(prev_vid)
-                )
+            winner = d_vid if value > cur else np.array([prev_vid], np.int32)
+            state = state._replace(vid=state.vid.at[ki].set(winner))
     return state
 
 
@@ -73,14 +83,23 @@ def test_treg_matches_hostref(seed):
     state = apply_ops(state, interner, ops)
 
     for k in range(K):
-        got_ts = int(np.asarray(state.ts[k]))
         got_vid = int(np.asarray(state.vid[k]))
         want = refs[k].read()
         if want is None:
             assert got_vid == -1
         else:
             assert got_vid >= 0
-            assert (interner.lookup(got_vid), got_ts) == want
+            assert (interner.lookup(got_vid), get_ts(state, k)) == want
+
+
+def test_treg_ts_across_u32_boundary():
+    """Timestamps straddling 2^32 must compare by the full 64-bit value."""
+    interner = Interner()
+    state = treg.init(2)
+    big, small = (1 << 32) + 7, (1 << 32) - 1
+    state = apply_ops(state, interner, [(0, b"old", big), (0, b"new", small)])
+    assert interner.lookup(int(np.asarray(state.vid[0]))) == b"old"
+    assert get_ts(state, 0) == big
 
 
 def test_treg_unset_loses_to_zero_ts_write():
@@ -92,12 +111,12 @@ def test_treg_unset_loses_to_zero_ts_write():
 
 
 def test_treg_converge_many_scan():
-    """64 replica batches folded in one compiled scan must equal sequential."""
+    """Replica batches folded in one compiled scan must equal sequential."""
     rng = np.random.default_rng(9)
     interner = Interner()
     n_batches, B = 8, 16
     vals = [bytes([97 + i]) for i in range(26)]
-    vids = np.array([interner.intern(v) for v in vals], dtype=np.int64)
+    vids = np.array([interner.intern(v) for v in vals], dtype=np.int32)
     ranks = np.array([prefix_rank(v) for v in vals], dtype=np.uint64)
 
     ki = rng.integers(0, K, size=(n_batches, B)).astype(np.int32)
@@ -108,11 +127,16 @@ def test_treg_converge_many_scan():
     d_ts = rng.integers(0, 1000, size=(n_batches, B)).astype(np.uint64)
     d_vid = vids[pick]
     d_rank = ranks[pick]
+    th, tl, rh, rl = split_batch(d_ts, d_rank)
 
     seq = treg.init(K)
     for i in range(n_batches):
-        seq, _ = treg.converge_batch(seq, ki[i], d_ts[i], d_rank[i], d_vid[i])
+        seq, _ = treg.converge_batch(
+            seq, ki[i], th[i], tl[i], rh[i], rl[i], d_vid[i]
+        )
 
-    scanned, _ = treg.converge_many(treg.init(K), ki, d_ts, d_rank, d_vid)
-    np.testing.assert_array_equal(np.asarray(seq.ts), np.asarray(scanned.ts))
-    np.testing.assert_array_equal(np.asarray(seq.vid), np.asarray(scanned.vid))
+    scanned, _ = treg.converge_many(treg.init(K), ki, th, tl, rh, rl, d_vid)
+    for plane in ("ts_hi", "ts_lo", "vid"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(seq, plane)), np.asarray(getattr(scanned, plane))
+        )
