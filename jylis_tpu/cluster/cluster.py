@@ -13,7 +13,7 @@ Reference analog: cluster.pony:4-265 — the whole distributed backend:
 * **Self-healing names:** any gossiped address with my host:port but a
   different name is permanently blacklisted via P2Set removal
   (cluster.pony:215-230).
-* **Failure detection:** per-connection activity tick; conns idle >= 10
+* **Failure detection:** per-connection activity tick; conns idle > 10
   ticks are closed (cluster.pony:118-121); dropped actives are re-dialed on
   the next sync (cluster.pony:92-99), dropped passives are forgotten.
 * **Anti-entropy:** every tick ``database.flush_deltas(broadcast_deltas)``;
@@ -148,7 +148,7 @@ class Cluster:
 
     def _evict_idle(self) -> None:
         for conn, last in list(self._last_activity.items()):
-            if self._tick - last >= IDLE_TICKS_LIMIT:
+            if self._tick - last > IDLE_TICKS_LIMIT:
                 self._log.info() and self._log.i("evicting idle connection")
                 self._drop(conn)
 
@@ -313,11 +313,22 @@ class Cluster:
         if not self._send_to_actives(data):
             # nobody reachable right now (maybe nobody known yet): hold
             # instead of losing, so a late-joining peer still converges on
-            # pre-join writes up to the cap
-            self._held.append(data)
-            del self._held[: -self._held_cap]
+            # pre-join writes up to the cap. Empty SYSTEM keepalive frames
+            # (deltas_size()==1 quirk) carry nothing and would FIFO-evict
+            # real pre-join writes on a long-solo node — don't hold those.
+            if self._worth_holding(name, batch):
+                self._held.append(data)
+                del self._held[: -self._held_cap]
             return
         self._flush_held()
+
+    @staticmethod
+    def _worth_holding(name: str, batch) -> bool:
+        if not batch:
+            return False
+        if name == "SYSTEM":
+            return any(entries or cutoff for _, (entries, cutoff) in batch)
+        return True
 
     def _send_to_actives(self, data: bytes) -> bool:
         """Write one pre-framed message to every established active conn;

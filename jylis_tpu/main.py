@@ -54,20 +54,27 @@ class Dispose:
         if self._disposing:
             return
         self._disposing = True
-        self._database.clean_shutdown()  # final flush rides broadcast_deltas
-        if self._snapshot_path:
-            try:
-                persist.save_snapshot(self._database, self._snapshot_path)
-            except OSError as e:
-                if self._log is not None:
-                    self._log.err() and self._log.e(f"snapshot failed: {e}")
-        # after the final drains (snapshot dump included) so the report
-        # covers them and no profiler trace restarts behind our back
-        if self._log is not None:
-            self._log.info() and self._log.i(f"merge metrics: {metrics.report()}")
-        metrics.stop_profiling()
-        self._cluster.dispose()
-        asyncio.get_running_loop().create_task(self._finish())
+        # device drains can raise at shutdown; the listeners must still stop
+        # and `done` must still be set, or a second SIGINT would no-op
+        # (_disposing already True) and the process would only die to SIGKILL
+        try:
+            self._database.clean_shutdown()  # final flush rides broadcast_deltas
+            if self._snapshot_path:
+                try:
+                    persist.save_snapshot(self._database, self._snapshot_path)
+                except Exception as e:
+                    if self._log is not None:
+                        self._log.err() and self._log.e(f"snapshot failed: {e}")
+            # after the final drains (snapshot dump included) so the report
+            # covers them and no profiler trace restarts behind our back
+            if self._log is not None:
+                self._log.info() and self._log.i(
+                    f"merge metrics: {metrics.report()}"
+                )
+            metrics.stop_profiling()
+        finally:
+            self._cluster.dispose()
+            asyncio.get_running_loop().create_task(self._finish())
 
     async def _finish(self) -> None:
         await self._server.dispose()

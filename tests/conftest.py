@@ -1,20 +1,12 @@
 """Test harness: force an 8-device virtual CPU platform BEFORE jax inits.
 
 The surrounding environment pins JAX_PLATFORMS=axon (the tunneled real TPU);
-for tests we override via jax.config, which wins over the env var, so the
-suite runs hermetically on a virtual 8-device CPU mesh — mirroring how the
-driver's dryrun_multichip check runs. Real-TPU runs happen only in bench.py.
+the shared helper overrides via jax.config, which wins over the env var, so
+the suite runs hermetically on a virtual 8-device CPU mesh — mirroring how
+the driver's dryrun_multichip check runs. Real-TPU runs happen only in
+bench.py.
 """
 
-import os
+from jylis_tpu.utils.vcpu import force_virtual_cpu
 
-_flags = os.environ.get("XLA_FLAGS", "")
-if "--xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+force_virtual_cpu(8)

@@ -262,6 +262,56 @@ def test_system_log_replicates(three_nodes):
     asyncio.run(main())
 
 
+def test_worth_holding_filters_empty_system_keepalives():
+    """Empty SYSTEM keepalive frames (the deltas_size()==1 quirk) must not
+    enter the held-delta buffer, or a long-solo node FIFO-evicts real
+    pre-join writes with empty frames."""
+    wh = Cluster._worth_holding
+    assert not wh("SYSTEM", [])
+    assert not wh("SYSTEM", [(b"_log", ([], 0))])
+    assert wh("SYSTEM", [(b"_log", ([(b"line", 5)], 0))])
+    assert wh("SYSTEM", [(b"_log", ([], 7))])  # a cutoff is joinable state
+    assert wh("GCOUNT", [(b"k", object())])
+
+
+def test_solo_node_holds_real_deltas_not_keepalives():
+    async def main():
+        (port,) = grab_ports(1)
+        foo = Node("foo", port)
+        await foo.start()
+        try:
+            # no peers: an empty SYSTEM frame is dropped, a real one is held
+            foo.cluster.broadcast_deltas(("SYSTEM", [(b"_log", ([], 0))]))
+            assert foo.cluster._held == []
+            foo.cluster.broadcast_deltas(
+                ("SYSTEM", [(b"_log", ([(b"pre-join line", 5)], 0))])
+            )
+            assert len(foo.cluster._held) == 1
+        finally:
+            await foo.stop()
+
+    asyncio.run(main())
+
+
+def test_idle_eviction_boundary():
+    """Eviction fires after MORE than IDLE_TICKS_LIMIT idle ticks, matching
+    the reference's `(last_tick + 10) < _tick` (cluster.pony:118-121)."""
+    from jylis_tpu.cluster.cluster import IDLE_TICKS_LIMIT, _Conn
+
+    node = Node("solo", grab_ports(1)[0])
+    cl = node.cluster
+    conn = _Conn(writer=None, active_addr=None)
+    cl._passives.add(conn)
+    cl._last_activity[conn] = cl._tick
+    cl._tick += IDLE_TICKS_LIMIT  # idle exactly the limit: keep
+    cl._evict_idle()
+    assert conn in cl._passives
+    cl._tick += 1  # one past the limit: evict
+    cl._evict_idle()
+    assert conn not in cl._passives
+    assert conn not in cl._last_activity
+
+
 def test_stale_name_blacklisted():
     """An address gossiped with my host:port but another name is permanently
     removed (cluster.pony:215-230)."""
