@@ -5,8 +5,10 @@ insertion). Here the keyspace is the padded ops/tlog block; local INS and
 incoming delta logs coalesce host-side per key and drain as one vmap'd
 merge kernel call. TRIM/TRIMAT/CLR are batched device ops whose returned
 (length, cutoff) pairs maintain the host serving cache, so SIZE/CUTOFF are
-host lookups; GET gathers the one requested row and renders with full
-strings (exact documented ordering even on rank-prefix collisions).
+host lookups; GET serves from a per-row rendered host cache (exact
+documented ordering even on rank-prefix collisions), rebuilt by a one-row
+device gather only on the first read after a merge or trim touches the
+row — a quiescent GET performs zero device calls.
 
 Delta wire shape: (entries: list[(value: bytes, ts: u64)], cutoff: u64).
 """
@@ -67,6 +69,11 @@ class RepoTLOG:
         self._interner = Interner()
         self._len_cache: dict[int, int] = {}  # row -> length
         self._cut_cache: dict[int, int] = {}  # row -> cutoff
+        # row -> desc-sorted [(ts, value)], the rendered GET view; built on
+        # first read, dropped whenever a drain or trim touches the row — so
+        # quiescent GETs never dispatch to the device (the counter repos'
+        # host-shadow pattern, repo_counters.py)
+        self._render: dict[int, list[tuple[int, bytes]]] = {}
         # row -> (entries [(ts, value)], incoming-delta cutoff)
         self._pend_entries: dict[int, list[tuple[int, bytes]]] = {}
         self._pend_cutoff: dict[int, int] = {}
@@ -136,16 +143,19 @@ class RepoTLOG:
         if row is None:
             resp.array_start(0)
             return
-        length = self._len_cache.get(row, 0)
-        ts_row, vid_row = _get_row(self._state, row)
-        ts_row = np.asarray(ts_row)
-        vid_row = np.asarray(vid_row)
-        ents = [
-            (int(ts_row[i]), self._interner.lookup(int(vid_row[i])))
-            for i in range(length)
-        ]
-        ents.sort(key=lambda e: (e[0], e[1]), reverse=True)
-        n = min(count, length)
+        ents = self._render.get(row)
+        if ents is None:
+            length = self._len_cache.get(row, 0)
+            ts_row, vid_row = _get_row(self._state, row)
+            ts_row = np.asarray(ts_row)
+            vid_row = np.asarray(vid_row)
+            ents = [
+                (int(ts_row[i]), self._interner.lookup(int(vid_row[i])))
+                for i in range(length)
+            ]
+            ents.sort(key=lambda e: (e[0], e[1]), reverse=True)
+            self._render[row] = ents
+        n = min(count, len(ents))
         resp.array_start(n)
         for ts, value in ents[:n]:
             resp.array_start(2)
@@ -175,6 +185,7 @@ class RepoTLOG:
         ki[0] = row
         counts[0] = count
         self._state, lens, cuts = _trim(self._state, ki, counts)
+        self._render.pop(row, None)
         self._len_cache[row] = int(np.asarray(lens)[0])
         self._cut_cache[row] = int(np.asarray(cuts)[0])
         self._delta_for(key).raise_cutoff(self._cut_cache[row])
@@ -273,6 +284,7 @@ class RepoTLOG:
             lens = np.asarray(lens)
             cuts = np.asarray(cuts)
             for i, row in enumerate(rows):
+                self._render.pop(row, None)
                 self._len_cache[row] = int(lens[i])
                 self._cut_cache[row] = int(cuts[i])
             self._pend_entries.clear()

@@ -312,6 +312,150 @@ def test_idle_eviction_boundary():
     assert conn not in cl._last_activity
 
 
+def test_active_redialed_after_drop(three_nodes):
+    """A dropped active connection's address stays known, so the next
+    heartbeat's sync re-dials it (cluster.pony:92-99)."""
+
+    async def main():
+        foo, bar, baz = await three_nodes()
+        try:
+            assert await converge_wait(lambda: meshed(foo, bar, baz))
+            bar_addr = bar.config.addr
+            dropped = foo.cluster._actives[bar_addr]
+            foo.cluster._drop(dropped)
+            assert bar_addr not in foo.cluster._actives
+
+            def redialed():
+                conn = foo.cluster._actives.get(bar_addr)
+                return (
+                    conn is not None
+                    and conn is not dropped
+                    and conn.established
+                )
+
+            assert await converge_wait(redialed)
+        finally:
+            for n in (foo, bar, baz):
+                await n.stop()
+
+    asyncio.run(main())
+
+
+def test_handshake_signature_mismatch_drops_connection():
+    """A peer presenting the wrong schema signature is dropped before any
+    message exchange (cluster_notify.pony:37-61: auth failure)."""
+
+    async def main():
+        from jylis_tpu.cluster.framing import frame
+
+        (port,) = grab_ports(1)
+        foo = Node("foo", port)
+        await foo.start()
+        try:
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            writer.write(frame(b"x" * 32))  # wrong signature, right shape
+            await writer.drain()
+            got = await asyncio.wait_for(reader.read(1 << 16), timeout=2.0)
+            assert got == b""  # peer closed without establishing
+            writer.close()
+            assert await converge_wait(lambda: not foo.cluster._passives)
+        finally:
+            await foo.stop()
+
+    asyncio.run(main())
+
+
+def test_held_deltas_reach_late_joiner():
+    """Writes made while a node is ALONE are held (bounded) and delivered
+    once the first peer joins — strictly better than the reference, which
+    loses them (SURVEY.md §2.5 'known gap')."""
+
+    async def main():
+        p_foo, p_bar = grab_ports(2)
+        foo = Node("foo", p_foo)
+        await foo.start()
+        try:
+            # write while solo: the proactive flush finds zero peers
+            got = await resp_call(
+                foo.server.port,
+                b"*4\r\n$6\r\nGCOUNT\r\n$3\r\nINC\r\n$4\r\npre1\r\n$1\r\n7\r\n",
+            )
+            assert got == b"+OK\r\n"
+            # let heartbeats flush the repo into the held buffer
+            assert await converge_wait(lambda: len(foo.cluster._held) > 0)
+
+            bar = Node("bar", p_bar, seeds=[foo.config.addr])
+            await bar.start()
+            try:
+                async def bar_sees_pre_join_write():
+                    out = await resp_call(
+                        bar.server.port,
+                        b"*3\r\n$6\r\nGCOUNT\r\n$3\r\nGET\r\n$4\r\npre1\r\n",
+                    )
+                    return out == b":7\r\n"
+
+                deadline = asyncio.get_event_loop().time() + 60 * TICK
+                ok = False
+                while asyncio.get_event_loop().time() < deadline:
+                    if await bar_sees_pre_join_write():
+                        ok = True
+                        break
+                    await asyncio.sleep(TICK)
+                assert ok
+                assert foo.cluster._held == []  # buffer fully flushed
+            finally:
+                await bar.stop()
+        finally:
+            await foo.stop()
+
+    asyncio.run(main())
+
+
+def test_backpressured_connection_dropped_on_broadcast():
+    """A peer whose transport write buffer exceeds the cap is treated as
+    dead: the broadcast drops it instead of buffering without bound."""
+
+    from jylis_tpu.cluster.cluster import _Conn
+
+    class FakeTransport:
+        def __init__(self, buffered: int):
+            self.buffered = buffered
+
+        def is_closing(self):
+            return False
+
+        def get_write_buffer_size(self):
+            return self.buffered
+
+    class FakeWriter:
+        def __init__(self, buffered: int):
+            self.transport = FakeTransport(buffered)
+            self.wrote = b""
+            self.closed = False
+
+        def write(self, data):
+            self.wrote += data
+
+        def close(self):
+            self.closed = True
+
+    node = Node("solo", grab_ports(1)[0])
+    cl = node.cluster
+    slow_addr = Address("127.0.0.1", "1", "slow")
+    ok_addr = Address("127.0.0.1", "2", "ok")
+    slow = _Conn(FakeWriter(_Conn.WRITE_BUFFER_LIMIT + 1), slow_addr)
+    ok = _Conn(FakeWriter(0), ok_addr)
+    slow.established = ok.established = True
+    cl._actives[slow_addr] = slow
+    cl._actives[ok_addr] = ok
+    cl.broadcast_deltas(("GCOUNT", [(b"k", {1: 5})]))
+    assert slow_addr not in cl._actives  # backpressured conn dropped
+    assert slow.writer.closed
+    assert ok_addr in cl._actives  # healthy conn delivered
+    assert ok.writer.wrote != b""
+    assert cl._held == []  # delivery succeeded, nothing held
+
+
 def test_stale_name_blacklisted():
     """An address gossiped with my host:port but another name is permanently
     removed (cluster.pony:215-230)."""
