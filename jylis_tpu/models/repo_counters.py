@@ -45,6 +45,26 @@ def _drain_pn(state, ki, dp_hi, dp_lo, dn_hi, dn_lo):
     return st, pncount.read(st, ki)
 
 
+# dense drains: when a batch covers most of the keyspace (a full
+# anti-entropy sweep), an elementwise join streams each plane once instead
+# of paying random-access gathers + scatters twice per plane
+@partial(jax.jit, donate_argnums=0)
+def _drain_g_dense(state, d_hi, d_lo):
+    st = gcount.join(state, gcount.GCountState(d_hi, d_lo))
+    return st, gcount.read_all(st)
+
+
+@partial(jax.jit, donate_argnums=0)
+def _drain_pn_dense(state, dp_hi, dp_lo, dn_hi, dn_lo):
+    st = pncount.join(state, pncount.PNCountState(dp_hi, dp_lo, dn_hi, dn_lo))
+    return st, pncount.read_all(st)
+
+
+# a batch covering >= 1/DENSE_FRACTION of the keyspace drains dense: the
+# sparse composite's random accesses cost far more per row than streaming
+DENSE_FRACTION = 4
+
+
 def _wrap_i64(v: int) -> int:
     """Wrap into signed-64 range (the reference's modular (p-n).i64())."""
     return ((v + (1 << 63)) & U64_MAX) - (1 << 63)
@@ -155,18 +175,29 @@ class RepoGCOUNT(_CounterRepo):
             return
         self._grow_to_fit()
         rows = list(self._pending)  # dict keys: unique, as converge requires
-        b = bucket(len(rows))
-        ki = pad_rows(b)
-        ki[: len(rows)] = rows
-        deltas = np.zeros((b, self._rep_cap), np.uint64)
-        for i, row in enumerate(rows):
-            for col, v in self._pending[row].items():
-                deltas[i, col] = v
-        d_hi, d_lo = planes.split64_np(deltas)
-        self._state, sums = _drain_g(self._state, ki, d_hi, d_lo)
-        sums = np.asarray(sums)
-        for i, row in enumerate(rows):
-            self._values[row] = int(sums[i])
+        if len(rows) * DENSE_FRACTION >= self._key_cap:
+            dense = np.zeros((self._key_cap, self._rep_cap), np.uint64)
+            for row in rows:
+                for col, v in self._pending[row].items():
+                    dense[row, col] = v
+            d_hi, d_lo = planes.split64_np(dense)
+            self._state, sums = _drain_g_dense(self._state, d_hi, d_lo)
+            sums = np.asarray(sums)
+            for row in rows:
+                self._values[row] = int(sums[row])
+        else:
+            b = bucket(len(rows))
+            ki = pad_rows(b)
+            ki[: len(rows)] = rows
+            deltas = np.zeros((b, self._rep_cap), np.uint64)
+            for i, row in enumerate(rows):
+                for col, v in self._pending[row].items():
+                    deltas[i, col] = v
+            d_hi, d_lo = planes.split64_np(deltas)
+            self._state, sums = _drain_g(self._state, ki, d_hi, d_lo)
+            sums = np.asarray(sums)
+            for i, row in enumerate(rows):
+                self._values[row] = int(sums[i])
         self._pending.clear()
         self._foreign.clear()
 
@@ -268,22 +299,41 @@ class RepoPNCOUNT(_CounterRepo):
             return
         self._grow_to_fit()
         rows = sorted(set(self._pending_p) | set(self._pending_n))
-        b = bucket(len(rows))
-        ki = pad_rows(b)
-        ki[: len(rows)] = rows
-        dp = np.zeros((b, self._rep_cap), np.uint64)
-        dn = np.zeros((b, self._rep_cap), np.uint64)
-        for i, row in enumerate(rows):
-            for col, v in self._pending_p.get(row, {}).items():
-                dp[i, col] = v
-            for col, v in self._pending_n.get(row, {}).items():
-                dn[i, col] = v
-        dp_hi, dp_lo = planes.split64_np(dp)
-        dn_hi, dn_lo = planes.split64_np(dn)
-        self._state, sums = _drain_pn(self._state, ki, dp_hi, dp_lo, dn_hi, dn_lo)
-        sums = np.asarray(sums)
-        for i, row in enumerate(rows):
-            self._values[row] = int(sums[i])
+        if len(rows) * DENSE_FRACTION >= self._key_cap:
+            dp = np.zeros((self._key_cap, self._rep_cap), np.uint64)
+            dn = np.zeros((self._key_cap, self._rep_cap), np.uint64)
+            for row in rows:
+                for col, v in self._pending_p.get(row, {}).items():
+                    dp[row, col] = v
+                for col, v in self._pending_n.get(row, {}).items():
+                    dn[row, col] = v
+            dp_hi, dp_lo = planes.split64_np(dp)
+            dn_hi, dn_lo = planes.split64_np(dn)
+            self._state, sums = _drain_pn_dense(
+                self._state, dp_hi, dp_lo, dn_hi, dn_lo
+            )
+            sums = np.asarray(sums)
+            for row in rows:
+                self._values[row] = int(sums[row])
+        else:
+            b = bucket(len(rows))
+            ki = pad_rows(b)
+            ki[: len(rows)] = rows
+            dp = np.zeros((b, self._rep_cap), np.uint64)
+            dn = np.zeros((b, self._rep_cap), np.uint64)
+            for i, row in enumerate(rows):
+                for col, v in self._pending_p.get(row, {}).items():
+                    dp[i, col] = v
+                for col, v in self._pending_n.get(row, {}).items():
+                    dn[i, col] = v
+            dp_hi, dp_lo = planes.split64_np(dp)
+            dn_hi, dn_lo = planes.split64_np(dn)
+            self._state, sums = _drain_pn(
+                self._state, ki, dp_hi, dp_lo, dn_hi, dn_lo
+            )
+            sums = np.asarray(sums)
+            for i, row in enumerate(rows):
+                self._values[row] = int(sums[i])
         self._pending_p.clear()
         self._pending_n.clear()
         self._foreign.clear()

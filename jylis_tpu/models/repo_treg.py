@@ -34,8 +34,19 @@ def _drain(state, ki, ts_hi, ts_lo, rank_hi, rank_lo, vid):
 
 
 @partial(jax.jit, donate_argnums=0)
+def _drain_dense(state, ts_hi, ts_lo, rank_hi, rank_lo, vid):
+    st, tie = treg.converge_dense(state, ts_hi, ts_lo, rank_hi, rank_lo, vid)
+    return st, tie, st.ts_hi, st.ts_lo, st.vid
+
+
+@partial(jax.jit, donate_argnums=0)
 def _patch_vids(state, ki, vids):
     return state._replace(vid=state.vid.at[ki].set(vids, mode="drop"))
+
+
+# a batch covering >= 1/DENSE_FRACTION of the keyspace drains through the
+# elementwise dense join (each plane streamed once, no random access)
+DENSE_FRACTION = 4
 
 
 class RepoTREG:
@@ -133,36 +144,47 @@ class RepoTREG:
             self._key_cap = cap
             self._state = treg.grow(self._state, cap)
         rows = list(self._pending)
-        b = bucket(len(rows))
+        dense = len(rows) * DENSE_FRACTION >= self._key_cap
+        b = self._key_cap if dense else bucket(len(rows))
         ki = pad_rows(b)
         d_ts = np.zeros(b, np.uint64)
         d_rank = np.zeros(b, np.uint64)
         d_vid = np.full(b, -1, np.int32)
-        values = []
+        values: dict[int, bytes] = {}  # batch slot -> full delta string
         for i, row in enumerate(rows):
             ts, value = self._pending[row]
+            slot = row if dense else i
             ki[i] = row
-            d_ts[i] = ts
-            d_rank[i] = prefix_rank(value)
-            d_vid[i] = self._interner.intern(value)
-            values.append(value)
+            d_ts[slot] = ts
+            d_rank[slot] = prefix_rank(value)
+            d_vid[slot] = self._interner.intern(value)
+            values[slot] = value
         ts_hi, ts_lo = planes.split64_np(d_ts)
         rank_hi, rank_lo = planes.split64_np(d_rank)
-        self._state, tie, out_ts_hi, out_ts_lo, out_vid = _drain(
-            self._state, ki, ts_hi, ts_lo, rank_hi, rank_lo, d_vid
-        )
+        if dense:
+            self._state, tie, out_ts_hi, out_ts_lo, out_vid = _drain_dense(
+                self._state, ts_hi, ts_lo, rank_hi, rank_lo, d_vid
+            )
+            slots = rows  # outputs are in dense key order
+        else:
+            self._state, tie, out_ts_hi, out_ts_lo, out_vid = _drain(
+                self._state, ki, ts_hi, ts_lo, rank_hi, rank_lo, d_vid
+            )
+            slots = list(range(len(rows)))
         tie = np.asarray(tie)
         out_ts = planes.combine64_np(np.asarray(out_ts_hi), np.asarray(out_ts_lo))
         out_vid = np.asarray(out_vid).copy()
-        if tie[: len(rows)].any():
+        if tie[slots].any():
             # prefix collision: full-string compare decides; patch losers
             patch_ki, patch_vid = [], []
-            for i in np.nonzero(tie[: len(rows)])[0]:
-                cur_val = self._interner.lookup(int(out_vid[i]))
-                if values[i] > cur_val:
-                    patch_ki.append(rows[i])
-                    patch_vid.append(int(d_vid[i]))
-                    out_vid[i] = d_vid[i]
+            for row, slot in zip(rows, slots):
+                if not tie[slot]:
+                    continue
+                cur_val = self._interner.lookup(int(out_vid[slot]))
+                if values[slot] > cur_val:
+                    patch_ki.append(row)
+                    patch_vid.append(int(d_vid[slot]))
+                    out_vid[slot] = d_vid[slot]
             if patch_ki:
                 pb = bucket(len(patch_ki))
                 pk = pad_rows(pb)  # distinct out-of-range pads drop
@@ -170,6 +192,6 @@ class RepoTREG:
                 pk[: len(patch_ki)] = patch_ki
                 pv[: len(patch_vid)] = patch_vid
                 self._state = _patch_vids(self._state, pk, pv)
-        for i, row in enumerate(rows):
-            self._cache[row] = (int(out_ts[i]), int(out_vid[i]))
+        for row, slot in zip(rows, slots):
+            self._cache[row] = (int(out_ts[slot]), int(out_vid[slot]))
         self._pending.clear()

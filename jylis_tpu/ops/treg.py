@@ -108,6 +108,33 @@ def converge_batch(
     )
 
 
+def converge_dense(
+    state: TRegState,
+    d_ts_hi: jax.Array,
+    d_ts_lo: jax.Array,
+    d_rank_hi: jax.Array,
+    d_rank_lo: jax.Array,
+    d_vid: jax.Array,
+) -> tuple[TRegState, jax.Array]:
+    """Full-keyspace elementwise LWW join — the dense fast path.
+
+    The delta arrays are in dense key order ((K,) each, same length as the
+    state); rows with no delta carry the lattice identity (0, 0, 0, 0, -1),
+    which never wins and never ties. No gather, no scatter: when a batch
+    covers most of the keyspace (a full anti-entropy sweep — the
+    BASELINE.json north-star shape), this streams each plane exactly once
+    instead of paying random-access gathers and scatters twice per plane.
+
+    Returns (new_state, tie_mask (K,)).
+    """
+    d = (d_ts_hi, d_ts_lo, d_rank_hi, d_rank_lo, d_vid)
+    wins, tie = _b_wins(tuple(state), d)
+    return (
+        TRegState(*(jnp.where(wins, dv, cv) for dv, cv in zip(d, state))),
+        tie,
+    )
+
+
 def converge_many(
     state: TRegState,
     key_idx: jax.Array,

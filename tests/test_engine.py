@@ -164,6 +164,52 @@ def test_tlog_render_cache_invalidated_by_merge(db):
     assert run(db, "TLOG", "GET", "chat") == b"*1\r\n*2\r\n$3\r\ntwo\r\n:200\r\n"
 
 
+def test_dense_drain_equivalence():
+    """A small-capacity repo (batch covers >=1/4 of the keyspace -> dense
+    elementwise drain) must serve identical values to a large-capacity one
+    (sparse scatter drain) on the same operations."""
+    from jylis_tpu.models.repo_counters import RepoGCOUNT, RepoPNCOUNT
+    from jylis_tpu.models.repo_treg import RepoTREG
+
+    rng = np.random.default_rng(3)
+    keys = [b"k%d" % i for i in range(12)]
+    decs = {k: int(rng.integers(1, 4)) for k in keys}
+
+    for cls in (RepoGCOUNT, RepoPNCOUNT):
+        small = cls(identity=1, key_cap=16, rep_cap=4)  # dense path
+        big = cls(identity=1, key_cap=4096, rep_cap=4)  # sparse path
+        for repo in (small, big):
+            for k in keys:
+                repo.converge(
+                    k, {7: 5} if cls is RepoGCOUNT else ({7: 5}, {9: decs[k]})
+                )
+            repo.drain()
+        for k in keys:
+            assert small._get_value(k) == big._get_value(k), (cls.__name__, k)
+
+    small = RepoTREG(identity=1, key_cap=16)
+    big = RepoTREG(identity=1, key_cap=4096)
+    shared = b"longsharedprefix-"  # >8 bytes: rank collision -> device tie
+    for repo in (small, big):
+        for i, k in enumerate(keys):
+            repo.converge(k, (b"v%d" % i, 10 + i))
+            # drain between the colliding writes so the tie reaches the
+            # device (one-drain writes coalesce host-side first)
+            repo.converge(k, (shared + (b"aaa" if i % 2 else b"zzz"), 100))
+        repo.drain()
+        for i, k in enumerate(keys):
+            repo.converge(k, (shared + (b"zzz" if i % 2 else b"aaa"), 100))
+        repo.drain()  # tie rows resolve on host: zzz must win either order
+    for k in keys:
+        srow, brow = small._keys[k], big._keys[k]
+        assert small._cache[srow][0] == big._cache[brow][0] == 100
+        assert (
+            small._interner.lookup(small._cache[srow][1])
+            == big._interner.lookup(big._cache[brow][1])
+            == shared + b"zzz"
+        )
+
+
 # -- UJSON -----------------------------------------------------------------
 
 

@@ -110,6 +110,37 @@ def test_treg_unset_loses_to_zero_ts_write():
     assert int(np.asarray(state.vid[1])) == -1  # still unset
 
 
+@pytest.mark.parametrize("seed", [0, 1])
+def test_treg_converge_dense_matches_sparse(seed):
+    """The dense full-keyspace join must agree with the scatter composite;
+    identity rows (0, 0, 0, 0, -1) must never win or tie."""
+    rng = np.random.default_rng(seed)
+    present = rng.random(K) < 0.7
+    rows = np.nonzero(present)[0].astype(np.int32)
+    d_ts = np.where(present, rng.integers(0, 4, K), 0).astype(np.uint64)
+    d_rank = np.where(present, rng.integers(0, 3, K), 0).astype(np.uint64)
+    d_vid = np.where(present, rng.integers(0, 50, K), -1).astype(np.int32)
+
+    # pre-populate both states identically
+    pre_ts = rng.integers(0, 4, K).astype(np.uint64)
+    pre_rank = rng.integers(0, 3, K).astype(np.uint64)
+    pre_vid = rng.integers(0, 50, K).astype(np.int32)
+    th, tl, rh, rl = split_batch(pre_ts, pre_rank)
+    base, _ = treg.converge_dense(treg.init(K), th, tl, rh, rl, pre_vid)
+
+    th, tl, rh, rl = split_batch(d_ts, d_rank)
+    dense, tie_d = treg.converge_dense(base, th, tl, rh, rl, d_vid)
+    sparse, tie_s = treg.converge_batch(
+        base, rows, th[rows], tl[rows], rh[rows], rl[rows], d_vid[rows]
+    )
+    for plane in ("ts_hi", "ts_lo", "rank_hi", "rank_lo", "vid"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(dense, plane)), np.asarray(getattr(sparse, plane))
+        )
+    np.testing.assert_array_equal(np.asarray(tie_d)[rows], np.asarray(tie_s))
+    assert not np.asarray(tie_d)[~present].any()  # identity never ties
+
+
 def test_treg_converge_many_scan():
     """Replica batches folded in one compiled scan must equal sequential."""
     rng = np.random.default_rng(9)
