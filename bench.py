@@ -403,12 +403,70 @@ def config_ujson_32() -> dict:
     }
 
 
+def config_codec_native() -> dict:
+    """Native cluster codec (native/cluster_codec.cpp) vs the Python
+    oracle on the MsgPushDeltas hot path: encode+decode of a PNCOUNT
+    anti-entropy batch (5k keys x 4 replica entries per polarity), the
+    wire work every heartbeat broadcast/converge performs."""
+    from jylis_tpu.cluster import codec
+    from jylis_tpu.cluster.msg import MsgPushDeltas
+    from jylis_tpu.native import codec as ncodec
+    from jylis_tpu.native import lib
+
+    n_keys, n_rids = 5000, 4
+    batch = tuple(
+        (
+            b"key:%08d" % k,
+            (
+                {r: (k * 7 + r) % (1 << 40) for r in range(n_rids)},
+                {r: (k * 3 + r) % (1 << 40) for r in range(n_rids)},
+            ),
+        )
+        for k in range(n_keys)
+    )
+    msg = MsgPushDeltas("PNCOUNT", batch)
+    body = codec._encode_oracle(msg)
+
+    def native_once():
+        t0 = time.perf_counter()
+        out = ncodec.encode_push(msg)
+        got = ncodec.decode_push(body)
+        dt = time.perf_counter() - t0
+        assert out == body and got == msg
+        return n_keys, dt
+
+    def oracle_once():
+        t0 = time.perf_counter()
+        out = codec._encode_oracle(msg)
+        got = codec._decode_oracle(body)
+        dt = time.perf_counter() - t0
+        assert out == body and got == msg
+        return n_keys, dt
+
+    oracle = _median_rate(oracle_once, CPU_RUNS)
+    if lib() is None:
+        return {
+            "metric": "cluster codec PushDeltas encode+decode (native)",
+            "value": round(oracle, 1),
+            "unit": "keys/sec",
+            "vs_baseline": 1.0,
+        }
+    native = _median_rate(native_once, CPU_RUNS)
+    return {
+        "metric": "cluster codec PushDeltas encode+decode (native)",
+        "value": round(native, 1),
+        "unit": "keys/sec",
+        "vs_baseline": round(native / oracle, 2),
+    }
+
+
 CONFIGS = {
     "gcount-smoke": config_gcount_smoke,
     "pncount-100k": config_pncount_100k,
     "treg-1m": config_treg_1m,
     "tlog-trim": config_tlog_trim,
     "ujson-32": config_ujson_32,
+    "codec-native": config_codec_native,
 }
 
 

@@ -18,8 +18,13 @@ are encoded per data type (the wire shapes documented in each repo module):
     PNCOUNT        ({rid: u64}, {rid: u64})
     UJSON          dot-store entries + causal context (ops/ujson_host.py)
 
-A native C++ fast path for the same format lives in native/; this module
-is the always-available implementation and its correctness oracle.
+A native C++ fast path for the MsgPushDeltas hot loop (the per-key delta
+packing on every anti-entropy broadcast/converge) lives in
+native/cluster_codec.cpp behind jylis_tpu/native/codec.py; encode()/
+decode() below try it first and fall back here. This module is the
+always-available implementation and the byte-level correctness oracle
+(fuzz-differential tests: tests/test_native_codec.py); membership
+messages and UJSON payloads always take this path.
 """
 
 from __future__ import annotations
@@ -121,7 +126,13 @@ class _Reader:
         return b
 
     def str_(self) -> str:
-        return self.bytes_().decode()
+        b = self.bytes_()
+        try:
+            return b.decode()
+        except UnicodeDecodeError as e:
+            # malformed peer bytes must surface as CodecError (the cluster
+            # drops the connection on it), never a raw UnicodeDecodeError
+            raise CodecError(f"invalid utf-8 string: {e}") from e
 
     def done(self) -> bool:
         return self.pos == len(self.buf)
@@ -258,6 +269,16 @@ _TAG_PUSH = 3
 
 
 def encode(msg: Msg) -> bytes:
+    if isinstance(msg, MsgPushDeltas):
+        from ..native import codec as ncodec
+
+        fast = ncodec.encode_push(msg)
+        if fast is not None:
+            return fast
+    return _encode_oracle(msg)
+
+
+def _encode_oracle(msg: Msg) -> bytes:
     out = bytearray()
     if isinstance(msg, MsgPong):
         out.append(_TAG_PONG)
@@ -280,6 +301,16 @@ def encode(msg: Msg) -> bytes:
 
 
 def decode(body: bytes) -> Msg:
+    if body and body[0] == _TAG_PUSH:
+        from ..native import codec as ncodec
+
+        fast = ncodec.decode_push(body)
+        if fast is not None:
+            return fast
+    return _decode_oracle(body)
+
+
+def _decode_oracle(body: bytes) -> Msg:
     r = _Reader(body)
     if not body:
         raise CodecError("empty message")

@@ -6,9 +6,10 @@ validates the magic byte and rejects the frame otherwise. The reference
 additionally guards for 64-bit platforms at compile time (framing.pony:3);
 Python ints make that moot, but we keep the explicit u64 bound check.
 
-A native C++ implementation of the same format lives in native/ (loaded via
-ctypes when built); this module is the always-available reference path and
-the correctness oracle for it.
+The header is 9 fixed bytes built/parsed with ``struct`` — there is
+deliberately no native twin for it (nothing to win); the codec underneath
+the framing (cluster/codec.py) is where the native fast path lives
+(native/cluster_codec.cpp).
 """
 
 from __future__ import annotations

@@ -54,6 +54,58 @@ def _stale() -> bool:
     )
 
 
+def _declare_codec(cdll: ctypes.CDLL) -> None:
+    """Signatures for the cluster wire codec (native/cluster_codec.cpp)."""
+    c = ctypes
+    p64 = c.POINTER(c.c_int64)
+    sigs = {
+        # encode: (..., out, cap) -> bytes written or -1
+        "jy_push_counters_encode": (
+            c.c_int64,
+            [c.c_char_p, c.c_int64, c.c_int64, c.c_char_p, c.c_void_p,
+             c.c_void_p, c.c_int32, c.c_void_p, c.c_void_p, c.c_void_p,
+             c.c_void_p, c.c_int64],
+        ),
+        "jy_push_treg_encode": (
+            c.c_int64,
+            [c.c_char_p, c.c_int64, c.c_int64, c.c_char_p, c.c_void_p,
+             c.c_void_p, c.c_char_p, c.c_void_p, c.c_void_p, c.c_void_p,
+             c.c_void_p, c.c_int64],
+        ),
+        "jy_push_tlog_encode": (
+            c.c_int64,
+            [c.c_char_p, c.c_int64, c.c_int64, c.c_char_p, c.c_void_p,
+             c.c_void_p, c.c_void_p, c.c_char_p, c.c_void_p, c.c_void_p,
+             c.c_void_p, c.c_void_p, c.c_void_p, c.c_int64],
+        ),
+        # measure/decode: -> 0 ok, -1 malformed, -2 fall back to oracle
+        "jy_push_counters_measure": (
+            c.c_int32, [c.c_char_p, c.c_int64, c.c_int32, p64, p64],
+        ),
+        "jy_push_counters_decode": (
+            c.c_int32,
+            [c.c_char_p, c.c_int64, c.c_int32, c.c_void_p, c.c_void_p,
+             c.c_void_p, c.c_void_p, c.c_void_p],
+        ),
+        "jy_push_treg_measure": (c.c_int32, [c.c_char_p, c.c_int64, p64]),
+        "jy_push_treg_decode": (
+            c.c_int32,
+            [c.c_char_p, c.c_int64, c.c_void_p, c.c_void_p, c.c_void_p,
+             c.c_void_p, c.c_void_p],
+        ),
+        "jy_push_tlog_measure": (c.c_int32, [c.c_char_p, c.c_int64, p64, p64]),
+        "jy_push_tlog_decode": (
+            c.c_int32,
+            [c.c_char_p, c.c_int64, c.c_void_p, c.c_void_p, c.c_void_p,
+             c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p],
+        ),
+    }
+    for fn_name, (restype, argtypes) in sigs.items():
+        fn = getattr(cdll, fn_name)
+        fn.restype = restype
+        fn.argtypes = argtypes
+
+
 def lib() -> ctypes.CDLL | None:
     """The native library, building it on first use if needed/possible."""
     global _lib, _tried
@@ -87,6 +139,7 @@ def lib() -> ctypes.CDLL | None:
             ctypes.c_int32,
             ctypes.POINTER(ctypes.c_int32),
         ]
+        _declare_codec(cdll)
         _lib = cdll
     except OSError:
         _lib = None

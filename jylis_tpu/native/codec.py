@@ -1,0 +1,327 @@
+"""ctypes wrapper for the native MsgPushDeltas wire codec.
+
+`encode_push(msg)` / `decode_push(body)` return None whenever the native
+path can't (or shouldn't) handle the input — no library, UJSON payloads,
+values outside u64, malformed bytes — and the caller falls back to the
+pure-Python oracle in cluster/codec.py. For every input the native path
+does accept, its output is byte-identical (encode) / object-equal (decode)
+to the oracle; tests/test_native_codec.py fuzz-checks that equivalence.
+
+The Python side does exactly one flattening pass over the delta objects
+(list/ndarray building — C-speed per element); all varint/byte-shuffling
+work happens in one or two FFI calls over contiguous buffers
+(native/cluster_codec.cpp).
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from ..cluster.msg import Msg, MsgPushDeltas
+from . import lib
+
+_U64_MAX = (1 << 64) - 1
+
+# name -> ndicts for the counter family
+_COUNTER_NDICTS = {"GCOUNT": 1, "PNCOUNT": 2}
+
+
+def _ptr(arr: np.ndarray):
+    return ctypes.c_void_p(arr.ctypes.data)
+
+
+def _u64_array(values) -> np.ndarray | None:
+    """Values as u64, or None if any falls outside [0, 2^64)."""
+    try:
+        return np.array(values, dtype=np.uint64)
+    except (OverflowError, TypeError, ValueError):
+        return None
+
+
+def _key_blob(batch) -> tuple[bytes, np.ndarray, np.ndarray]:
+    offs = np.empty(len(batch), np.int64)
+    lens = np.empty(len(batch), np.int64)
+    pos = 0
+    parts = []
+    for i, (key, _delta) in enumerate(batch):
+        offs[i] = pos
+        lens[i] = len(key)
+        pos += len(key)
+        parts.append(key)
+    return b"".join(parts), offs, lens
+
+
+# ---- encode ----------------------------------------------------------------
+
+
+def encode_push(msg: MsgPushDeltas) -> bytes | None:
+    cdll = lib()
+    if cdll is None:
+        return None
+    name = msg.name
+    if name in _COUNTER_NDICTS:
+        return _encode_counters(cdll, msg, _COUNTER_NDICTS[name])
+    if name == "TREG":
+        return _encode_treg(cdll, msg)
+    if name in ("TLOG", "SYSTEM"):
+        return _encode_tlog(cdll, msg)
+    return None  # UJSON / unknown: oracle
+
+
+def _encode_counters(cdll, msg: MsgPushDeltas, ndicts: int) -> bytes | None:
+    batch = msg.batch
+    key_blob, key_off, key_len = _key_blob(batch)
+    counts = np.empty(len(batch) * ndicts, np.int64)
+    rids: list[int] = []
+    vals: list[int] = []
+    for i, (_key, delta) in enumerate(batch):
+        dicts = (delta,) if ndicts == 1 else delta
+        if len(dicts) != ndicts:
+            return None
+        for d, dct in enumerate(dicts):
+            items = sorted(dct.items())
+            counts[i * ndicts + d] = len(items)
+            if items:
+                r, v = zip(*items)
+                rids.extend(r)
+                vals.extend(v)
+    rid_arr = _u64_array(rids)
+    val_arr = _u64_array(vals)
+    if rid_arr is None or val_arr is None:
+        return None
+    name_b = msg.name.encode()
+    cap = (
+        16 + len(name_b) + len(key_blob)
+        + len(batch) * (10 + 10 * ndicts) + 20 * len(rids)
+    )
+    out = np.empty(cap, np.uint8)
+    n = cdll.jy_push_counters_encode(
+        name_b, len(name_b), len(batch),
+        key_blob, _ptr(key_off), _ptr(key_len),
+        ndicts, _ptr(counts), _ptr(rid_arr), _ptr(val_arr),
+        _ptr(out), cap,
+    )
+    return out[:n].tobytes() if n >= 0 else None
+
+
+def _encode_treg(cdll, msg: MsgPushDeltas) -> bytes | None:
+    batch = msg.batch
+    key_blob, key_off, key_len = _key_blob(batch)
+    val_off = np.empty(len(batch), np.int64)
+    val_len = np.empty(len(batch), np.int64)
+    ts_list = []
+    pos = 0
+    parts = []
+    for i, (_key, delta) in enumerate(batch):
+        value, ts = delta
+        val_off[i] = pos
+        val_len[i] = len(value)
+        pos += len(value)
+        parts.append(value)
+        ts_list.append(ts)
+    ts_arr = _u64_array(ts_list)
+    if ts_arr is None:
+        return None
+    val_blob = b"".join(parts)
+    name_b = msg.name.encode()
+    cap = 16 + len(name_b) + len(key_blob) + len(val_blob) + 30 * len(batch)
+    out = np.empty(cap, np.uint8)
+    n = cdll.jy_push_treg_encode(
+        name_b, len(name_b), len(batch),
+        key_blob, _ptr(key_off), _ptr(key_len),
+        val_blob, _ptr(val_off), _ptr(val_len), _ptr(ts_arr),
+        _ptr(out), cap,
+    )
+    return out[:n].tobytes() if n >= 0 else None
+
+
+def _encode_tlog(cdll, msg: MsgPushDeltas) -> bytes | None:
+    batch = msg.batch
+    key_blob, key_off, key_len = _key_blob(batch)
+    entry_counts = np.empty(len(batch), np.int64)
+    cut_list = []
+    ts_list: list[int] = []
+    ent_parts: list[bytes] = []
+    for i, (_key, delta) in enumerate(batch):
+        entries, cutoff = delta
+        entry_counts[i] = len(entries)
+        cut_list.append(cutoff)
+        for value, ts in entries:
+            ent_parts.append(value)
+            ts_list.append(ts)
+    ts_arr = _u64_array(ts_list)
+    cut_arr = _u64_array(cut_list)
+    if ts_arr is None or cut_arr is None:
+        return None
+    ent_off = np.empty(len(ent_parts), np.int64)
+    ent_len = np.empty(len(ent_parts), np.int64)
+    pos = 0
+    for i, part in enumerate(ent_parts):
+        ent_off[i] = pos
+        ent_len[i] = len(part)
+        pos += len(part)
+    ent_blob = b"".join(ent_parts)
+    name_b = msg.name.encode()
+    cap = (
+        16 + len(name_b) + len(key_blob) + len(ent_blob)
+        + 30 * len(batch) + 20 * len(ent_parts)
+    )
+    out = np.empty(cap, np.uint8)
+    n = cdll.jy_push_tlog_encode(
+        name_b, len(name_b), len(batch),
+        key_blob, _ptr(key_off), _ptr(key_len),
+        _ptr(entry_counts),
+        ent_blob, _ptr(ent_off), _ptr(ent_len), _ptr(ts_arr),
+        _ptr(cut_arr), _ptr(out), cap,
+    )
+    return out[:n].tobytes() if n >= 0 else None
+
+
+# ---- decode ----------------------------------------------------------------
+
+
+def _read_header(body: bytes) -> tuple[str, int] | None:
+    """Parse tag + name; return (name, offset-past-name) or None."""
+    if not body or body[0] != 3:
+        return None
+    pos, shift, n = 1, 0, 0
+    while True:
+        if pos >= len(body) or shift > 70:
+            return None
+        b = body[pos]
+        pos += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+    if pos + n > len(body):
+        return None
+    try:
+        name = body[pos : pos + n].decode()
+    except UnicodeDecodeError:
+        return None
+    return name, pos + n
+
+
+def decode_push(body: bytes) -> Msg | None:
+    cdll = lib()
+    if cdll is None:
+        return None
+    header = _read_header(body)
+    if header is None:
+        return None
+    name, off = header
+    rest = body[off:]
+    if name in _COUNTER_NDICTS:
+        return _decode_counters(cdll, name, body, rest, off, _COUNTER_NDICTS[name])
+    if name == "TREG":
+        return _decode_treg(cdll, name, body, rest, off)
+    if name in ("TLOG", "SYSTEM"):
+        return _decode_tlog(cdll, name, body, rest, off)
+    return None
+
+
+def _decode_counters(cdll, name, body, rest, off, ndicts) -> Msg | None:
+    n_keys = ctypes.c_int64()
+    total = ctypes.c_int64()
+    rc = cdll.jy_push_counters_measure(
+        rest, len(rest), ndicts, ctypes.byref(n_keys), ctypes.byref(total)
+    )
+    if rc != 0:
+        return None
+    nk, ne = n_keys.value, total.value
+    key_off = np.empty(nk, np.int64)
+    key_len = np.empty(nk, np.int64)
+    counts = np.empty(nk * ndicts, np.int64)
+    rids = np.empty(ne, np.uint64)
+    vals = np.empty(ne, np.uint64)
+    rc = cdll.jy_push_counters_decode(
+        rest, len(rest), ndicts,
+        _ptr(key_off), _ptr(key_len), _ptr(counts), _ptr(rids), _ptr(vals),
+    )
+    if rc != 0:
+        return None
+    rid_l = rids.tolist()
+    val_l = vals.tolist()
+    ko = key_off.tolist()
+    kl = key_len.tolist()
+    cl = counts.tolist()
+    batch = []
+    e = 0
+    for k in range(nk):
+        key = rest[ko[k] : ko[k] + kl[k]]
+        dicts = []
+        for d in range(ndicts):
+            c = cl[k * ndicts + d]
+            dicts.append(dict(zip(rid_l[e : e + c], val_l[e : e + c])))
+            e += c
+        batch.append((key, dicts[0] if ndicts == 1 else tuple(dicts)))
+    return MsgPushDeltas(name, tuple(batch))
+
+
+def _decode_treg(cdll, name, body, rest, off) -> Msg | None:
+    n_keys = ctypes.c_int64()
+    rc = cdll.jy_push_treg_measure(rest, len(rest), ctypes.byref(n_keys))
+    if rc != 0:
+        return None
+    nk = n_keys.value
+    key_off = np.empty(nk, np.int64)
+    key_len = np.empty(nk, np.int64)
+    val_off = np.empty(nk, np.int64)
+    val_len = np.empty(nk, np.int64)
+    ts = np.empty(nk, np.uint64)
+    rc = cdll.jy_push_treg_decode(
+        rest, len(rest),
+        _ptr(key_off), _ptr(key_len), _ptr(val_off), _ptr(val_len), _ptr(ts),
+    )
+    if rc != 0:
+        return None
+    ko, kl = key_off.tolist(), key_len.tolist()
+    vo, vl = val_off.tolist(), val_len.tolist()
+    tl = ts.tolist()
+    batch = tuple(
+        (rest[ko[k] : ko[k] + kl[k]], (rest[vo[k] : vo[k] + vl[k]], tl[k]))
+        for k in range(nk)
+    )
+    return MsgPushDeltas(name, batch)
+
+
+def _decode_tlog(cdll, name, body, rest, off) -> Msg | None:
+    n_keys = ctypes.c_int64()
+    total = ctypes.c_int64()
+    rc = cdll.jy_push_tlog_measure(
+        rest, len(rest), ctypes.byref(n_keys), ctypes.byref(total)
+    )
+    if rc != 0:
+        return None
+    nk, ne = n_keys.value, total.value
+    key_off = np.empty(nk, np.int64)
+    key_len = np.empty(nk, np.int64)
+    entry_counts = np.empty(nk, np.int64)
+    ent_off = np.empty(ne, np.int64)
+    ent_len = np.empty(ne, np.int64)
+    ent_ts = np.empty(ne, np.uint64)
+    cutoffs = np.empty(nk, np.uint64)
+    rc = cdll.jy_push_tlog_decode(
+        rest, len(rest),
+        _ptr(key_off), _ptr(key_len), _ptr(entry_counts),
+        _ptr(ent_off), _ptr(ent_len), _ptr(ent_ts), _ptr(cutoffs),
+    )
+    if rc != 0:
+        return None
+    ko, kl = key_off.tolist(), key_len.tolist()
+    cnt = entry_counts.tolist()
+    eo, el = ent_off.tolist(), ent_len.tolist()
+    et = ent_ts.tolist()
+    cut = cutoffs.tolist()
+    batch = []
+    e = 0
+    for k in range(nk):
+        entries = [
+            (rest[eo[i] : eo[i] + el[i]], et[i]) for i in range(e, e + cnt[k])
+        ]
+        e += cnt[k]
+        batch.append((rest[ko[k] : ko[k] + kl[k]], (entries, cut[k])))
+    return MsgPushDeltas(name, tuple(batch))

@@ -1,0 +1,142 @@
+"""Differential tests: native cluster codec vs the pure-Python oracle.
+
+Same posture as tests/test_native_resp.py: the Python implementation in
+cluster/codec.py is the semantic oracle; the C++ fast path
+(native/cluster_codec.cpp via jylis_tpu/native/codec.py) must be
+byte-identical on encode and object-equal on decode for every input it
+accepts, and must decline (return None -> caller falls back) on anything
+outside its domain — including inputs where the oracle raises.
+"""
+
+import random
+
+import pytest
+
+from jylis_tpu.cluster import codec
+from jylis_tpu.cluster.msg import MsgPushDeltas
+from jylis_tpu.native import codec as ncodec
+from jylis_tpu.native import lib
+
+pytestmark = pytest.mark.skipif(
+    lib() is None, reason="native library unavailable (no C++ toolchain)"
+)
+
+
+def _rand_key(rng: random.Random) -> bytes:
+    n = rng.choice([0, 1, 3, 17, 200])
+    return bytes(rng.randrange(256) for _ in range(n))
+
+
+def _rand_u64(rng: random.Random) -> int:
+    # bias toward varint length boundaries
+    return rng.choice(
+        [0, 1, 127, 128, rng.randrange(1 << 21), rng.randrange(1 << 49),
+         (1 << 64) - 1, rng.randrange(1 << 64)]
+    )
+
+
+def _rand_gdict(rng: random.Random) -> dict:
+    return {rng.randrange(1 << 63): _rand_u64(rng) for _ in range(rng.randrange(6))}
+
+
+def _rand_msg(rng: random.Random, name: str) -> MsgPushDeltas:
+    batch = []
+    for _ in range(rng.randrange(5)):
+        key = _rand_key(rng)
+        if name == "GCOUNT":
+            delta = _rand_gdict(rng)
+        elif name == "PNCOUNT":
+            delta = (_rand_gdict(rng), _rand_gdict(rng))
+        elif name == "TREG":
+            delta = (_rand_key(rng), _rand_u64(rng))
+        else:  # TLOG / SYSTEM
+            entries = [
+                (_rand_key(rng), _rand_u64(rng))
+                for _ in range(rng.randrange(4))
+            ]
+            delta = (entries, _rand_u64(rng))
+        batch.append((key, delta))
+    return MsgPushDeltas(name, tuple(batch))
+
+
+NAMES = ["GCOUNT", "PNCOUNT", "TREG", "TLOG", "SYSTEM"]
+
+
+@pytest.mark.parametrize("name", NAMES)
+def test_encode_byte_identical_to_oracle(name):
+    rng = random.Random(f"enc-{name}")
+    for _ in range(200):
+        msg = _rand_msg(rng, name)
+        fast = ncodec.encode_push(msg)
+        assert fast is not None, "native encoder declined a valid message"
+        assert fast == codec._encode_oracle(msg)
+
+
+@pytest.mark.parametrize("name", NAMES)
+def test_decode_equals_oracle(name):
+    rng = random.Random(f"dec-{name}")
+    for _ in range(200):
+        msg = _rand_msg(rng, name)
+        body = codec._encode_oracle(msg)
+        fast = ncodec.decode_push(body)
+        assert fast is not None, "native decoder declined oracle bytes"
+        assert fast == codec._decode_oracle(body) == msg
+
+
+@pytest.mark.parametrize("name", NAMES)
+def test_public_roundtrip_uses_native(name):
+    rng = random.Random(f"rt-{name}")
+    for _ in range(50):
+        msg = _rand_msg(rng, name)
+        assert codec.decode(codec.encode(msg)) == msg
+
+
+def test_mutation_fuzz_never_diverges():
+    """Mutated wire bytes: wherever the native decoder accepts, the oracle
+    must accept with the identical result; where the oracle raises, the
+    native path must have declined (so the public decode still raises)."""
+    rng = random.Random("mutate")
+    for trial in range(400):
+        name = rng.choice(NAMES)
+        body = bytearray(codec._encode_oracle(_rand_msg(rng, name)))
+        if not body:
+            continue
+        for _ in range(rng.randrange(1, 4)):
+            body[rng.randrange(len(body))] = rng.randrange(256)
+        body = bytes(body)
+        if not body or body[0] != 3:
+            continue  # not a PushDeltas any more; native path not consulted
+        try:
+            expect = codec._decode_oracle(body)
+            oracle_raised = False
+        except codec.CodecError:
+            oracle_raised = True
+        fast = ncodec.decode_push(body)
+        if fast is not None:
+            assert not oracle_raised, "native accepted bytes the oracle rejects"
+            assert fast == expect
+        if oracle_raised:
+            with pytest.raises(codec.CodecError):
+                codec.decode(body)
+
+
+def test_oversize_values_fall_back_to_oracle():
+    """Values outside u64 are out of the native domain on both sides but
+    must still roundtrip through the public API via the oracle."""
+    big = 1 << 70
+    msg = MsgPushDeltas("GCOUNT", ((b"k", {3: big}),))
+    assert ncodec.encode_push(msg) is None
+    body = codec.encode(msg)
+    assert ncodec.decode_push(body) is None  # 65+-bit varint -> decline
+    assert codec.decode(body) == msg
+
+
+def test_empty_batch_and_empty_dicts():
+    for msg in [
+        MsgPushDeltas("GCOUNT", ()),
+        MsgPushDeltas("PNCOUNT", ((b"", ({}, {})),)),
+        MsgPushDeltas("TLOG", ((b"k", ([], 0)),)),
+    ]:
+        fast = ncodec.encode_push(msg)
+        assert fast == codec._encode_oracle(msg)
+        assert codec.decode(fast) == msg
