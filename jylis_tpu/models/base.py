@@ -48,28 +48,6 @@ def parse_opt_count(args: list[bytes], i: int) -> int:
         return U64_MAX
 
 
-# batch-padding row index: out of range for any real keyspace, so padded
-# scatter updates fall into mode="drop" instead of colliding with row 0
-PAD_ROW = (1 << 31) - 1
-
-
-def pad_rows(n: int):
-    """(n,) int32 of DISTINCT out-of-range rows (PAD_ROW, PAD_ROW-1, ...).
-
-    Kernels scatter with ``unique_indices=True``; repeating PAD_ROW itself
-    for every padded slot would make that hint a lie (duplicate indices
-    under the hint are documented UB, even ones mode="drop" discards).
-    Distinct descending pads keep the whole index vector genuinely unique —
-    real keyspaces are far smaller than PAD_ROW - n."""
-    import numpy as np
-
-    return (PAD_ROW - np.arange(n)).astype(np.int32)
-
-
-def bucket(n: int, lo: int = 16) -> int:
-    """Next power of two >= n (>= lo): pads batch dims so the jit cache
-    stays small — every distinct shape is a fresh XLA compile."""
-    b = lo
-    while b < n:
-        b <<= 1
-    return b
+# batching helpers live in utils/batching.py (import-cycle-free ground
+# shared with parallel/); re-exported here for the repos' convenience
+from ..utils.batching import PAD_ROW, bucket, pad_rows  # noqa: E402,F401

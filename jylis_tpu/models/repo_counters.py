@@ -23,6 +23,13 @@ import jax
 import numpy as np
 
 from ..ops import gcount, planes, pncount
+from ..parallel import (
+    drain_sharded_g,
+    drain_sharded_pn,
+    route_drain,
+    serving_mesh,
+    shard_plane,
+)
 from .base import ParseError, bucket, need, pad_rows, parse_u64, U64_MAX
 from ..utils.metrics import timed_drain
 from .help import RepoHelp
@@ -73,11 +80,21 @@ def _wrap_i64(v: int) -> int:
 class _CounterRepo:
     """Shared machinery; subclasses bind the ops module and command set."""
 
-    def __init__(self, identity: int, key_cap: int = 1024, rep_cap: int = 8):
+    def __init__(
+        self, identity: int, key_cap: int = 1024, rep_cap: int = 8, mesh="auto"
+    ):
         self._identity = identity
         self._keys: dict[bytes, int] = {}  # key -> row
         self._rids: dict[int, int] = {}  # replica id -> column
-        self._key_cap = key_cap
+        # mesh mode (SURVEY.md §5.8): with >1 visible device the keyspace
+        # planes live keys-sharded over the serving mesh and drains route
+        # through parallel/sharded — the per-type actor keyspace of
+        # repo_manager.pony:92-93 become per-device key blocks. With one
+        # device (the real tunneled chip) this resolves to None and the
+        # single-chip fast path below is untouched.
+        self._mesh = serving_mesh() if mesh == "auto" else mesh
+        self._n_shards = self._mesh.devices.size if self._mesh is not None else 1
+        self._key_cap = self._round_cap(key_cap)
         self._rep_cap = rep_cap
         self._values: dict[int, int] = {}  # row -> cached serving value
         self._dirty: set[bytes] = set()  # keys with unflushed deltas
@@ -110,12 +127,23 @@ class _CounterRepo:
             self._rids[rid] = col
         return col
 
+    def _round_cap(self, k: int) -> int:
+        """Key capacity must split evenly over the mesh's keys axis."""
+        ns = self._n_shards
+        return -(-k // ns) * ns
+
+    def _place(self, state):
+        """(Re-)place state planes keys-sharded when a mesh is active."""
+        if self._mesh is None:
+            return state
+        return type(state)(*(shard_plane(self._mesh, p) for p in state))
+
     def _grow_to_fit(self) -> None:
-        k = bucket(max(len(self._keys), 1), self._key_cap)
+        k = self._round_cap(bucket(max(len(self._keys), 1), self._key_cap))
         r = bucket(max(len(self._rids), 1), self._rep_cap)
         if k != self._key_cap or r != self._rep_cap:
             self._key_cap, self._rep_cap = k, r
-            self._state = self._ops.grow(self._state, k, r)
+            self._state = self._place(self._ops.grow(self._state, k, r))
 
     def deltas_size(self) -> int:
         return len(self._dirty)
@@ -128,7 +156,7 @@ class RepoGCOUNT(_CounterRepo):
 
     def __init__(self, identity: int, **kw):
         super().__init__(identity, **kw)
-        self._state = gcount.init(self._key_cap, self._rep_cap)
+        self._state = self._place(gcount.init(self._key_cap, self._rep_cap))
         self._own: dict[bytes, int] = {}  # my column, absolute (u64 wrap)
         self._pending: dict[int, dict[int, int]] = {}  # row -> col -> max val
 
@@ -175,7 +203,26 @@ class RepoGCOUNT(_CounterRepo):
             return
         self._grow_to_fit()
         rows = list(self._pending)  # dict keys: unique, as converge requires
-        if len(rows) * DENSE_FRACTION >= self._key_cap:
+        if self._mesh is not None:
+            deltas = np.zeros((len(rows), self._rep_cap), np.uint64)
+            for i, row in enumerate(rows):
+                for col, v in self._pending[row].items():
+                    deltas[i, col] = v
+            lr, d_hi, d_lo, slots = route_drain(
+                np.asarray(rows, np.int64),
+                deltas,
+                self._n_shards,
+                self._key_cap // self._n_shards,
+            )
+            hi, lo, sums = drain_sharded_g(
+                self._mesh, self._state.hi, self._state.lo, lr, d_hi, d_lo
+            )
+            self._state = gcount.GCountState(hi, lo)
+            sums = np.asarray(sums)
+            for j, g in enumerate(slots):
+                if g >= 0:
+                    self._values[int(g)] = int(sums[j])
+        elif len(rows) * DENSE_FRACTION >= self._key_cap:
             dense = np.zeros((self._key_cap, self._rep_cap), np.uint64)
             for row in rows:
                 for col, v in self._pending[row].items():
@@ -243,7 +290,7 @@ class RepoPNCOUNT(_CounterRepo):
 
     def __init__(self, identity: int, **kw):
         super().__init__(identity, **kw)
-        self._state = pncount.init(self._key_cap, self._rep_cap)
+        self._state = self._place(pncount.init(self._key_cap, self._rep_cap))
         self._own_p: dict[bytes, int] = {}
         self._own_n: dict[bytes, int] = {}
         # row -> (col -> max val), one map per polarity
@@ -299,7 +346,30 @@ class RepoPNCOUNT(_CounterRepo):
             return
         self._grow_to_fit()
         rows = sorted(set(self._pending_p) | set(self._pending_n))
-        if len(rows) * DENSE_FRACTION >= self._key_cap:
+        if self._mesh is not None:
+            # polarity-stacked (B, 2R) so one routing pass serves both
+            stacked = np.zeros((len(rows), 2 * self._rep_cap), np.uint64)
+            r = self._rep_cap
+            for i, row in enumerate(rows):
+                for col, v in self._pending_p.get(row, {}).items():
+                    stacked[i, col] = v
+                for col, v in self._pending_n.get(row, {}).items():
+                    stacked[i, r + col] = v
+            lr, d_hi, d_lo, slots = route_drain(
+                np.asarray(rows, np.int64),
+                stacked,
+                self._n_shards,
+                self._key_cap // self._n_shards,
+            )
+            p_hi, p_lo, n_hi, n_lo, sums = drain_sharded_pn(
+                self._mesh, *self._state, lr, d_hi, d_lo
+            )
+            self._state = pncount.PNCountState(p_hi, p_lo, n_hi, n_lo)
+            sums = np.asarray(sums)
+            for j, g in enumerate(slots):
+                if g >= 0:
+                    self._values[int(g)] = int(sums[j])
+        elif len(rows) * DENSE_FRACTION >= self._key_cap:
             dp = np.zeros((self._key_cap, self._rep_cap), np.uint64)
             dn = np.zeros((self._key_cap, self._rep_cap), np.uint64)
             for row in rows:

@@ -33,8 +33,15 @@ def _ptr(arr: np.ndarray):
 
 
 def _u64_array(values) -> np.ndarray | None:
-    """Values as u64, or None if any falls outside [0, 2^64)."""
+    """Values as u64, or None if any falls outside [0, 2^64).
+
+    The range check is explicit (numpy 1.x silently wraps out-of-range
+    Python ints; relying on OverflowError is a numpy>=2 behavior) — an
+    out-of-range value must fall back to the oracle, which raises, rather
+    than broadcast a wrapped number to peers."""
     try:
+        if not all(isinstance(v, int) and 0 <= v <= _U64_MAX for v in values):
+            return None
         return np.array(values, dtype=np.uint64)
     except (OverflowError, TypeError, ValueError):
         return None
@@ -215,15 +222,15 @@ def decode_push(body: bytes) -> Msg | None:
     name, off = header
     rest = body[off:]
     if name in _COUNTER_NDICTS:
-        return _decode_counters(cdll, name, body, rest, off, _COUNTER_NDICTS[name])
+        return _decode_counters(cdll, name, rest, _COUNTER_NDICTS[name])
     if name == "TREG":
-        return _decode_treg(cdll, name, body, rest, off)
+        return _decode_treg(cdll, name, rest)
     if name in ("TLOG", "SYSTEM"):
-        return _decode_tlog(cdll, name, body, rest, off)
+        return _decode_tlog(cdll, name, rest)
     return None
 
 
-def _decode_counters(cdll, name, body, rest, off, ndicts) -> Msg | None:
+def _decode_counters(cdll, name, rest, ndicts) -> Msg | None:
     n_keys = ctypes.c_int64()
     total = ctypes.c_int64()
     rc = cdll.jy_push_counters_measure(
@@ -261,7 +268,7 @@ def _decode_counters(cdll, name, body, rest, off, ndicts) -> Msg | None:
     return MsgPushDeltas(name, tuple(batch))
 
 
-def _decode_treg(cdll, name, body, rest, off) -> Msg | None:
+def _decode_treg(cdll, name, rest) -> Msg | None:
     n_keys = ctypes.c_int64()
     rc = cdll.jy_push_treg_measure(rest, len(rest), ctypes.byref(n_keys))
     if rc != 0:
@@ -288,7 +295,7 @@ def _decode_treg(cdll, name, body, rest, off) -> Msg | None:
     return MsgPushDeltas(name, batch)
 
 
-def _decode_tlog(cdll, name, body, rest, off) -> Msg | None:
+def _decode_tlog(cdll, name, rest) -> Msg | None:
     n_keys = ctypes.c_int64()
     total = ctypes.c_int64()
     rc = cdll.jy_push_tlog_measure(

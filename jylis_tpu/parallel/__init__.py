@@ -12,20 +12,27 @@ delta push, jylis_tpu/cluster/) — collectives are the wrong tool for
 elastic membership; the mesh handles the dense intra-pod math.
 """
 
-from .mesh import make_mesh
+from .mesh import make_mesh, serving_mesh
 from .sharded import (
     converge_sharded,
+    drain_sharded_g,
+    drain_sharded_pn,
     join_replica_axis,
     read_all_sharded,
     route_batch,
+    route_drain,
     shard_plane,
 )
 
 __all__ = [
     "make_mesh",
+    "serving_mesh",
     "shard_plane",
     "route_batch",
+    "route_drain",
     "converge_sharded",
+    "drain_sharded_g",
+    "drain_sharded_pn",
     "read_all_sharded",
     "join_replica_axis",
 ]

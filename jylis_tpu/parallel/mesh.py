@@ -30,3 +30,22 @@ def make_mesh(
         raise ValueError(f"n_devices {n_devices} not divisible by rep {rep}")
     grid = np.array(devs[:n_devices]).reshape(rep, n_devices // rep)
     return Mesh(grid, axis_names)
+
+
+_SERVING_MESH: list = []  # memo cell: [Mesh | None] once resolved
+
+
+def serving_mesh() -> Mesh | None:
+    """The process-wide keys-sharded serving mesh, or None single-device.
+
+    Repos call this at construction (mesh="auto"): with one visible device
+    (the real tunneled TPU chip) they keep the single-chip fast path; with
+    a multi-device platform (a pod slice, or the 8-virtual-device test
+    harness) every counter keyspace is born keys-sharded across all of it.
+    Memoised: jits specialise on the mesh as a static arg, so all repos
+    must share one Mesh object.
+    """
+    if not _SERVING_MESH:
+        n = len(jax.devices())
+        _SERVING_MESH.append(make_mesh(n) if n > 1 else None)
+    return _SERVING_MESH[0]

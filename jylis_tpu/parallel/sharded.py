@@ -39,7 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..models.base import pad_rows
+from ..utils.batching import bucket, pad_rows
 from ..ops import planes
 
 U32 = jnp.uint32
@@ -52,6 +52,43 @@ def shard_plane(mesh, arr):
     return jax.device_put(arr, NamedSharding(mesh, P("keys", None)))
 
 
+def _route(key_idx, deltas, n_shards: int, rows_per_shard: int, bucket_width=False):
+    """Shared routing core: coalesce, bucket per shard, pad to a common
+    width. Returns (local_rows, d_hi, d_lo, slot_rows) where slot_rows maps
+    each flattened slot back to its GLOBAL key row (-1 for pad slots).
+    With bucket_width the width is padded to a power of two (bounds the jit
+    cache over drain sizes)."""
+    key_idx, deltas = planes.coalesce(key_idx, deltas)
+    shard_of = key_idx // rows_per_shard
+    order = np.argsort(shard_of, kind="stable")
+    counts = np.bincount(shard_of, minlength=n_shards)
+    width = max(int(counts.max()) if len(key_idx) else 0, 1)
+    if bucket_width:
+        width = bucket(width, 8)
+    # distinct out-of-range pads per shard: each device's scatter keeps an
+    # honestly-unique index vector
+    local_rows = np.broadcast_to(pad_rows(width), (n_shards, width)).copy()
+    local_deltas = np.zeros((n_shards, width, deltas.shape[-1]), np.uint64)
+    slot_rows = np.full((n_shards, width), -1, np.int64)
+    start = 0
+    for s in range(n_shards):
+        c = int(counts[s])
+        sel = order[start : start + c]
+        local_rows[s, :c] = key_idx[sel] % rows_per_shard
+        local_deltas[s, :c] = deltas[sel]
+        slot_rows[s, :c] = key_idx[sel]
+        start += c
+    d_hi, d_lo = planes.split64_np(
+        local_deltas.reshape(n_shards * width, deltas.shape[-1])
+    )
+    return (
+        local_rows.reshape(n_shards * width),
+        d_hi,
+        d_lo,
+        slot_rows.reshape(n_shards * width),
+    )
+
+
 def route_batch(key_idx, deltas, n_shards: int, rows_per_shard: int):
     """Host-side shard routing: global (B,) rows + (B, R) u64 deltas become
     ((n_shards * W,) local rows, hi/lo (n_shards * W, R) u32 planes) with
@@ -59,26 +96,16 @@ def route_batch(key_idx, deltas, n_shards: int, rows_per_shard: int):
     Duplicate keys are max-combined here (the device composite requires
     unique rows); padded slots carry PAD_ROW, which the scatter drops.
     """
-    key_idx, deltas = planes.coalesce(key_idx, deltas)
-    shard_of = key_idx // rows_per_shard
-    order = np.argsort(shard_of, kind="stable")
-    counts = np.bincount(shard_of, minlength=n_shards)
-    width = max(int(counts.max()) if len(key_idx) else 0, 1)
-    # distinct out-of-range pads per shard: each device's scatter keeps an
-    # honestly-unique index vector
-    local_rows = np.broadcast_to(pad_rows(width), (n_shards, width)).copy()
-    local_deltas = np.zeros((n_shards, width, deltas.shape[-1]), np.uint64)
-    start = 0
-    for s in range(n_shards):
-        c = int(counts[s])
-        sel = order[start : start + c]
-        local_rows[s, :c] = key_idx[sel] % rows_per_shard
-        local_deltas[s, :c] = deltas[sel]
-        start += c
-    d_hi, d_lo = planes.split64_np(
-        local_deltas.reshape(n_shards * width, deltas.shape[-1])
-    )
-    return local_rows.reshape(n_shards * width), d_hi, d_lo
+    local_rows, d_hi, d_lo, _ = _route(key_idx, deltas, n_shards, rows_per_shard)
+    return local_rows, d_hi, d_lo
+
+
+def route_drain(key_idx, deltas, n_shards: int, rows_per_shard: int):
+    """Serving-path routing: like `route_batch`, but the per-shard width is
+    bucketed to a power of two (bounds the jit cache over drain sizes) and
+    the slot -> global-row map is returned so the host value cache can be
+    refreshed from the per-slot sums the sharded drain kernels emit."""
+    return _route(key_idx, deltas, n_shards, rows_per_shard, bucket_width=True)
 
 
 def _local_converge(hi_blk, lo_blk, rows_blk, dhi_blk, dlo_blk):
@@ -125,6 +152,81 @@ def read_all_sharded(mesh, hi, lo):
     """Row sums (counter values, u64 wrapping) for the whole keyspace;
     output stays keys-sharded — only materialise on host what you need."""
     return _read_all_sharded(mesh, hi, lo)
+
+
+# ---- serving drains: converge + read-back in ONE sharded launch ------------
+#
+# The counter repos' drain needs the post-join row sums for its host value
+# cache. Doing the read inside the same shard_map body keeps the whole
+# drain one device launch (no second dispatch latency on the tunneled TPU)
+# and keeps read work proportional to the BATCH, not the keyspace: each
+# device gathers only its routed rows. Pad slots gather clamped garbage,
+# which the host drops via the slot_rows map.
+
+
+def _local_drain_g(hi_blk, lo_blk, rows_blk, dhi_blk, dlo_blk):
+    hi_blk, lo_blk = planes.scatter_join(hi_blk, lo_blk, rows_blk, dhi_blk, dlo_blk)
+    sums = planes.rowsum64(hi_blk[rows_blk], lo_blk[rows_blk])
+    return hi_blk, lo_blk, sums
+
+
+@partial(jax.jit, static_argnames=("mesh",), donate_argnums=(1, 2))
+def drain_sharded_g(mesh, hi, lo, local_rows, d_hi, d_lo):
+    """GCOUNT sharded drain: join the routed batch into each device's key
+    block and return (hi, lo, per-slot u64 row sums)."""
+    return jax.shard_map(
+        _local_drain_g,
+        mesh=mesh,
+        in_specs=(
+            P("keys", None),
+            P("keys", None),
+            P("keys"),
+            P("keys", None),
+            P("keys", None),
+        ),
+        out_specs=(P("keys", None), P("keys", None), P("keys")),
+    )(hi, lo, local_rows, d_hi, d_lo)
+
+
+def _local_drain_pn(p_hi, p_lo, n_hi, n_lo, rows_blk, dhi_blk, dlo_blk):
+    # deltas arrive polarity-stacked (W, 2R): one routing pass serves both
+    r = p_hi.shape[1]
+    p_hi, p_lo = planes.scatter_join(
+        p_hi, p_lo, rows_blk, dhi_blk[:, :r], dlo_blk[:, :r]
+    )
+    n_hi, n_lo = planes.scatter_join(
+        n_hi, n_lo, rows_blk, dhi_blk[:, r:], dlo_blk[:, r:]
+    )
+    p = planes.rowsum64(p_hi[rows_blk], p_lo[rows_blk])
+    n = planes.rowsum64(n_hi[rows_blk], n_lo[rows_blk])
+    sums = jax.lax.bitcast_convert_type(p - n, jnp.int64)
+    return p_hi, p_lo, n_hi, n_lo, sums
+
+
+@partial(jax.jit, static_argnames=("mesh",), donate_argnums=(1, 2, 3, 4))
+def drain_sharded_pn(mesh, p_hi, p_lo, n_hi, n_lo, local_rows, d_hi, d_lo):
+    """PNCOUNT sharded drain: both polarities join in one launch; returns
+    (state planes..., per-slot i64 net values)."""
+    return jax.shard_map(
+        _local_drain_pn,
+        mesh=mesh,
+        in_specs=(
+            P("keys", None),
+            P("keys", None),
+            P("keys", None),
+            P("keys", None),
+            P("keys"),
+            P("keys", None),
+            P("keys", None),
+        ),
+        out_specs=(
+            P("keys", None),
+            P("keys", None),
+            P("keys", None),
+            P("keys", None),
+            P("keys"),
+        ),
+    )(p_hi, p_lo, n_hi, n_lo, local_rows, d_hi, d_lo)
 
 
 def _tree_join(hi_blk, lo_blk):

@@ -1,0 +1,32 @@
+"""Batch-shape helpers shared by the repos (models/) and the mesh routing
+layer (parallel/) — kept dependency-free so either side can import them
+without pulling the other in.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# batch-padding row index: out of range for any real keyspace, so padded
+# scatter updates fall into mode="drop" instead of colliding with row 0
+PAD_ROW = (1 << 31) - 1
+
+
+def pad_rows(n: int):
+    """(n,) int32 of DISTINCT out-of-range rows (PAD_ROW, PAD_ROW-1, ...).
+
+    Kernels scatter with ``unique_indices=True``; repeating PAD_ROW itself
+    for every padded slot would make that hint a lie (duplicate indices
+    under the hint are documented UB, even ones mode="drop" discards).
+    Distinct descending pads keep the whole index vector genuinely unique —
+    real keyspaces are far smaller than PAD_ROW - n."""
+    return (PAD_ROW - np.arange(n)).astype(np.int32)
+
+
+def bucket(n: int, lo: int = 16) -> int:
+    """Next power of two >= n (>= lo): pads batch dims so the jit cache
+    stays small — every distinct shape is a fresh XLA compile."""
+    b = lo
+    while b < n:
+        b <<= 1
+    return b

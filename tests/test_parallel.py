@@ -73,6 +73,104 @@ def test_sharded_converge_matches_single_chip():
     np.testing.assert_array_equal(sums, reference.sum(axis=1, dtype=np.uint64))
 
 
+class _R:
+    """Minimal resp sink for driving repos directly."""
+
+    def __init__(self):
+        self.vals = []
+
+    def u64(self, v):
+        self.vals.append(v)
+
+    def i64(self, v):
+        self.vals.append(v)
+
+    def ok(self):
+        pass
+
+
+def test_serving_repos_auto_shard_disjoint_key_blocks():
+    """Under the 8-device harness the counter repos serve keys-sharded:
+    each device owns a disjoint, contiguous block of key rows covering the
+    whole keyspace (VERDICT round-1 item 2)."""
+    from jylis_tpu.models.repo_counters import RepoGCOUNT
+
+    repo = RepoGCOUNT(identity=7)
+    assert repo._mesh is not None and repo._n_shards == 8
+    k = repo._key_cap
+    blocks = []
+    for shard in repo._state.hi.addressable_shards:
+        (rows, cols) = shard.index
+        blocks.append((rows.start or 0, rows.stop if rows.stop else k))
+        assert cols == slice(None) or (cols.start or 0) == 0  # all replica cols resident
+    blocks.sort()
+    assert blocks[0][0] == 0 and blocks[-1][1] == k
+    for (a0, a1), (b0, b1) in zip(blocks, blocks[1:]):
+        assert a1 == b0  # contiguous, non-overlapping
+    assert len({b[0] for b in blocks}) == 8
+
+
+def test_sharded_engine_convergence_two_nodes():
+    """Two engine repos (different identities), both in mesh mode, exchange
+    flushed deltas and converge to identical values — the reference's
+    anti-entropy round (repo_gcount.pony:25-60) on the sharded path."""
+    from jylis_tpu.models.repo_counters import RepoGCOUNT, RepoPNCOUNT
+
+    a, b = RepoGCOUNT(identity=1), RepoGCOUNT(identity=2)
+    rng = np.random.default_rng(3)
+    keys = [b"k%d" % i for i in range(300)]  # > one shard block's worth
+    model = {k: 0 for k in keys}
+    for repo in (a, b):
+        for k in keys:
+            amt = int(rng.integers(1, 1000))
+            repo.apply(_R(), [b"INC", k, str(amt).encode()])
+            model[k] += amt
+    for src, dst in ((a, b), (b, a)):
+        for key, delta in src.flush_deltas():
+            dst.converge(key, delta)
+    for repo in (a, b):
+        for k in keys:
+            r = _R()
+            repo.apply(r, [b"GET", k])
+            assert r.vals == [model[k]], k
+
+    pa, pb = RepoPNCOUNT(identity=1), RepoPNCOUNT(identity=2)
+    pmodel = {k: 0 for k in keys}
+    for repo in (pa, pb):
+        for k in keys:
+            amt = int(rng.integers(1, 1000))
+            op = b"INC" if rng.integers(2) else b"DEC"
+            repo.apply(_R(), [op, k, str(amt).encode()])
+            pmodel[k] += amt if op == b"INC" else -amt
+    for src, dst in ((pa, pb), (pb, pa)):
+        for key, delta in src.flush_deltas():
+            dst.converge(key, delta)
+    for repo in (pa, pb):
+        for k in keys:
+            r = _R()
+            repo.apply(r, [b"GET", k])
+            assert r.vals == [pmodel[k]], k
+
+
+def test_sharded_repo_grows_past_initial_capacity():
+    """Growth re-places the planes sharded and keeps values intact."""
+    from jylis_tpu.models.repo_counters import RepoGCOUNT
+
+    repo = RepoGCOUNT(identity=5, key_cap=16)
+    n = 200  # forces several grows past 16
+    for i in range(n):
+        repo.apply(_R(), [b"INC", b"g%d" % i, b"%d" % (i + 1)])
+    # foreign deltas force a real sharded drain
+    repo.converge(b"g0", {99: 7})
+    repo.drain()
+    assert repo._state.hi.shape[0] >= n
+    assert len(repo._state.hi.addressable_shards) == 8
+    for i in range(n):
+        r = _R()
+        repo.apply(r, [b"GET", b"g%d" % i])
+        assert r.vals == [(i + 1) + (7 if i == 0 else 0)]
+
+
 def test_join_replica_axis_is_lattice_join():
     rng = np.random.default_rng(1)
     S, K = 8, 64  # 2 local rows per rep shard: exercises the local fold
