@@ -471,6 +471,56 @@ def config_codec_native() -> dict:
     }
 
 
+def config_pallas_join() -> dict:
+    """Pallas fused dense join vs the XLA dense join on the north-star
+    workload — the measurement behind ops/pallas_join.py's docstring
+    (vs_baseline here is pallas/xla: < 1 means XLA's fusion wins and
+    stays the production default)."""
+    import jax
+    import jax.numpy as jnp
+
+    from jylis_tpu.ops import pallas_join, pncount
+
+    def bits(j):
+        return jax.random.bits(jax.random.key(j), (K, R), jnp.uint32)
+
+    state = pncount.init(K, R)
+    deltas = pncount.PNCountState(bits(0), bits(1), bits(2), bits(3))
+
+    def make_sweep(join):
+        @jax.jit
+        def sweep(st, d):
+            def body(s, i):
+                dd = pncount.PNCountState(d.p_hi ^ i, d.p_lo, d.n_hi ^ i, d.n_lo)
+                return join(s, dd), None
+
+            s, _ = jax.lax.scan(body, st, jnp.arange(ROUNDS, dtype=jnp.uint32))
+            return s
+
+        return sweep
+
+    def rate(sweep):
+        s1 = sweep(state, deltas)
+        _ = np.asarray(jax.device_get(s1.p_hi.ravel()[0:1]))
+
+        def once():
+            t0 = time.perf_counter()
+            s = sweep(state, deltas)
+            _ = np.asarray(jax.device_get(s.p_hi.ravel()[0:1]))
+            return K * ROUNDS, time.perf_counter() - t0
+
+        return _median_rate(once)
+
+    r_pallas = rate(make_sweep(lambda s, d: pallas_join.join_fused(s, d)))
+    r_xla = rate(make_sweep(pncount.join))
+    return {
+        "metric": "Pallas fused dense join (north-star shape; baseline = XLA dense join)",
+        "value": round(r_pallas, 1),
+        "unit": "merges/sec",
+        "vs_baseline": round(r_pallas / r_xla, 2),
+    }
+
+
 CONFIGS = {
     "gcount-smoke": config_gcount_smoke,
     "pncount-100k": config_pncount_100k,
@@ -478,6 +528,7 @@ CONFIGS = {
     "tlog-trim": config_tlog_trim,
     "ujson-32": config_ujson_32,
     "codec-native": config_codec_native,
+    "pallas-join": config_pallas_join,
 }
 
 

@@ -1,0 +1,35 @@
+"""Pallas fused dense join vs the XLA/oracle join — interpret mode on the
+CPU harness (real-TPU compilation is exercised by bench.py --config
+pallas-join)."""
+
+import numpy as np
+import pytest
+
+import jylis_tpu  # noqa: F401
+from jylis_tpu.ops import pallas_join, pncount
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_join_fused_matches_oracle(seed):
+    rng = np.random.default_rng(seed)
+    K, R = 1600, 32  # rows = K*R/128 = 400 = one BLOCK_ROWS tile
+    state = pncount.from_counts(
+        rng.integers(0, 1 << 60, (K, R)).astype(np.uint64),
+        rng.integers(0, 1 << 60, (K, R)).astype(np.uint64),
+    )
+    deltas = pncount.from_counts(
+        rng.integers(0, 1 << 60, (K, R)).astype(np.uint64),
+        rng.integers(0, 1 << 60, (K, R)).astype(np.uint64),
+    )
+    assert pallas_join.supported(state)
+    want = pncount.join(state, deltas)
+    # join_fused donates its state arg: hand it a copy
+    state2 = pncount.PNCountState(*(p.copy() for p in state))
+    got = pallas_join.join_fused(state2, deltas, interpret=True)
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(np.asarray(w), np.asarray(g))
+
+
+def test_supported_rejects_odd_shapes():
+    st = pncount.init(100, 3)
+    assert not pallas_join.supported(st)
