@@ -1,0 +1,265 @@
+"""UJSON ORSWOT join as batched device kernels.
+
+The host lattice (`ops/ujson_host.py`) is authoritative for serving —
+documents are small and pointer-heavy. What DOES tensorise is the
+anti-entropy fan-in (docs/_docs/types/ujson.md:134-182 semantics,
+reference loop repo_ujson.pony:96-110): joining many deltas into many
+replica documents, where the per-entry set operations dominate. This
+module represents a batch of documents as padded per-row tensors and
+implements the ORSWOT join as sorted-set ops:
+
+* ``dots (B, L) uint64`` — each entry's causal dot packed as
+  ``(replica_col << 32) | seq``, sorted ascending per row, ``PAD``
+  (2^64-1) in unused slots. Replica ids (64-bit hashes) are interned to
+  columns on the host, exactly like the counter repos; seqs are bounded
+  to u32 on the device path (the host lattice keeps unbounded ints — a
+  document that ever exceeds 2^32-1 mutations from one replica stays on
+  the host path).
+* ``pay (B, L) int32`` — interned (path, value-token) payload id; -1 pad.
+  Dots name payloads immutably (a dot's (path, value) never changes), so
+  the join only moves ids and the host interner resolves them back.
+* ``vv (B, R) uint32`` — per-replica-column contiguous causal max.
+* ``cloud (B, C) uint64`` — context dots beyond the vv, sorted, PAD pad.
+  Device joins never compact cloud→vv (that bookkeeping is sequential
+  and host-cheap); coverage stays exact because ``contains`` checks the
+  union vv ∪ cloud either way.
+
+Join of rows a, b (the documented add-wins rule):
+  keep an a-entry iff it is also in b, or b's context never observed it;
+  add a b-entry iff a doesn't hold it and a's context never observed it.
+Membership tests are ``searchsorted`` probes on the sorted dot rows;
+coverage is a vv gather + compare plus a cloud probe; the surviving
+entries merge by one concat + sort per side pair. Everything is static
+shape: output widths are the (padded) sums of the input widths, and the
+host re-buckets between rounds.
+
+``fold_deltas`` is where the TPU earns its keep: the join is associative
+and commutative, so N deltas fold pairwise in ceil(log2 N) batched
+device calls instead of N sequential host merges, and the folded delta
+then joins every replica in ONE batched call (`bench.py --config
+ujson-32`).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.batching import bucket
+
+U64 = jnp.uint64
+U32 = jnp.uint32
+I32 = jnp.int32
+
+PAD = np.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+class DocBatch(NamedTuple):
+    """B documents as padded device tensors (see module docstring)."""
+
+    dots: jax.Array  # (B, L) uint64, sorted per row, PAD-padded
+    pay: jax.Array  # (B, L) int32, -1 pad
+    vv: jax.Array  # (B, R) uint32
+    cloud: jax.Array  # (B, C) uint64, sorted per row, PAD-padded
+
+
+def pack_dot(rid_col: int, seq: int) -> int:
+    return (rid_col << 32) | seq
+
+
+def unpack_dot(dot: int) -> tuple[int, int]:
+    return dot >> 32, dot & 0xFFFFFFFF
+
+
+def _member(sorted_row, queries):
+    """For each query, is it present in the sorted (PAD-padded) row?"""
+    idx = jnp.searchsorted(sorted_row, queries)
+    idx = jnp.minimum(idx, sorted_row.shape[-1] - 1)
+    return sorted_row[idx] == queries
+
+
+def _covered(vv, cloud, dots):
+    """ctx.contains for each dot: seq <= vv[rid] or dot in cloud."""
+    rid = (dots >> jnp.uint64(32)).astype(I32)
+    seq = (dots & jnp.uint64(0xFFFFFFFF)).astype(U32)
+    # PAD rows gather rid 2^31-ish; clamp and rely on callers masking pads
+    rid = jnp.minimum(rid, vv.shape[-1] - 1)
+    return (seq <= vv[rid]) | _member(cloud, dots)
+
+
+def _sortmerge(row_a, pay_a, row_b, pay_b):
+    """Merge two masked rows into one sorted row (pays ride along)."""
+    dots = jnp.concatenate([row_a, row_b], axis=-1)
+    pays = jnp.concatenate([pay_a, pay_b], axis=-1)
+    order = jnp.argsort(dots)
+    return dots[order], pays[order]
+
+
+def _join_row(a_dots, a_pay, a_vv, a_cloud, b_dots, b_pay, b_vv, b_cloud):
+    valid_a = a_dots != PAD
+    valid_b = b_dots != PAD
+    keep_a = valid_a & (
+        _member(b_dots, a_dots) | ~_covered(b_vv, b_cloud, a_dots)
+    )
+    # no duplicate survivors: an added b-entry is by definition not in a
+    add_b = valid_b & ~_member(a_dots, b_dots) & ~_covered(a_vv, a_cloud, b_dots)
+    dots, pay = _sortmerge(
+        jnp.where(keep_a, a_dots, PAD),
+        jnp.where(keep_a, a_pay, -1),
+        jnp.where(add_b, b_dots, PAD),
+        jnp.where(add_b, b_pay, -1),
+    )
+    vv = jnp.maximum(a_vv, b_vv)
+    # context union; duplicates are harmless for coverage but dedup keeps
+    # growth linear: sort, blank repeats, resort
+    cl = jnp.sort(jnp.concatenate([a_cloud, b_cloud], axis=-1))
+    dup = jnp.concatenate([jnp.zeros((1,), bool), cl[1:] == cl[:-1]])
+    cloud = jnp.sort(jnp.where(dup, PAD, cl))
+    return dots, pay, vv, cloud
+
+
+@jax.jit
+def join_batch(a: DocBatch, b: DocBatch) -> DocBatch:
+    """Row-wise ORSWOT join of two document batches (row i joins row i).
+
+    Output widths are the sums of the input widths (static shapes); use
+    `compact` on the host to re-bucket when they grow past the live size.
+    """
+    return DocBatch(
+        *jax.vmap(_join_row)(
+            a.dots, a.pay, a.vv, a.cloud, b.dots, b.pay, b.vv, b.cloud
+        )
+    )
+
+
+def fold_deltas(batch: DocBatch) -> DocBatch:
+    """Fold all B rows into ONE document by pairwise tree join —
+    ceil(log2 B) batched device calls for a B-delta anti-entropy fan-in.
+    """
+    while batch.dots.shape[0] > 1:
+        n = batch.dots.shape[0]
+        half = n // 2
+        a = DocBatch(*(p[:half] for p in batch))
+        b = DocBatch(*(p[half : 2 * half] for p in batch))
+        joined = join_batch(a, b)
+        if n % 2:
+            joined = DocBatch(
+                *(
+                    jnp.concatenate([jp, _pad_to(lp[-1:], jp.shape[-1], pad)], axis=0)
+                    for jp, lp, pad in zip(
+                        joined, batch, (PAD, np.int32(-1), None, PAD)
+                    )
+                )
+            )
+        batch = joined
+    return batch
+
+
+def _pad_to(row, width, pad):
+    cur = row.shape[-1]
+    if cur == width:
+        return row
+    if pad is None:  # vv plane: widths never change
+        return row
+    fill = jnp.full(row.shape[:-1] + (width - cur,), pad, row.dtype)
+    return jnp.concatenate([row, fill], axis=-1)
+
+
+def broadcast_join(replicas: DocBatch, delta_row: DocBatch) -> DocBatch:
+    """Join ONE folded delta into every replica row in one batched call."""
+    b = replicas.dots.shape[0]
+    tiled = DocBatch(*(jnp.broadcast_to(p, (b,) + p.shape[1:]) for p in delta_row))
+    return join_batch(replicas, tiled)
+
+
+# ---- host-side encode / decode / compaction --------------------------------
+
+
+def encode_docs(docs, rid_cols: dict[int, int], pay_ids, n_rep: int) -> DocBatch:
+    """Pack host `UJSON` documents into one DocBatch.
+
+    rid_cols: replica-id -> column (shared, grows on host like the
+    counter repos' _rids). pay_ids: callable (path, token) -> int32 id.
+    """
+    rows = []
+    for doc in docs:
+        dots = []
+        for (rid, seq), (path, token) in doc.entries.items():
+            col = rid_cols.setdefault(rid, len(rid_cols))
+            if seq > 0xFFFFFFFF:
+                raise OverflowError("device path bounds seqs to u32")
+            dots.append((pack_dot(col, seq), pay_ids(path, token)))
+        vv = np.zeros(n_rep, np.uint32)
+        for rid, s in doc.ctx.vv.items():
+            col = rid_cols.setdefault(rid, len(rid_cols))
+            vv[col] = min(s, 0xFFFFFFFF)
+        cloud = []
+        for rid, seq in doc.ctx.cloud:
+            col = rid_cols.setdefault(rid, len(rid_cols))
+            cloud.append(pack_dot(col, seq))
+        rows.append((sorted(dots), vv, sorted(cloud)))
+    if len(rid_cols) > n_rep:
+        raise ValueError(f"n_rep {n_rep} too small for {len(rid_cols)} replicas")
+    wl = bucket(max((len(r[0]) for r in rows), default=1), 4)
+    wc = bucket(max((len(r[2]) for r in rows), default=1), 4)
+    b = len(rows)
+    dots = np.full((b, wl), PAD, np.uint64)
+    pay = np.full((b, wl), -1, np.int32)
+    vv = np.zeros((b, n_rep), np.uint32)
+    cloud = np.full((b, wc), PAD, np.uint64)
+    for i, (drow, vrow, crow) in enumerate(rows):
+        for j, (d, p) in enumerate(drow):
+            dots[i, j] = d
+            pay[i, j] = p
+        vv[i] = vrow
+        for j, c in enumerate(crow):
+            cloud[i, j] = c
+    return DocBatch(
+        jnp.asarray(dots), jnp.asarray(pay), jnp.asarray(vv), jnp.asarray(cloud)
+    )
+
+
+def decode_doc(batch: DocBatch, row: int, cols_rid, pay_lookup):
+    """Unpack one row back into a host `UJSON` (for reads / verification).
+
+    cols_rid: column -> replica id; pay_lookup: id -> (path, token).
+    """
+    from .ujson_host import UJSON
+
+    doc = UJSON()
+    dots = np.asarray(batch.dots[row])
+    pays = np.asarray(batch.pay[row])
+    for d, p in zip(dots, pays):
+        if d == PAD:
+            continue
+        col, seq = unpack_dot(int(d))
+        doc.entries[(cols_rid[col], seq)] = pay_lookup(int(p))
+    vv = np.asarray(batch.vv[row])
+    for col, s in enumerate(vv):
+        if s:
+            doc.ctx.vv[cols_rid[col]] = int(s)
+    for c in np.asarray(batch.cloud[row]):
+        if c != PAD:
+            col, seq = unpack_dot(int(c))
+            doc.ctx.cloud.add((cols_rid[col], seq))
+    doc.ctx.compact()
+    return doc
+
+
+def compact(batch: DocBatch) -> DocBatch:
+    """Host-side re-bucket: drop all-pad columns the joins accumulated."""
+    dots = np.asarray(batch.dots)
+    cloud = np.asarray(batch.cloud)
+    live_l = int((dots != PAD).sum(axis=1).max()) if dots.size else 1
+    live_c = int((cloud != PAD).sum(axis=1).max()) if cloud.size else 1
+    wl, wc = bucket(max(live_l, 1), 4), bucket(max(live_c, 1), 4)
+    return DocBatch(
+        jnp.asarray(dots[:, :wl]),
+        jnp.asarray(np.asarray(batch.pay)[:, :wl]),
+        batch.vv,
+        jnp.asarray(cloud[:, :wc]),
+    )

@@ -14,10 +14,12 @@ dots and every removed dot. Joins are: keep an entry iff it is present in
 both sides, or present in one side and its dot is NOT covered by the other
 side's context (i.e. the other side never observed it — it survives).
 
-This lattice lives on the host: its data volume per document is tiny and
-its structure is pointer-heavy; the TPU payoff in this system is the dense
-counter/register/log keyspaces (ops/gcount etc.). A device-batched join for
-large UJSON fan-ins is future work tracked in parallel/PLAN.md.
+This lattice lives on the host for SERVING: per-document data is tiny and
+pointer-heavy. The anti-entropy fan-in — joining many deltas into many
+replicas — is tensorised in ops/ujson_device.py (sorted packed-dot rows,
+vv planes, log-depth delta folds), differentially tested against this
+oracle and measured faster than the host loop on the 32-replica
+benchmark (bench.py --config ujson-32).
 
 Values are stored as canonical JSON tokens (the exact primitive serialisation,
 e.g. '"user"', '42', 'true', 'null') so value identity is representation

@@ -1,0 +1,161 @@
+"""Differential tests: device ORSWOT join vs the authoritative host
+lattice (ops/ujson_host.py) on random workloads."""
+
+import numpy as np
+import pytest
+
+import jylis_tpu  # noqa: F401
+from jylis_tpu.ops import ujson_device as dev
+from jylis_tpu.ops.ujson_host import UJSON
+
+
+class PayInterner:
+    def __init__(self):
+        self.ids = {}
+        self.rev = []
+
+    def __call__(self, path, token):
+        key = (path, token)
+        if key not in self.ids:
+            self.ids[key] = len(self.rev)
+            self.rev.append(key)
+        return self.ids[key]
+
+    def lookup(self, pid):
+        return self.rev[pid]
+
+
+def copy_doc(doc: UJSON) -> UJSON:
+    c = UJSON()
+    c.entries = dict(doc.entries)
+    c.ctx.vv = dict(doc.ctx.vv)
+    c.ctx.cloud = set(doc.ctx.cloud)
+    return c
+
+
+def random_mutations(rng, doc, replica, n_ops, delta=None):
+    paths = [("a",), ("b",), ("a", "x"), ("c", "y", "z")]
+    for _ in range(n_ops):
+        op = rng.integers(4)
+        path = paths[rng.integers(len(paths))]
+        if op == 0:
+            doc.set_doc(replica, path, str(int(rng.integers(100))), delta=delta)
+        elif op == 1:
+            doc.ins(replica, path, str(int(rng.integers(100))), delta=delta)
+        elif op == 2:
+            vals = [t for p, t in doc.entries.values() if p == path]
+            if vals:
+                doc.rm(replica, path, vals[0], delta=delta)
+        else:
+            doc.clr(replica, path, delta=delta)
+
+
+def roundtrip_join(a: UJSON, b: UJSON):
+    """Join a⊔b via the device kernels, decoded back to a host doc."""
+    pay = PayInterner()
+    rid_cols: dict[int, int] = {}
+    batch = dev.encode_docs([a, b], rid_cols, pay, n_rep=8)
+    one = dev.join_batch(
+        dev.DocBatch(*(p[:1] for p in batch)),
+        dev.DocBatch(*(p[1:] for p in batch)),
+    )
+    cols_rid = {c: r for r, c in rid_cols.items()}
+    return dev.decode_doc(one, 0, cols_rid, pay.lookup)
+
+
+def assert_same_doc(got: UJSON, want: UJSON):
+    assert got.entries == want.entries
+    # contexts may compact differently; what matters is identical coverage
+    dots = set(got.entries) | set(want.entries) | want.ctx.cloud | got.ctx.cloud
+    for r, s in list(want.ctx.vv.items()) + list(got.ctx.vv.items()):
+        dots.add((r, s))
+        dots.add((r, s + 1))
+    for d in dots:
+        assert got.ctx.contains(d) == want.ctx.contains(d), d
+    assert got.render() == want.render()
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_pairwise_join_matches_host(seed):
+    rng = np.random.default_rng(seed)
+    a, b = UJSON(), UJSON()
+    random_mutations(rng, a, replica=1, n_ops=12)
+    random_mutations(rng, b, replica=2, n_ops=12)
+    # partial cross-knowledge: b sees an early snapshot of a
+    snap = copy_doc(a)
+    random_mutations(rng, a, replica=1, n_ops=6)
+    b.converge(snap)
+    random_mutations(rng, b, replica=2, n_ops=6)
+
+    want = copy_doc(a)
+    want.converge(b)
+    got = roundtrip_join(a, b)
+    assert_same_doc(got, want)
+
+
+def test_add_wins_concurrent_rm_ins():
+    """The documented add-wins case (ujson.md:134-182): concurrent RM and
+    re-INS of the same (path, value) — the insert survives the join."""
+    a, b = UJSON(), UJSON()
+    a.ins(1, ("tags",), '"blue"')
+    b.converge(copy_doc(a))
+    da, db = UJSON(), UJSON()
+    a.rm(1, ("tags",), '"blue"', delta=da)
+    b.ins(2, ("tags",), '"blue"', delta=db)
+
+    want = copy_doc(a)
+    want.converge(b)
+    got = roundtrip_join(a, b)
+    assert_same_doc(got, want)
+    assert got.render(("tags",)) == '"blue"'
+
+
+@pytest.mark.parametrize("n_rep,edits", [(8, 10), (16, 5)])
+def test_fold_deltas_matches_sequential_convergence(n_rep, edits):
+    """The anti-entropy fan-in: fold all deltas on device in log depth,
+    broadcast-join into every replica, compare against the host oracle
+    converging every delta sequentially."""
+    rng = np.random.default_rng(7)
+    replicas = [UJSON() for _ in range(n_rep)]
+    deltas = []
+    for r, doc in enumerate(replicas):
+        for _ in range(edits):
+            d = UJSON()
+            random_mutations(rng, doc, replica=r, n_ops=1, delta=d)
+            deltas.append(d)
+
+    # host oracle: every replica converges every delta
+    want = [copy_doc(doc) for doc in replicas]
+    for doc in want:
+        for d in deltas:
+            doc.converge(d)
+    renders = {doc.render() for doc in want}
+    assert len(renders) == 1
+
+    pay = PayInterner()
+    rid_cols: dict[int, int] = {}
+    dbatch = dev.encode_docs(deltas, rid_cols, pay, n_rep=n_rep)
+    folded = dev.fold_deltas(dbatch)
+    rbatch = dev.encode_docs(replicas, rid_cols, pay, n_rep=n_rep)
+    joined = dev.broadcast_join(rbatch, folded)
+    cols_rid = {c: r for r, c in rid_cols.items()}
+    for i in range(n_rep):
+        got = dev.decode_doc(joined, i, cols_rid, pay.lookup)
+        assert_same_doc(got, want[i])
+
+
+def test_compact_preserves_rows():
+    a = UJSON()
+    a.ins(1, ("k",), "1")
+    a.ins(1, ("k",), "2")
+    b = UJSON()
+    b.ins(2, ("k",), "3")
+    pay = PayInterner()
+    rid_cols: dict[int, int] = {}
+    batch = dev.encode_docs([a, b], rid_cols, pay, n_rep=4)
+    wide = dev.join_batch(batch, batch)  # self-join doubles widths, no-op
+    slim = dev.compact(wide)
+    assert slim.dots.shape[-1] <= wide.dots.shape[-1]
+    cols_rid = {c: r for r, c in rid_cols.items()}
+    got_a = dev.decode_doc(slim, 0, cols_rid, pay.lookup)
+    assert_same_doc(got_a, a)
