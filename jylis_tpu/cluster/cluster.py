@@ -143,7 +143,11 @@ class Cluster:
         if self._tick % ANNOUNCE_EVERY == 0:
             self._broadcast_msg(MsgAnnounceAddrs(self._known_addrs.copy()))
         self._flush_held()
-        self._database.flush_deltas(self.broadcast_deltas)
+        # flush as a task taking each repo's lock: a repo mid-drain delays
+        # only its own flush, never the tick (eviction/announce/dial above)
+        asyncio.get_running_loop().create_task(
+            self._database.flush_deltas_async(self.broadcast_deltas)
+        )
         self._sync_actives()
 
     def _evict_idle(self) -> None:
@@ -241,7 +245,7 @@ class Cluster:
                     if active:
                         self._active_msg(conn, msg)
                     else:
-                        self._passive_msg(conn, msg)
+                        await self._passive_msg(conn, msg)
         except (ConnectionError, asyncio.CancelledError, FramingError):
             pass
         finally:
@@ -260,7 +264,7 @@ class Cluster:
         )
         self._drop(conn)
 
-    def _passive_msg(self, conn: _Conn, msg) -> None:
+    async def _passive_msg(self, conn: _Conn, msg) -> None:
         if isinstance(msg, MsgPong):
             return
         if isinstance(msg, MsgExchangeAddrs):
@@ -268,12 +272,15 @@ class Cluster:
             self._converge_addrs(msg.known_addrs)
             self._send(conn, MsgExchangeAddrs(self._known_addrs.copy()))
             return
-        if isinstance(msg, MsgAnnounceAddrs):
-            self._converge_addrs(msg.known_addrs)
+        if isinstance(msg, MsgPushDeltas):
+            # repo-lock-aware converge: waits out any in-flight threaded
+            # drain for this type; awaiting (not spawning) keeps peer
+            # backpressure and per-connection delta ordering
+            await self._database.converge_async((msg.name, list(msg.batch)))
             self._send(conn, MsgPong())
             return
-        if isinstance(msg, MsgPushDeltas):
-            self._database.converge_deltas((msg.name, list(msg.batch)))
+        if isinstance(msg, MsgAnnounceAddrs):
+            self._converge_addrs(msg.known_addrs)
             self._send(conn, MsgPong())
             return
         self._log.err() and self._log.e(

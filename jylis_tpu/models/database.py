@@ -43,6 +43,24 @@ class Database:
             return
         mgr.apply(resp, cmd)
 
+    async def apply_async(self, resp, cmd: list[bytes]) -> None:
+        """Serving path: per-repo locking + threaded drains (manager.py)."""
+        mgr = self._map.get(cmd[0]) if cmd else None
+        if mgr is None:
+            respond_help(resp, DATATYPE_HELP)
+            return
+        await mgr.apply_async(resp, cmd)
+
+    async def converge_async(self, deltas) -> None:
+        name, batch = deltas
+        mgr = self._map.get(name.encode() if isinstance(name, str) else name)
+        if mgr is not None:
+            await mgr.converge_async(batch)
+
+    async def flush_deltas_async(self, fn) -> None:
+        for mgr in self._map.values():
+            await mgr.flush_async(fn)
+
     def flush_deltas(self, fn) -> None:
         for mgr in self._map.values():
             mgr.flush_deltas(fn)

@@ -1,8 +1,7 @@
 """Per-type repo manager: dispatch, help-on-failure, proactive flush.
 
 Reference analog: RepoManagerCore (repo_manager.pony:36-108). The actor
-boundary becomes the asyncio event loop (one loop = strict per-node command
-ordering, the same guarantee one Pony actor per type gave within a type);
+boundary becomes the asyncio event loop plus a per-repo asyncio.Lock;
 what this class keeps is the behavioral contract:
 
 * shutdown flag rejects new commands with the SHUTDOWN error (:49-55),
@@ -11,14 +10,49 @@ what this class keeps is the behavioral contract:
   most once per 500 ms per repo (:68-84),
 * flush_deltas registers the delta sink and drains if non-empty (:86-90),
 * clean_shutdown stops intake and performs a final flush (:95-108).
+
+Concurrency (SURVEY.md §7(c) host↔device pipelining): commands that will
+hit the device (the repo's ``may_drain`` predicate) run in a worker
+thread via ``asyncio.to_thread`` so a multi-millisecond drain never
+stalls the event loop — other repos' commands, other client connections,
+and the cluster heartbeat all proceed. The per-repo lock is what the
+one-actor-per-type boundary becomes: every repo access (apply, cluster
+converge, heartbeat flush) serialises through it in FIFO order, so repo
+state is never touched concurrently with an offloaded drain, and
+per-repo command ordering is exactly the reference's. Replies from
+offloaded commands are buffered and replayed on the loop thread
+(transports are not thread-safe). The sync ``apply`` path remains for
+single-threaded callers (warmup, persistence restore, direct-drive
+tests and benchmarks).
 """
 
 from __future__ import annotations
 
+import asyncio
 import time
 
 from .base import ParseError
 from .help import respond_help
+
+
+class _ReplayResp:
+    """Records resp-protocol calls in a worker thread; replays them on the
+    event-loop thread afterwards."""
+
+    __slots__ = ("calls",)
+
+    def __init__(self):
+        self.calls: list = []
+
+    def __getattr__(self, name):
+        def record(*args):
+            self.calls.append((name, args))
+
+        return record
+
+    def replay(self, resp) -> None:
+        for name, args in self.calls:
+            getattr(resp, name)(*args)
 
 PROACTIVE_FLUSH_INTERVAL = 0.5  # seconds; repo_manager.pony:80
 
@@ -34,19 +68,49 @@ class RepoManager:
         self._deltas_fn = None
         self._last_proactive = None
         self._shutdown = False
+        self._lock = asyncio.Lock()
 
     def apply(self, resp, cmd: list[bytes]) -> None:
-        """cmd includes the routing word (cmd[0] == data type name)."""
+        """cmd includes the routing word (cmd[0] == data type name).
+        Single-threaded path — see module docstring."""
         if self._shutdown:
             resp.err(SHUTDOWN_ERR)
             return
+        if self._apply_core(resp, cmd):
+            self._maybe_proactive_flush()
+
+    def _apply_core(self, resp, cmd: list[bytes]) -> bool:
         try:
-            changed = self.repo.apply(resp, cmd[1:])
+            return self.repo.apply(resp, cmd[1:])
         except ParseError:
             respond_help(resp, self.help.render(cmd[1:]))
+            return False
+
+    async def apply_async(self, resp, cmd: list[bytes]) -> None:
+        """Serving path: device-bound commands offload to a thread under
+        the repo lock; host-only commands run inline (still under the
+        lock, so they never race an offloaded drain)."""
+        if self._shutdown:
+            resp.err(SHUTDOWN_ERR)
             return
-        if changed:
-            self._maybe_proactive_flush()
+        async with self._lock:
+            may = getattr(self.repo, "may_drain", None)
+            if may is not None and may(cmd[1:]):
+                replay = _ReplayResp()
+                changed = await asyncio.to_thread(self._apply_core, replay, cmd)
+                replay.replay(resp)
+            else:
+                changed = self._apply_core(resp, cmd)
+            if changed:
+                self._maybe_proactive_flush()
+
+    async def converge_async(self, batch) -> None:
+        async with self._lock:
+            self.converge_deltas(batch)
+
+    async def flush_async(self, fn) -> None:
+        async with self._lock:
+            self.flush_deltas(fn)
 
     def _maybe_proactive_flush(self) -> None:
         if self._deltas_fn is None:

@@ -148,6 +148,15 @@ class _CounterRepo:
     def deltas_size(self) -> int:
         return len(self._dirty)
 
+    def may_drain(self, args: list[bytes]) -> bool:
+        """Will this command hit the device? Only a GET over a row holding
+        un-drained FOREIGN deltas does (local writes keep the host value
+        cache exact); the server offloads such commands to a thread."""
+        if len(args) < 2 or args[0] != b"GET":
+            return False
+        row = self._keys.get(args[1])
+        return row is not None and row in self._foreign
+
 
 class RepoGCOUNT(_CounterRepo):
     name = "GCOUNT"

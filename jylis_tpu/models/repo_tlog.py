@@ -205,6 +205,19 @@ class RepoTLOG:
     def deltas_size(self) -> int:
         return len(self._deltas)
 
+    def may_drain(self, args: list[bytes]) -> bool:
+        """Device-bound commands the server offloads to a thread: trims
+        always dispatch a device call; reads only when deltas are pending
+        (quiescent reads serve from the host render/len/cut caches)."""
+        if not args:
+            return False
+        op = args[0]
+        if op in (b"TRIM", b"TRIMAT", b"CLR"):
+            return True
+        if op in (b"GET", b"SIZE", b"CUTOFF"):
+            return bool(self._pend_entries or self._pend_cutoff)
+        return False
+
     def flush_deltas(self):
         out = [
             (k, (d.latest(), d.cutoff)) for k, d in sorted(self._deltas.items())

@@ -112,6 +112,11 @@ class RepoTREG:
     def deltas_size(self) -> int:
         return len(self._deltas)
 
+    def may_drain(self, args: list[bytes]) -> bool:
+        """GET drains when any writes/deltas are pending; the server
+        offloads those to a thread (manager.apply_async)."""
+        return bool(self._pending) and bool(args) and args[0] == b"GET"
+
     def flush_deltas(self):
         out = sorted(self._deltas.items())
         self._deltas.clear()

@@ -7,9 +7,11 @@ complete commands into Database.apply, and on protocol errors reply with an
 error and drop the connection (server_notify.pony:19-22).
 
 Concurrency model: the asyncio loop replaces the per-connection Pony
-actors; Database.apply is synchronous, which serialises command application
-exactly like the reference's one-actor-per-type does, while socket IO
-overlaps. Device batches are drained inside apply when a read needs them.
+actors. Commands apply through Database.apply_async — device-bound work
+runs in a worker thread under a per-repo lock (models/manager.py), so a
+slow drain stalls neither other connections nor the heartbeat. Within one
+connection commands complete strictly in order (RESP replies must match
+request order), which each connection's sequential await provides.
 """
 
 from __future__ import annotations
@@ -57,7 +59,7 @@ class Server:
                 parser.append(data)
                 try:
                     for cmd in parser:
-                        self._database.apply(resp, cmd)
+                        await self._database.apply_async(resp, cmd)
                 except RespError as e:
                     resp.err(str(e))
                     break

@@ -1,0 +1,158 @@
+"""Event-loop liveness under device drains (SURVEY.md §7(c)).
+
+A slow drain on one repo must not stall the loop: unrelated repos keep
+serving, the heartbeat keeps ticking, and per-repo ordering holds.
+Drains are made artificially slow by wrapping the repo's drain with a
+blocking sleep — the worker thread eats it, the loop must not.
+"""
+
+import asyncio
+import time
+
+import jylis_tpu  # noqa: F401
+from jylis_tpu.models.database import Database
+from jylis_tpu.server.server import Server
+from jylis_tpu.utils.config import Config
+from jylis_tpu.utils.log import Log
+
+from test_server import send_recv
+
+SLOW = 0.6  # seconds a slowed drain blocks its worker thread
+
+
+def make_server():
+    cfg = Config()
+    cfg.port = "0"
+    cfg.log = Log.create_none()
+    db = Database(identity=1)
+    return Server(cfg, db), db
+
+
+def slow_down_drain(db, name: str) -> None:
+    repo = db.manager(name).repo
+    orig = repo.drain
+
+    def slow_drain():
+        time.sleep(SLOW)
+        orig()
+
+    repo.drain = slow_drain
+
+
+def test_slow_drain_does_not_stall_unrelated_repo_or_loop():
+    async def main():
+        server, db = make_server()
+        await server.start()
+        try:
+            slow_down_drain(db, "GCOUNT")
+            # foreign delta: the next GCOUNT GET must drain (slowly)
+            db.manager("GCOUNT").repo.converge(b"k", {99: 5})
+
+            slow_task = asyncio.create_task(
+                send_recv(server.port, b"GCOUNT GET k\r\n")
+            )
+            await asyncio.sleep(0.05)  # let the slow GET enter its drain
+
+            # 1) an unrelated repo's command completes while the drain runs
+            t0 = time.monotonic()
+            out = await send_recv(server.port, b"PNCOUNT INC x 7\r\n")
+            fast_latency = time.monotonic() - t0
+            assert out == b"+OK\r\n"
+            assert fast_latency < SLOW / 2, fast_latency
+
+            # 2) the loop itself stays responsive (heartbeat-tick proxy)
+            t0 = time.monotonic()
+            await asyncio.sleep(0.05)
+            assert time.monotonic() - t0 < SLOW / 2
+
+            # 3) the slow GET still returns the converged value
+            assert await slow_task == b":5\r\n"
+        finally:
+            await server.dispose()
+
+    asyncio.run(main())
+
+
+def test_heartbeat_ticks_during_slow_drain():
+    """A real Heart attached to a flushing target keeps firing while a
+    drain occupies the worker thread (the tick only schedules the flush
+    task; the flush for the busy repo waits on its own lock)."""
+    from jylis_tpu.cluster.heart import Heart
+
+    async def main():
+        server, db = make_server()
+        await server.start()
+        ticks = []
+
+        class Target:
+            _log = None
+
+            def _heartbeat(self):
+                ticks.append(time.monotonic())
+                asyncio.get_running_loop().create_task(
+                    db.flush_deltas_async(lambda d: None)
+                )
+
+        try:
+            slow_down_drain(db, "GCOUNT")
+            db.manager("GCOUNT").repo.converge(b"k", {99: 5})
+            heart = Heart(Target(), 0.05)
+            heart.start()
+            slow_task = asyncio.create_task(
+                send_recv(server.port, b"GCOUNT GET k\r\n")
+            )
+            await asyncio.sleep(SLOW * 0.8)  # drain still in flight
+            heart.dispose()
+            # ≥ 0.48s of 50ms ticks: a blocked loop would produce ~1-2
+            assert len(ticks) >= 5, ticks
+            gaps = [b - a for a, b in zip(ticks, ticks[1:])]
+            assert max(gaps) < SLOW / 2, gaps
+            assert await slow_task == b":5\r\n"
+        finally:
+            await server.dispose()
+
+    asyncio.run(main())
+
+
+def test_same_repo_ordering_across_connections():
+    """FIFO repo lock: a write queued behind a slow foreign-delta GET
+    lands after it; the final read sees both."""
+
+    async def main():
+        server, db = make_server()
+        await server.start()
+        try:
+            slow_down_drain(db, "GCOUNT")
+            db.manager("GCOUNT").repo.converge(b"k", {99: 5})
+            slow_task = asyncio.create_task(
+                send_recv(server.port, b"GCOUNT GET k\r\n")
+            )
+            await asyncio.sleep(0.05)
+            out = await send_recv(server.port, b"GCOUNT INC k 2\r\n")
+            assert out == b"+OK\r\n"
+            assert await slow_task == b":5\r\n"  # GET ordered before INC
+            out = await send_recv(server.port, b"GCOUNT GET k\r\n")
+            assert out == b":7\r\n"
+        finally:
+            await server.dispose()
+
+    asyncio.run(main())
+
+
+def test_pipelined_connection_replies_stay_in_order():
+    """One connection pipelines a device-bound GET and host-only commands;
+    RESP replies must come back in request order."""
+
+    async def main():
+        server, db = make_server()
+        await server.start()
+        try:
+            slow_down_drain(db, "GCOUNT")
+            db.manager("GCOUNT").repo.converge(b"k", {99: 5})
+            payload = b"GCOUNT GET k\r\nPNCOUNT INC y 1\r\nPNCOUNT GET y\r\n"
+            out = await send_recv(server.port, payload, expect_len=14)
+            assert out == b":5\r\n+OK\r\n:1\r\n"
+        finally:
+            await server.dispose()
+
+    asyncio.run(main())
