@@ -3,13 +3,17 @@
 
 PY ?= python
 
-.PHONY: test bench native run clean check-graft
+.PHONY: test bench bench-all native run clean check-graft
 
 test:
 	$(PY) -m pytest tests/ -x -q
 
 bench:
 	$(PY) bench.py
+
+# every BASELINE config, one JSON line each (north star first)
+bench-all:
+	$(PY) bench.py --all
 
 # build the native codecs explicitly (they also build lazily on import)
 native:

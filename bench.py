@@ -118,8 +118,9 @@ def config_gcount_smoke() -> dict:
     serving reads. Baseline: the reference's per-command work (data +
     delta-state map updates, value sum) on the host lattice. This config
     is a dispatch smoke — single-key commands never touch the batched
-    merge path where the TPU wins (the north star), so vs_baseline ~1x is
-    the expected posture, not a target."""
+    merge path where the TPU wins (the north star), so the expected
+    posture is sub-1x (measured ~0.3-0.5x: full command routing against
+    a bare dict loop), not a target."""
     from jylis_tpu.models.database import Database, _NullRespond
     from jylis_tpu.ops.hostref import GCounter
 
@@ -423,18 +424,18 @@ def config_ujson_32() -> dict:
         t0 = time.perf_counter()
         pay = _Pay()
         rid_cols: dict[int, int] = {}
-        dbatch = dev.encode_docs(deltas, rid_cols, pay, n_rep=n_rep)
-        folded = dev.fold_deltas(dbatch)
-        rbatch = dev.encode_docs(replicas, rid_cols, pay, n_rep=n_rep)
-        joined = dev.broadcast_join(rbatch, folded)
+        shift = dev.plan_shift(deltas + replicas, n_rep=n_rep)
+        dbatch = dev.encode_docs(deltas, rid_cols, pay, n_rep=n_rep, shift=shift)
+        rbatch = dev.encode_docs(replicas, rid_cols, pay, n_rep=n_rep, shift=shift)
+        joined = dev.fold_and_broadcast(rbatch, dbatch, shift=shift)
         import jax
 
         jax.block_until_ready(joined.dots)
         dt = time.perf_counter() - t0
         cols_rid = {c: r for r, c in rid_cols.items()}
         renders = {
-            dev.decode_doc(joined, i, cols_rid, pay.lookup).render()
-            for i in range(n_rep)
+            doc.render()
+            for doc in dev.decode_batch(joined, cols_rid, pay.lookup, shift=shift)
         }
         assert len(renders) == 1, "replicas diverged"
         return n_rep * len(deltas), dt

@@ -8,21 +8,23 @@ replica documents, where the per-entry set operations dominate. This
 module represents a batch of documents as padded per-row tensors and
 implements the ORSWOT join as sorted-set ops:
 
-* ``dots (B, L) uint64`` — each entry's causal dot packed as
-  ``(replica_col << 32) | seq``, sorted ascending per row, ``PAD``
-  (2^64-1) in unused slots. Replica ids (64-bit hashes) are interned to
-  columns on the host, exactly like the counter repos; seqs are bounded
-  to u32 on the device path (the host lattice keeps unbounded ints — a
-  document that ever exceeds 2^32-1 mutations from one replica stays on
-  the host path).
+* ``dots (B, L)`` — each entry's causal dot packed as
+  ``(replica_col << shift) | seq``, sorted ascending per row, pad-filled.
+  Replica ids (64-bit hashes) are interned to columns on the host,
+  exactly like the counter repos. The dtype is ADAPTIVE per batch:
+  when every seq fits in ``31 - ceil(log2 R)`` bits the dots pack into
+  native-sortable **int32** (TPUs have no 64-bit datapath; u64 sorts
+  emulate compares and dominated the join's cost when this module used
+  them unconditionally), otherwise uint64 with shift 32. The shift is a
+  static jit parameter, so each layout compiles its own kernels.
 * ``pay (B, L) int32`` — interned (path, value-token) payload id; -1 pad.
   Dots name payloads immutably (a dot's (path, value) never changes), so
   the join only moves ids and the host interner resolves them back.
 * ``vv (B, R) uint32`` — per-replica-column contiguous causal max.
-* ``cloud (B, C) uint64`` — context dots beyond the vv, sorted, PAD pad.
-  Device joins never compact cloud→vv (that bookkeeping is sequential
-  and host-cheap); coverage stays exact because ``contains`` checks the
-  union vv ∪ cloud either way.
+* ``cloud (B, C)`` — context dots beyond the vv, sorted, pad-filled (same
+  dtype as ``dots``). Device joins never compact cloud→vv (that
+  bookkeeping is sequential and host-cheap); coverage stays exact
+  because ``contains`` checks the union vv ∪ cloud either way.
 
 Join of rows a, b (the documented add-wins rule):
   keep an a-entry iff it is also in b, or b's context never observed it;
@@ -31,11 +33,12 @@ Membership tests are ``searchsorted`` probes on the sorted dot rows;
 coverage is a vv gather + compare plus a cloud probe; the surviving
 entries merge by one concat + sort per side pair. Everything is static
 shape: output widths are the (padded) sums of the input widths, and the
-host re-buckets between rounds.
+host re-buckets between rounds (`compact`).
 
 ``fold_deltas`` is where the TPU earns its keep: the join is associative
-and commutative, so N deltas fold pairwise in ceil(log2 N) batched
-device calls instead of N sequential host merges, and the folded delta
+and commutative, so N deltas fold in ceil(log_8 N) batched device calls
+(8 rows reduce per launch — dispatch latency over the tunneled chip is
+per-launch) instead of N sequential host merges, and the folded delta
 then joins every replica in ONE batched call (`bench.py --config
 ujson-32`).
 """
@@ -55,38 +58,36 @@ U64 = jnp.uint64
 U32 = jnp.uint32
 I32 = jnp.int32
 
-PAD = np.uint64(0xFFFFFFFFFFFFFFFF)
+PAD64 = np.uint64(0xFFFFFFFFFFFFFFFF)
+PAD32 = np.int32(0x7FFFFFFF)
+
+
+def _pad_of(dtype) -> np.generic:
+    return PAD32 if np.dtype(dtype) == np.int32 else PAD64
 
 
 class DocBatch(NamedTuple):
     """B documents as padded device tensors (see module docstring)."""
 
-    dots: jax.Array  # (B, L) uint64, sorted per row, PAD-padded
+    dots: jax.Array  # (B, L) int32 or uint64, sorted per row, pad-filled
     pay: jax.Array  # (B, L) int32, -1 pad
     vv: jax.Array  # (B, R) uint32
-    cloud: jax.Array  # (B, C) uint64, sorted per row, PAD-padded
-
-
-def pack_dot(rid_col: int, seq: int) -> int:
-    return (rid_col << 32) | seq
-
-
-def unpack_dot(dot: int) -> tuple[int, int]:
-    return dot >> 32, dot & 0xFFFFFFFF
+    cloud: jax.Array  # (B, C) same dtype as dots, sorted, pad-filled
 
 
 def _member(sorted_row, queries):
-    """For each query, is it present in the sorted (PAD-padded) row?"""
+    """For each query, is it present in the sorted (pad-filled) row?"""
     idx = jnp.searchsorted(sorted_row, queries)
     idx = jnp.minimum(idx, sorted_row.shape[-1] - 1)
     return sorted_row[idx] == queries
 
 
-def _covered(vv, cloud, dots):
+def _covered(vv, cloud, dots, shift):
     """ctx.contains for each dot: seq <= vv[rid] or dot in cloud."""
-    rid = (dots >> jnp.uint64(32)).astype(I32)
-    seq = (dots & jnp.uint64(0xFFFFFFFF)).astype(U32)
-    # PAD rows gather rid 2^31-ish; clamp and rely on callers masking pads
+    dt = dots.dtype
+    rid = (dots >> dt.type(shift)).astype(I32)
+    seq = (dots & dt.type((1 << shift) - 1)).astype(U32)
+    # pad rows gather out of range; clamp and rely on callers masking pads
     rid = jnp.minimum(rid, vv.shape[-1] - 1)
     return (seq <= vv[rid]) | _member(cloud, dots)
 
@@ -99,100 +100,197 @@ def _sortmerge(row_a, pay_a, row_b, pay_b):
     return dots[order], pays[order]
 
 
-def _join_row(a_dots, a_pay, a_vv, a_cloud, b_dots, b_pay, b_vv, b_cloud):
-    valid_a = a_dots != PAD
-    valid_b = b_dots != PAD
+def _join_row(
+    a_dots, a_pay, a_vv, a_cloud, b_dots, b_pay, b_vv, b_cloud,
+    shift, sort_output=True,
+):
+    pad = _pad_of(a_dots.dtype)
+    valid_a = a_dots != pad
+    valid_b = b_dots != pad
     keep_a = valid_a & (
-        _member(b_dots, a_dots) | ~_covered(b_vv, b_cloud, a_dots)
+        _member(b_dots, a_dots) | ~_covered(b_vv, b_cloud, a_dots, shift)
     )
     # no duplicate survivors: an added b-entry is by definition not in a
-    add_b = valid_b & ~_member(a_dots, b_dots) & ~_covered(a_vv, a_cloud, b_dots)
-    dots, pay = _sortmerge(
-        jnp.where(keep_a, a_dots, PAD),
-        jnp.where(keep_a, a_pay, -1),
-        jnp.where(add_b, b_dots, PAD),
-        jnp.where(add_b, b_pay, -1),
+    add_b = valid_b & ~_member(a_dots, b_dots) & ~_covered(
+        a_vv, a_cloud, b_dots, shift
     )
+    ka_dots = jnp.where(keep_a, a_dots, pad)
+    ka_pay = jnp.where(keep_a, a_pay, -1)
+    ab_dots = jnp.where(add_b, b_dots, pad)
+    ab_pay = jnp.where(add_b, b_pay, -1)
+    if sort_output:
+        dots, pay = _sortmerge(ka_dots, ka_pay, ab_dots, ab_pay)
+    else:
+        # the sort is the join's dominant cost; a FINAL join whose output
+        # feeds no further searchsorted can skip it
+        dots = jnp.concatenate([ka_dots, ab_dots], axis=-1)
+        pay = jnp.concatenate([ka_pay, ab_pay], axis=-1)
     vv = jnp.maximum(a_vv, b_vv)
     # context union; duplicates are harmless for coverage but dedup keeps
     # growth linear: sort, blank repeats, resort
     cl = jnp.sort(jnp.concatenate([a_cloud, b_cloud], axis=-1))
     dup = jnp.concatenate([jnp.zeros((1,), bool), cl[1:] == cl[:-1]])
-    cloud = jnp.sort(jnp.where(dup, PAD, cl))
+    cloud = jnp.sort(jnp.where(dup, pad, cl))
     return dots, pay, vv, cloud
 
 
-@jax.jit
-def join_batch(a: DocBatch, b: DocBatch) -> DocBatch:
+@partial(jax.jit, static_argnames=("shift", "sort_output"))
+def join_batch(
+    a: DocBatch, b: DocBatch, shift: int = 32, sort_output: bool = True
+) -> DocBatch:
     """Row-wise ORSWOT join of two document batches (row i joins row i).
 
     Output widths are the sums of the input widths (static shapes); use
     `compact` on the host to re-bucket when they grow past the live size.
+    ``sort_output=False`` only when nothing will searchsorted-probe the
+    result (e.g. the last join before a host read-back).
     """
     return DocBatch(
-        *jax.vmap(_join_row)(
+        *jax.vmap(partial(_join_row, shift=shift, sort_output=sort_output))(
             a.dots, a.pay, a.vv, a.cloud, b.dots, b.pay, b.vv, b.cloud
         )
     )
 
 
-def fold_deltas(batch: DocBatch) -> DocBatch:
-    """Fold all B rows into ONE document by pairwise tree join —
-    ceil(log2 B) batched device calls for a B-delta anti-entropy fan-in.
-    """
+FOLD_ARITY = 8  # rows folded per unrolled fold level
+
+
+def _join_inside(a: DocBatch, b: DocBatch, shift: int) -> DocBatch:
+    return DocBatch(
+        *jax.vmap(partial(_join_row, shift=shift))(
+            a.dots, a.pay, a.vv, a.cloud, b.dots, b.pay, b.vv, b.cloud
+        )
+    )
+
+
+def _empty_rows(batch: DocBatch, n: int) -> DocBatch:
+    """n identity rows (no entries, empty context) at batch's widths."""
+    pad = _pad_of(batch.dots.dtype)
+    return DocBatch(
+        jnp.full((n, batch.dots.shape[-1]), pad, batch.dots.dtype),
+        jnp.full((n, batch.pay.shape[-1]), -1, I32),
+        jnp.zeros((n, batch.vv.shape[-1]), U32),
+        jnp.full((n, batch.cloud.shape[-1]), pad, batch.cloud.dtype),
+    )
+
+
+def _fold_body(batch: DocBatch, shift: int) -> DocBatch:
+    """Traceable full fold: the level loop unrolls at trace time (shapes
+    are static), so however many levels, the caller pays ONE dispatch."""
     while batch.dots.shape[0] > 1:
         n = batch.dots.shape[0]
-        half = n // 2
-        a = DocBatch(*(p[:half] for p in batch))
-        b = DocBatch(*(p[half : 2 * half] for p in batch))
-        joined = join_batch(a, b)
-        if n % 2:
-            joined = DocBatch(
-                *(
-                    jnp.concatenate([jp, _pad_to(lp[-1:], jp.shape[-1], pad)], axis=0)
-                    for jp, lp, pad in zip(
-                        joined, batch, (PAD, np.int32(-1), None, PAD)
-                    )
-                )
+        k = min(FOLD_ARITY, 1 << (n - 1).bit_length())
+        if n % k:
+            pad = _empty_rows(batch, k - n % k)
+            batch = DocBatch(
+                *(jnp.concatenate([p, q], axis=0) for p, q in zip(batch, pad))
             )
-        batch = joined
+            n = batch.dots.shape[0]
+        step = n // k
+        items = [
+            DocBatch(*(p[i * step : (i + 1) * step] for p in batch))
+            for i in range(k)
+        ]
+        while len(items) > 1:
+            items = [
+                _join_inside(items[i], items[i + 1], shift)
+                for i in range(0, len(items), 2)
+            ]
+        batch = items[0]
     return batch
 
 
-def _pad_to(row, width, pad):
-    cur = row.shape[-1]
-    if cur == width:
-        return row
-    if pad is None:  # vv plane: widths never change
-        return row
-    fill = jnp.full(row.shape[:-1] + (width - cur,), pad, row.dtype)
-    return jnp.concatenate([row, fill], axis=-1)
+@partial(jax.jit, static_argnames=("shift",))
+def fold_deltas(batch: DocBatch, shift: int = 32) -> DocBatch:
+    """Fold all B rows into ONE document in a single device dispatch (the
+    join is associative and commutative, so any fold shape converges
+    identically; FOLD_ARITY-wide levels keep the trace shallow)."""
+    return _fold_body(batch, shift)
 
 
-def broadcast_join(replicas: DocBatch, delta_row: DocBatch) -> DocBatch:
+def _tile(delta_row: DocBatch, b: int) -> DocBatch:
+    return DocBatch(
+        *(jnp.broadcast_to(p, (b,) + p.shape[1:]) for p in delta_row)
+    )
+
+
+def broadcast_join(
+    replicas: DocBatch,
+    delta_row: DocBatch,
+    shift: int = 32,
+    sort_output: bool = True,
+) -> DocBatch:
     """Join ONE folded delta into every replica row in one batched call."""
+    return join_batch(
+        replicas,
+        _tile(delta_row, replicas.dots.shape[0]),
+        shift=shift,
+        sort_output=sort_output,
+    )
+
+
+@partial(jax.jit, static_argnames=("shift", "sort_output"))
+def fold_and_broadcast(
+    replicas: DocBatch,
+    deltas: DocBatch,
+    shift: int = 32,
+    sort_output: bool = False,
+) -> DocBatch:
+    """The whole anti-entropy fan-in as ONE device program: fold all
+    delta rows, then join the result into every replica row. On a
+    tunneled chip the dominant cost is per-dispatch latency, so the
+    fold levels and the broadcast must not be separate launches."""
+    folded = _fold_body(deltas, shift)
     b = replicas.dots.shape[0]
-    tiled = DocBatch(*(jnp.broadcast_to(p, (b,) + p.shape[1:]) for p in delta_row))
-    return join_batch(replicas, tiled)
+    return DocBatch(
+        *jax.vmap(partial(_join_row, shift=shift, sort_output=sort_output))(
+            replicas.dots,
+            replicas.pay,
+            replicas.vv,
+            replicas.cloud,
+            *_tile(folded, b),
+        )
+    )
 
 
 # ---- host-side encode / decode / compaction --------------------------------
 
 
-def encode_docs(docs, rid_cols: dict[int, int], pay_ids, n_rep: int) -> DocBatch:
-    """Pack host `UJSON` documents into one DocBatch.
+def plan_shift(docs, n_rep: int) -> int:
+    """Pick the dot layout for a batch: int32 with the smallest workable
+    shift when every seq fits (native TPU sorts), else the u64/32 layout.
+    """
+    rid_bits = max(int(n_rep - 1).bit_length(), 1)
+    seq_bits = 31 - rid_bits
+    max_seq = 0
+    for doc in docs:
+        for _, s in doc.entries:
+            max_seq = max(max_seq, s)
+        for s in doc.ctx.vv.values():
+            max_seq = max(max_seq, s)
+        for _, s in doc.ctx.cloud:
+            max_seq = max(max_seq, s)
+    return seq_bits if max_seq < (1 << seq_bits) else 32
+
+
+def encode_docs(
+    docs, rid_cols: dict[int, int], pay_ids, n_rep: int, shift: int = 32
+) -> DocBatch:
+    """Pack host `UJSON` documents into one DocBatch at the given layout
+    (see `plan_shift`).
 
     rid_cols: replica-id -> column (shared, grows on host like the
     counter repos' _rids). pay_ids: callable (path, token) -> int32 id.
     """
+    seq_cap = 1 << shift
     rows = []
     for doc in docs:
         dots = []
         for (rid, seq), (path, token) in doc.entries.items():
             col = rid_cols.setdefault(rid, len(rid_cols))
-            if seq > 0xFFFFFFFF:
-                raise OverflowError("device path bounds seqs to u32")
-            dots.append((pack_dot(col, seq), pay_ids(path, token)))
+            if seq >= seq_cap:
+                raise OverflowError(f"seq {seq} needs a wider layout than {shift}")
+            dots.append(((col << shift) | seq, pay_ids(path, token)))
         vv = np.zeros(n_rep, np.uint32)
         for rid, s in doc.ctx.vv.items():
             col = rid_cols.setdefault(rid, len(rid_cols))
@@ -200,17 +298,21 @@ def encode_docs(docs, rid_cols: dict[int, int], pay_ids, n_rep: int) -> DocBatch
         cloud = []
         for rid, seq in doc.ctx.cloud:
             col = rid_cols.setdefault(rid, len(rid_cols))
-            cloud.append(pack_dot(col, seq))
+            if seq >= seq_cap:
+                raise OverflowError(f"seq {seq} needs a wider layout than {shift}")
+            cloud.append((col << shift) | seq)
         rows.append((sorted(dots), vv, sorted(cloud)))
     if len(rid_cols) > n_rep:
         raise ValueError(f"n_rep {n_rep} too small for {len(rid_cols)} replicas")
+    dtype = np.int32 if shift < 32 else np.uint64
+    pad = _pad_of(dtype)
     wl = bucket(max((len(r[0]) for r in rows), default=1), 4)
     wc = bucket(max((len(r[2]) for r in rows), default=1), 4)
     b = len(rows)
-    dots = np.full((b, wl), PAD, np.uint64)
+    dots = np.full((b, wl), pad, dtype)
     pay = np.full((b, wl), -1, np.int32)
     vv = np.zeros((b, n_rep), np.uint32)
-    cloud = np.full((b, wc), PAD, np.uint64)
+    cloud = np.full((b, wc), pad, dtype)
     for i, (drow, vrow, crow) in enumerate(rows):
         for j, (d, p) in enumerate(drow):
             dots[i, j] = d
@@ -223,39 +325,54 @@ def encode_docs(docs, rid_cols: dict[int, int], pay_ids, n_rep: int) -> DocBatch
     )
 
 
-def decode_doc(batch: DocBatch, row: int, cols_rid, pay_lookup):
-    """Unpack one row back into a host `UJSON` (for reads / verification).
+def decode_batch(batch: DocBatch, cols_rid, pay_lookup, shift: int = 32) -> list:
+    """Unpack every row back into host `UJSON` docs (reads/verification).
 
     cols_rid: column -> replica id; pay_lookup: id -> (path, token).
+    Each plane transfers device->host exactly ONCE — per-row pulls would
+    pay the (tunneled) dispatch latency B×4 times.
     """
     from .ujson_host import UJSON
 
-    doc = UJSON()
-    dots = np.asarray(batch.dots[row])
-    pays = np.asarray(batch.pay[row])
-    for d, p in zip(dots, pays):
-        if d == PAD:
-            continue
-        col, seq = unpack_dot(int(d))
-        doc.entries[(cols_rid[col], seq)] = pay_lookup(int(p))
-    vv = np.asarray(batch.vv[row])
-    for col, s in enumerate(vv):
-        if s:
-            doc.ctx.vv[cols_rid[col]] = int(s)
-    for c in np.asarray(batch.cloud[row]):
-        if c != PAD:
-            col, seq = unpack_dot(int(c))
-            doc.ctx.cloud.add((cols_rid[col], seq))
-    doc.ctx.compact()
-    return doc
+    pad = _pad_of(np.asarray(batch.dots).dtype)
+    mask = (1 << shift) - 1
+    all_dots = np.asarray(batch.dots)
+    all_pays = np.asarray(batch.pay)
+    all_vv = np.asarray(batch.vv)
+    all_cloud = np.asarray(batch.cloud)
+    docs = []
+    for row in range(all_dots.shape[0]):
+        doc = UJSON()
+        for d, p in zip(all_dots[row], all_pays[row]):
+            if d == pad:
+                continue
+            d = int(d)
+            doc.entries[(cols_rid[d >> shift], d & mask)] = pay_lookup(int(p))
+        for col, s in enumerate(all_vv[row]):
+            if s:
+                doc.ctx.vv[cols_rid[col]] = int(s)
+        for c in all_cloud[row]:
+            if c != pad:
+                c = int(c)
+                doc.ctx.cloud.add((cols_rid[c >> shift], c & mask))
+        doc.ctx.compact()
+        docs.append(doc)
+    return docs
+
+
+def decode_doc(batch: DocBatch, row: int, cols_rid, pay_lookup, shift: int = 32):
+    """Single-row convenience wrapper over `decode_batch`."""
+    one = DocBatch(*(p[row : row + 1] for p in batch))
+    return decode_batch(one, cols_rid, pay_lookup, shift=shift)[0]
 
 
 def compact(batch: DocBatch) -> DocBatch:
     """Host-side re-bucket: drop all-pad columns the joins accumulated."""
     dots = np.asarray(batch.dots)
     cloud = np.asarray(batch.cloud)
-    live_l = int((dots != PAD).sum(axis=1).max()) if dots.size else 1
-    live_c = int((cloud != PAD).sum(axis=1).max()) if cloud.size else 1
+    pad = _pad_of(dots.dtype)
+    live_l = int((dots != pad).sum(axis=1).max()) if dots.size else 1
+    live_c = int((cloud != pad).sum(axis=1).max()) if cloud.size else 1
     wl, wc = bucket(max(live_l, 1), 4), bucket(max(live_c, 1), 4)
     return DocBatch(
         jnp.asarray(dots[:, :wl]),

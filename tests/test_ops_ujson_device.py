@@ -50,17 +50,21 @@ def random_mutations(rng, doc, replica, n_ops, delta=None):
             doc.clr(replica, path, delta=delta)
 
 
-def roundtrip_join(a: UJSON, b: UJSON):
-    """Join a⊔b via the device kernels, decoded back to a host doc."""
+def roundtrip_join(a: UJSON, b: UJSON, shift=None):
+    """Join a⊔b via the device kernels, decoded back to a host doc.
+    shift=None plans the layout (int32 when it fits); 32 forces u64."""
     pay = PayInterner()
     rid_cols: dict[int, int] = {}
-    batch = dev.encode_docs([a, b], rid_cols, pay, n_rep=8)
+    if shift is None:
+        shift = dev.plan_shift([a, b], n_rep=8)
+    batch = dev.encode_docs([a, b], rid_cols, pay, n_rep=8, shift=shift)
     one = dev.join_batch(
         dev.DocBatch(*(p[:1] for p in batch)),
         dev.DocBatch(*(p[1:] for p in batch)),
+        shift=shift,
     )
     cols_rid = {c: r for r, c in rid_cols.items()}
-    return dev.decode_doc(one, 0, cols_rid, pay.lookup)
+    return dev.decode_doc(one, 0, cols_rid, pay.lookup, shift=shift)
 
 
 def assert_same_doc(got: UJSON, want: UJSON):
@@ -76,7 +80,8 @@ def assert_same_doc(got: UJSON, want: UJSON):
 
 
 @pytest.mark.parametrize("seed", range(6))
-def test_pairwise_join_matches_host(seed):
+@pytest.mark.parametrize("shift", [None, 32])  # planned int32 + forced u64
+def test_pairwise_join_matches_host(seed, shift):
     rng = np.random.default_rng(seed)
     a, b = UJSON(), UJSON()
     random_mutations(rng, a, replica=1, n_ops=12)
@@ -89,8 +94,17 @@ def test_pairwise_join_matches_host(seed):
 
     want = copy_doc(a)
     want.converge(b)
-    got = roundtrip_join(a, b)
+    got = roundtrip_join(a, b, shift=shift)
     assert_same_doc(got, want)
+
+
+def test_plan_shift_narrow_and_wide():
+    a = UJSON()
+    a.ins(1, ("k",), "1")
+    assert dev.plan_shift([a], n_rep=8) == 31 - 3
+    big = UJSON()
+    big.ctx.vv[2] = 1 << 30  # seq too large for a narrow layout
+    assert dev.plan_shift([a, big], n_rep=8) == 32
 
 
 def test_add_wins_concurrent_rm_ins():
@@ -134,14 +148,23 @@ def test_fold_deltas_matches_sequential_convergence(n_rep, edits):
 
     pay = PayInterner()
     rid_cols: dict[int, int] = {}
-    dbatch = dev.encode_docs(deltas, rid_cols, pay, n_rep=n_rep)
-    folded = dev.fold_deltas(dbatch)
-    rbatch = dev.encode_docs(replicas, rid_cols, pay, n_rep=n_rep)
-    joined = dev.broadcast_join(rbatch, folded)
+    shift = dev.plan_shift(deltas + replicas, n_rep=n_rep)
+    dbatch = dev.encode_docs(deltas, rid_cols, pay, n_rep=n_rep, shift=shift)
+    folded = dev.compact(dev.fold_deltas(dbatch, shift=shift))
+    rbatch = dev.encode_docs(replicas, rid_cols, pay, n_rep=n_rep, shift=shift)
+    joined = dev.broadcast_join(rbatch, folded, shift=shift, sort_output=False)
     cols_rid = {c: r for r, c in rid_cols.items()}
-    for i in range(n_rep):
-        got = dev.decode_doc(joined, i, cols_rid, pay.lookup)
-        assert_same_doc(got, want[i])
+    for got, want_doc in zip(
+        dev.decode_batch(joined, cols_rid, pay.lookup, shift=shift), want
+    ):
+        assert_same_doc(got, want_doc)
+
+    # the single-dispatch fused path (what bench config 5 runs) agrees
+    fused = dev.fold_and_broadcast(rbatch, dbatch, shift=shift)
+    for got, want_doc in zip(
+        dev.decode_batch(fused, cols_rid, pay.lookup, shift=shift), want
+    ):
+        assert_same_doc(got, want_doc)
 
 
 def test_compact_preserves_rows():
@@ -152,10 +175,11 @@ def test_compact_preserves_rows():
     b.ins(2, ("k",), "3")
     pay = PayInterner()
     rid_cols: dict[int, int] = {}
-    batch = dev.encode_docs([a, b], rid_cols, pay, n_rep=4)
-    wide = dev.join_batch(batch, batch)  # self-join doubles widths, no-op
+    shift = dev.plan_shift([a, b], n_rep=4)
+    batch = dev.encode_docs([a, b], rid_cols, pay, n_rep=4, shift=shift)
+    wide = dev.join_batch(batch, batch, shift=shift)  # self-join: no-op
     slim = dev.compact(wide)
     assert slim.dots.shape[-1] <= wide.dots.shape[-1]
     cols_rid = {c: r for r, c in rid_cols.items()}
-    got_a = dev.decode_doc(slim, 0, cols_rid, pay.lookup)
+    got_a = dev.decode_doc(slim, 0, cols_rid, pay.lookup, shift=shift)
     assert_same_doc(got_a, a)
