@@ -3,7 +3,10 @@
 
 PY ?= python
 
-.PHONY: test bench bench-all native run clean check-graft
+.PHONY: test bench bench-all native run clean check-graft ci
+
+# what CI runs per commit (.github/workflows/ci.yml): hermetic on any host
+ci: native test check-graft
 
 test:
 	$(PY) -m pytest tests/ -x -q
