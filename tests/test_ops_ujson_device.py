@@ -167,6 +167,80 @@ def test_fold_deltas_matches_sequential_convergence(n_rep, edits):
         assert_same_doc(got, want_doc)
 
 
+def test_repo_device_fold_matches_host_loop(monkeypatch):
+    """RepoUJSON drains a big per-key fan-in through the device fold;
+    result must match a repo converging the same deltas on the host loop."""
+    from jylis_tpu.models import repo_ujson as mod
+
+    class _R:
+        def __init__(self):
+            self.vals = []
+
+        def string(self, s):
+            self.vals.append(s)
+
+        def ok(self):
+            pass
+
+    def build_deltas():
+        rng = np.random.default_rng(11)
+        src = [UJSON() for _ in range(6)]
+        out = []
+        for r, doc in enumerate(src):
+            for _ in range(4):
+                d = UJSON()
+                random_mutations(rng, doc, replica=r + 10, n_ops=1, delta=d)
+                out.append(d)
+        return out
+
+    deltas = build_deltas()
+
+    monkeypatch.setattr(mod, "DEVICE_FANIN_MIN", 4)  # force the device path
+    dev_repo = mod.RepoUJSON(identity=1)
+    for d in deltas:
+        dev_repo.converge(b"doc", d)
+    assert dev_repo.may_drain([b"GET", b"doc"])
+    r1 = _R()
+    dev_repo.apply(r1, [b"GET", b"doc"])
+
+    monkeypatch.setattr(mod, "DEVICE_FANIN_MIN", 10_000)  # host loop
+    host_repo = mod.RepoUJSON(identity=1)
+    for d in build_deltas():
+        host_repo.converge(b"doc", d)
+    assert not host_repo.may_drain([b"GET", b"doc"])
+    r2 = _R()
+    host_repo.apply(r2, [b"GET", b"doc"])
+
+    assert r1.vals == r2.vals and r1.vals[0] != ""
+
+
+def test_repo_observed_remove_sees_buffered_deltas(monkeypatch):
+    """RM after a buffered remote INS must observe (and remove) it —
+    mutators drain their key first."""
+    from jylis_tpu.models import repo_ujson as mod
+
+    class _R:
+        def __init__(self):
+            self.vals = []
+
+        def string(self, s):
+            self.vals.append(s)
+
+        def ok(self):
+            pass
+
+    remote = UJSON()
+    d = UJSON()
+    remote.ins(7, ("tags",), '"x"', delta=d)
+
+    repo = mod.RepoUJSON(identity=1)
+    repo.converge(b"doc", d)  # buffered, not yet observed
+    repo.apply(_R(), [b"RM", b"doc", b"tags", b'"x"'])
+    r = _R()
+    repo.apply(r, [b"GET", b"doc", b"tags"])
+    assert r.vals == [""]  # the RM observed the buffered INS
+
+
 def test_compact_preserves_rows():
     a = UJSON()
     a.ins(1, ("k",), "1")
