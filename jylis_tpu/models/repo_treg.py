@@ -20,6 +20,13 @@ import numpy as np
 
 from ..ops import planes, treg
 from ..ops.interner import Interner, prefix_rank
+from ..parallel import (
+    drain_sharded_treg,
+    patch_sharded_treg,
+    route_drain,
+    serving_mesh,
+    shard_vec,
+)
 from .base import ParseError, bucket, need, pad_rows, parse_u64
 from ..utils.metrics import timed_drain
 from .help import RepoHelp
@@ -53,15 +60,29 @@ class RepoTREG:
     name = "TREG"
     help = TREG_HELP
 
-    def __init__(self, identity: int, key_cap: int = 1024):
+    def __init__(self, identity: int, key_cap: int = 1024, mesh="auto"):
         # identity is ignored: LWW needs no replica identity (repo_treg.pony:15)
         self._keys: dict[bytes, int] = {}
-        self._key_cap = key_cap
-        self._state = treg.init(key_cap)
+        # mesh mode mirrors the counter repos (repo_counters.py): with >1
+        # visible device the five planes live keys-sharded and drains
+        # route through parallel/sharded.drain_sharded_treg
+        self._mesh = serving_mesh() if mesh == "auto" else mesh
+        self._n_shards = self._mesh.devices.size if self._mesh is not None else 1
+        self._key_cap = self._round_cap(key_cap)
+        self._state = self._place(treg.init(self._key_cap))
         self._interner = Interner()
         self._cache: dict[int, tuple[int, int]] = {}  # row -> (ts, vid)
         self._pending: dict[int, tuple[int, bytes]] = {}  # row -> (ts, value)
         self._deltas: dict[bytes, tuple[bytes, int]] = {}  # key -> (value, ts)
+
+    def _round_cap(self, k: int) -> int:
+        ns = self._n_shards
+        return -(-k // ns) * ns
+
+    def _place(self, state):
+        if self._mesh is None:
+            return state
+        return type(state)(*(shard_vec(self._mesh, p) for p in state))
 
     def _row_for(self, key: bytes) -> int:
         row = self._keys.get(key)
@@ -144,11 +165,15 @@ class RepoTREG:
     def drain(self) -> None:
         if not self._pending:
             return
-        cap = bucket(max(len(self._keys), 1), self._key_cap)
+        cap = self._round_cap(bucket(max(len(self._keys), 1), self._key_cap))
         if cap != self._key_cap:
             self._key_cap = cap
-            self._state = treg.grow(self._state, cap)
+            self._state = self._place(treg.grow(self._state, cap))
         rows = list(self._pending)
+        if self._mesh is not None:
+            self._drain_sharded(rows)
+            self._pending.clear()
+            return
         dense = len(rows) * DENSE_FRACTION >= self._key_cap
         b = self._key_cap if dense else bucket(len(rows))
         ki = pad_rows(b)
@@ -200,3 +225,48 @@ class RepoTREG:
         for row, slot in zip(rows, slots):
             self._cache[row] = (int(out_ts[slot]), int(out_vid[slot]))
         self._pending.clear()
+
+    def _drain_sharded(self, rows) -> None:
+        """Mesh-mode drain: payload columns [ts, rank, vid] route to the
+        key blocks; ties come back per slot and resolve on host exactly
+        like the single-chip path, patched with a routed vid scatter."""
+        payload = np.zeros((len(rows), 3), np.uint64)
+        values: dict[int, bytes] = {}
+        for i, row in enumerate(rows):
+            ts, value = self._pending[row]
+            payload[i, 0] = ts
+            payload[i, 1] = prefix_rank(value)
+            payload[i, 2] = self._interner.intern(value)  # vids are >= 0
+            values[row] = value
+        rps = self._key_cap // self._n_shards
+        lr, d_hi, d_lo, slots = route_drain(
+            np.asarray(rows, np.int64), payload, self._n_shards, rps
+        )
+        out = drain_sharded_treg(self._mesh, *self._state, lr, d_hi, d_lo)
+        self._state = treg.TRegState(*out[:5])
+        tie = np.asarray(out[5])
+        out_ts = planes.combine64_np(np.asarray(out[6]), np.asarray(out[7]))
+        out_vid = np.asarray(out[8]).copy()
+        patch_rows: list[int] = []
+        patch_vids: list[int] = []
+        for j, g in enumerate(slots):
+            if g < 0:
+                continue
+            row = int(g)
+            if tie[j]:
+                cur_val = self._interner.lookup(int(out_vid[j]))
+                if values[row] > cur_val:
+                    my_vid = self._interner.intern(values[row])
+                    patch_rows.append(row)
+                    patch_vids.append(my_vid)
+                    out_vid[j] = my_vid
+            self._cache[row] = (int(out_ts[j]), int(out_vid[j]))
+        if patch_rows:
+            pp = np.asarray(patch_vids, np.uint64).reshape(-1, 1)
+            lr2, _p_hi, p_lo, _slots = route_drain(
+                np.asarray(patch_rows, np.int64), pp, self._n_shards, rps
+            )
+            vid_new = patch_sharded_treg(
+                self._mesh, self._state.vid, lr2, p_lo[:, 0].astype(np.int32)
+            )
+            self._state = self._state._replace(vid=vid_new)

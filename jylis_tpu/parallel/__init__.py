@@ -17,22 +17,28 @@ from .sharded import (
     converge_sharded,
     drain_sharded_g,
     drain_sharded_pn,
+    drain_sharded_treg,
     join_replica_axis,
+    patch_sharded_treg,
     read_all_sharded,
     route_batch,
     route_drain,
     shard_plane,
+    shard_vec,
 )
 
 __all__ = [
     "make_mesh",
     "serving_mesh",
     "shard_plane",
+    "shard_vec",
     "route_batch",
     "route_drain",
     "converge_sharded",
     "drain_sharded_g",
     "drain_sharded_pn",
+    "drain_sharded_treg",
+    "patch_sharded_treg",
     "read_all_sharded",
     "join_replica_axis",
 ]

@@ -40,7 +40,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..utils.batching import bucket, pad_rows
-from ..ops import planes
+from ..ops import planes, treg
 
 U32 = jnp.uint32
 
@@ -50,6 +50,11 @@ def shard_plane(mesh, arr):
     evenly by the keys axis (pad capacity with zeros — the lattice
     identity — before calling)."""
     return jax.device_put(arr, NamedSharding(mesh, P("keys", None)))
+
+
+def shard_vec(mesh, arr):
+    """Place one (K,) vector keys-sharded on the mesh."""
+    return jax.device_put(arr, NamedSharding(mesh, P("keys")))
 
 
 def _route(key_idx, deltas, n_shards: int, rows_per_shard: int, bucket_width=False):
@@ -227,6 +232,77 @@ def drain_sharded_pn(mesh, p_hi, p_lo, n_hi, n_lo, local_rows, d_hi, d_lo):
             P("keys"),
         ),
     )(p_hi, p_lo, n_hi, n_lo, local_rows, d_hi, d_lo)
+
+
+# ---- TREG sharded drain ----------------------------------------------------
+#
+# TREG's keyspace is five (K,) planes (ops/treg.py). Deltas route through
+# the same `route_drain` machinery by packing each row's payload as u64
+# columns [ts, rank, vid]: rows from the repo's pending dict are UNIQUE,
+# so the router's max-coalesce is the identity and the payload columns
+# pass through untouched. On device the columns unpack into the plane
+# quintuple, the LWW compare-and-scatter runs per key block, and the
+# touched rows' (ts, vid) plus the prefix-rank tie flags come back
+# per-slot for the host cache / host tie resolution.
+
+
+def _local_drain_treg(ts_hi, ts_lo, rk_hi, rk_lo, vid, rows_blk, d_hi, d_lo):
+    state = treg.TRegState(ts_hi, ts_lo, rk_hi, rk_lo, vid)
+    d_vid = d_lo[:, 2].astype(jnp.int32)
+    state, tie = treg.converge_batch(
+        state, rows_blk, d_hi[:, 0], d_lo[:, 0], d_hi[:, 1], d_lo[:, 1], d_vid
+    )
+    out_ts_hi = state.ts_hi[rows_blk]
+    out_ts_lo = state.ts_lo[rows_blk]
+    out_vid = state.vid[rows_blk]
+    return (*state, tie, out_ts_hi, out_ts_lo, out_vid)
+
+
+@partial(jax.jit, static_argnames=("mesh",), donate_argnums=(1, 2, 3, 4, 5))
+def drain_sharded_treg(mesh, ts_hi, ts_lo, rk_hi, rk_lo, vid, local_rows, d_hi, d_lo):
+    """TREG sharded drain: LWW-join the routed batch into each device's
+    key block; returns (5 state planes, per-slot tie flags, per-slot
+    ts_hi/ts_lo/vid read-back)."""
+    return jax.shard_map(
+        _local_drain_treg,
+        mesh=mesh,
+        in_specs=(
+            P("keys"),
+            P("keys"),
+            P("keys"),
+            P("keys"),
+            P("keys"),
+            P("keys"),
+            P("keys", None),
+            P("keys", None),
+        ),
+        out_specs=(
+            P("keys"),
+            P("keys"),
+            P("keys"),
+            P("keys"),
+            P("keys"),
+            P("keys"),
+            P("keys"),
+            P("keys"),
+            P("keys"),
+        ),
+    )(ts_hi, ts_lo, rk_hi, rk_lo, vid, local_rows, d_hi, d_lo)
+
+
+def _local_patch_treg(vid, rows_blk, patch_vid):
+    return vid.at[rows_blk].set(patch_vid, mode="drop", unique_indices=True)
+
+
+@partial(jax.jit, static_argnames=("mesh",), donate_argnums=(1,))
+def patch_sharded_treg(mesh, vid, local_rows, patch_vid):
+    """Host-resolved prefix-rank ties scatter their winning vids back."""
+    return jax.shard_map(
+        _local_patch_treg,
+        mesh=mesh,
+        in_specs=(P("keys"), P("keys"), P("keys")),
+        out_specs=P("keys"),
+    )(vid, local_rows, patch_vid)
 
 
 def _tree_join(hi_blk, lo_blk):

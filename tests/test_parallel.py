@@ -171,6 +171,60 @@ def test_sharded_repo_grows_past_initial_capacity():
         assert r.vals == [(i + 1) + (7 if i == 0 else 0)]
 
 
+def test_sharded_treg_convergence_and_ties():
+    """TREG in mesh mode: two repos exchange deltas and agree, including
+    a same-timestamp value tie that the host must resolve by string order
+    (docs treg.md:56-63) through the routed patch scatter."""
+    from jylis_tpu.models.repo_treg import RepoTREG
+
+    class _T:
+        def __init__(self):
+            self.out = []
+
+        def ok(self):
+            pass
+
+        def null(self):
+            self.out.append(None)
+
+        def array_start(self, n):
+            pass
+
+        def string(self, s):
+            self.out.append(s)
+
+        def u64(self, v):
+            self.out.append(v)
+
+    a, b = RepoTREG(identity=1), RepoTREG(identity=2)
+    assert a._mesh is not None and a._n_shards == 8
+    assert len(a._state.vid.addressable_shards) == 8
+    rng = np.random.default_rng(5)
+    keys = [b"r%d" % i for i in range(200)]
+    model: dict[bytes, tuple[int, bytes]] = {}
+    for repo in (a, b):
+        for k in keys:
+            ts = int(rng.integers(1, 1000))
+            val = b"v%d" % rng.integers(100)
+            repo.apply(_T(), [b"SET", k, val, str(ts).encode()])
+            cur = model.get(k)
+            if cur is None or (ts, val) > cur:
+                model[k] = (ts, val)
+    # a tie: same ts, different values -> larger string wins on both nodes
+    a.apply(_T(), [b"SET", b"tie", b"apple", b"777"])
+    b.apply(_T(), [b"SET", b"tie", b"zebra", b"777"])
+    model[b"tie"] = (777, b"zebra")
+    for src, dst in ((a, b), (b, a)):
+        for key, delta in src.flush_deltas():
+            dst.converge(key, delta)
+    for repo in (a, b):
+        for k in keys + [b"tie"]:
+            t = _T()
+            repo.apply(t, [b"GET", k])
+            want_ts, want_val = model[k]
+            assert t.out == [want_val, want_ts], (k, t.out)
+
+
 def test_join_replica_axis_is_lattice_join():
     rng = np.random.default_rng(1)
     S, K = 8, 64  # 2 local rows per rep shard: exercises the local fold
