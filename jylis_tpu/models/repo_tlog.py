@@ -20,6 +20,14 @@ import numpy as np
 
 from ..ops import hostref, tlog
 from ..ops.interner import Interner, prefix_rank
+from ..parallel import (
+    drain_sharded_tlog,
+    route_drain64,
+    serving_mesh,
+    shard_plane,
+    shard_vec,
+    trim_sharded_tlog,
+)
 from .base import PAD_ROW, ParseError, bucket, need, parse_opt_count, parse_u64
 from ..utils.metrics import timed_drain
 from .help import RepoHelp
@@ -60,12 +68,19 @@ class RepoTLOG:
     name = "TLOG"
     help = TLOG_HELP
 
-    def __init__(self, identity: int, key_cap: int = 1024, len_cap: int = 16):
+    def __init__(
+        self, identity: int, key_cap: int = 1024, len_cap: int = 16, mesh="auto"
+    ):
         # identity unused: log entries carry no replica identity
         self._keys: dict[bytes, int] = {}
-        self._key_cap = key_cap
+        # mesh mode mirrors the counter/TREG repos: with >1 visible device
+        # the segment tensors live keys-sharded and drains/trims route
+        # through parallel/sharded
+        self._mesh = serving_mesh() if mesh == "auto" else mesh
+        self._n_shards = self._mesh.devices.size if self._mesh is not None else 1
+        self._key_cap = self._round_cap(key_cap)
         self._len_cap = len_cap
-        self._state = tlog.init(key_cap, len_cap)
+        self._state = self._place(tlog.init(self._key_cap, len_cap))
         self._interner = Interner()
         self._len_cache: dict[int, int] = {}  # row -> length
         self._cut_cache: dict[int, int] = {}  # row -> cutoff
@@ -78,6 +93,23 @@ class RepoTLOG:
         self._pend_entries: dict[int, list[tuple[int, bytes]]] = {}
         self._pend_cutoff: dict[int, int] = {}
         self._deltas: dict[bytes, hostref.TLog] = {}
+
+    def _round_cap(self, k: int) -> int:
+        """Key capacity must split evenly over the mesh's keys axis."""
+        ns = self._n_shards
+        return -(-k // ns) * ns
+
+    def _place(self, state):
+        """(Re-)place state tensors keys-sharded when a mesh is active."""
+        if self._mesh is None:
+            return state
+        return tlog.TLogState(
+            shard_plane(self._mesh, state.ts),
+            shard_plane(self._mesh, state.rank),
+            shard_plane(self._mesh, state.vid),
+            shard_vec(self._mesh, state.length),
+            shard_vec(self._mesh, state.cutoff),
+        )
 
     def _row_for(self, key: bytes) -> int:
         row = self._keys.get(key)
@@ -175,19 +207,34 @@ class RepoTLOG:
     def _device_trim(self, key: bytes, count: int) -> None:
         self.drain()
         row = self._row_for(key)
-        kcap = bucket(max(len(self._keys), 1), self._key_cap)
+        kcap = self._round_cap(bucket(max(len(self._keys), 1), self._key_cap))
         if kcap != self._key_cap:  # TRIM on a brand-new key grows the space
             self._key_cap = kcap
-            self._state = tlog.grow(self._state, kcap, self._len_cap)
-        b = bucket(1)
-        ki = np.full(b, PAD_ROW, np.int32)  # padding drops on scatter
-        counts = np.full(b, 1 << 62, np.int64)
-        ki[0] = row
-        counts[0] = count
-        self._state, lens, cuts = _trim(self._state, ki, counts)
-        self._render.pop(row, None)
-        self._len_cache[row] = int(np.asarray(lens)[0])
-        self._cut_cache[row] = int(np.asarray(cuts)[0])
+            self._state = self._place(tlog.grow(self._state, kcap, self._len_cap))
+        if self._mesh is not None:
+            lr, pay, slots = route_drain64(
+                np.asarray([row], np.int64),
+                np.asarray([[count]], np.uint64),
+                self._n_shards,
+                self._key_cap // self._n_shards,
+            )
+            out = trim_sharded_tlog(self._mesh, *self._state, lr, pay)
+            self._state = tlog.TLogState(*out[:5])
+            j = int(np.nonzero(slots >= 0)[0][0])
+            lens, cuts = np.asarray(out[5]), np.asarray(out[6])
+            self._render.pop(row, None)
+            self._len_cache[row] = int(lens[j])
+            self._cut_cache[row] = int(cuts[j])
+        else:
+            b = bucket(1)
+            ki = np.full(b, PAD_ROW, np.int32)  # padding drops on scatter
+            counts = np.full(b, 1 << 62, np.int64)
+            ki[0] = row
+            counts[0] = count
+            self._state, lens, cuts = _trim(self._state, ki, counts)
+            self._render.pop(row, None)
+            self._len_cache[row] = int(np.asarray(lens)[0])
+            self._cut_cache[row] = int(np.asarray(cuts)[0])
         self._delta_for(key).raise_cutoff(self._cut_cache[row])
 
     # -- lattice plumbing ---------------------------------------------------
@@ -258,7 +305,7 @@ class RepoTLOG:
             return
         rows = sorted(set(self._pend_entries) | set(self._pend_cutoff))
         # capacity: keys, then entry slots (worst case current + pending)
-        kcap = bucket(max(len(self._keys), 1), self._key_cap)
+        kcap = self._round_cap(bucket(max(len(self._keys), 1), self._key_cap))
         need_len = max(
             self._len_cache.get(r, 0) + len(self._pend_entries.get(r, ()))
             for r in rows
@@ -266,7 +313,10 @@ class RepoTLOG:
         lcap = bucket(max(need_len, 1), self._len_cap)
         if kcap != self._key_cap or lcap != self._len_cap:
             self._key_cap, self._len_cap = kcap, lcap
-            self._state = tlog.grow(self._state, kcap, lcap)
+            self._state = self._place(tlog.grow(self._state, kcap, lcap))
+        if self._mesh is not None:
+            self._drain_sharded(rows)
+            return
         while True:
             b = bucket(len(rows))
             ld = bucket(
@@ -300,6 +350,57 @@ class RepoTLOG:
                 self._render.pop(row, None)
                 self._len_cache[row] = int(lens[i])
                 self._cut_cache[row] = int(cuts[i])
+            self._pend_entries.clear()
+            self._pend_cutoff.clear()
+            return
+
+    def _drain_sharded(self, rows) -> None:
+        """Mesh-mode drain: per-row deltas route as u64 payload columns
+        [ts(ld) | rank(ld) | vid(ld) | cutoff]; the vmap'd merge runs per
+        key block with per-slot lengths/cutoffs read back in the same
+        launch. Same overflow-retry contract as the single-chip path."""
+        import jax.numpy as jnp
+
+        while True:
+            ld = bucket(
+                max((len(self._pend_entries.get(r, ())) for r in rows), default=1),
+                1,
+            )
+            payload = np.zeros((len(rows), 3 * ld + 1), np.uint64)
+            # empty vid slots must read back as -1, not id 0
+            payload[:, 2 * ld : 3 * ld] = np.uint64(0xFFFFFFFFFFFFFFFF)
+            for i, row in enumerate(rows):
+                for j, (ts, value) in enumerate(self._pend_entries.get(row, ())):
+                    payload[i, j] = ts
+                    payload[i, ld + j] = prefix_rank(value)
+                    payload[i, 2 * ld + j] = self._interner.intern(value)
+                payload[i, 3 * ld] = self._pend_cutoff.get(row, 0)
+            lr, pay, slots = route_drain64(
+                np.asarray(rows, np.int64),
+                payload,
+                self._n_shards,
+                self._key_cap // self._n_shards,
+            )
+            out = drain_sharded_tlog(
+                self._mesh, *self._state, lr, jnp.asarray(pay), ld
+            )
+            ovf = np.asarray(out[5])
+            if bool(ovf[slots >= 0].any()):
+                # retry from the retained pre-merge state with doubled slots
+                self._len_cap *= 2
+                self._state = self._place(
+                    tlog.grow(self._state, self._key_cap, self._len_cap)
+                )
+                continue
+            self._state = tlog.TLogState(*out[:5])
+            lens, cuts = np.asarray(out[6]), np.asarray(out[7])
+            for j, g in enumerate(slots):
+                if g < 0:
+                    continue
+                row = int(g)
+                self._render.pop(row, None)
+                self._len_cache[row] = int(lens[j])
+                self._cut_cache[row] = int(cuts[j])
             self._pend_entries.clear()
             self._pend_cutoff.clear()
             return

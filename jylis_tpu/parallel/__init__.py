@@ -17,14 +17,17 @@ from .sharded import (
     converge_sharded,
     drain_sharded_g,
     drain_sharded_pn,
+    drain_sharded_tlog,
     drain_sharded_treg,
     join_replica_axis,
     patch_sharded_treg,
     read_all_sharded,
     route_batch,
     route_drain,
+    route_drain64,
     shard_plane,
     shard_vec,
+    trim_sharded_tlog,
 )
 
 __all__ = [
@@ -39,6 +42,9 @@ __all__ = [
     "drain_sharded_pn",
     "drain_sharded_treg",
     "patch_sharded_treg",
+    "drain_sharded_tlog",
+    "trim_sharded_tlog",
+    "route_drain64",
     "read_all_sharded",
     "join_replica_axis",
 ]

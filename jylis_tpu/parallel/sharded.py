@@ -83,13 +83,9 @@ def _route(key_idx, deltas, n_shards: int, rows_per_shard: int, bucket_width=Fal
         local_deltas[s, :c] = deltas[sel]
         slot_rows[s, :c] = key_idx[sel]
         start += c
-    d_hi, d_lo = planes.split64_np(
-        local_deltas.reshape(n_shards * width, deltas.shape[-1])
-    )
     return (
         local_rows.reshape(n_shards * width),
-        d_hi,
-        d_lo,
+        local_deltas.reshape(n_shards * width, deltas.shape[-1]),
         slot_rows.reshape(n_shards * width),
     )
 
@@ -101,7 +97,8 @@ def route_batch(key_idx, deltas, n_shards: int, rows_per_shard: int):
     Duplicate keys are max-combined here (the device composite requires
     unique rows); padded slots carry PAD_ROW, which the scatter drops.
     """
-    local_rows, d_hi, d_lo, _ = _route(key_idx, deltas, n_shards, rows_per_shard)
+    local_rows, payload, _ = _route(key_idx, deltas, n_shards, rows_per_shard)
+    d_hi, d_lo = planes.split64_np(payload)
     return local_rows, d_hi, d_lo
 
 
@@ -110,6 +107,16 @@ def route_drain(key_idx, deltas, n_shards: int, rows_per_shard: int):
     bucketed to a power of two (bounds the jit cache over drain sizes) and
     the slot -> global-row map is returned so the host value cache can be
     refreshed from the per-slot sums the sharded drain kernels emit."""
+    local_rows, payload, slot_rows = _route(
+        key_idx, deltas, n_shards, rows_per_shard, bucket_width=True
+    )
+    d_hi, d_lo = planes.split64_np(payload)
+    return local_rows, d_hi, d_lo, slot_rows
+
+
+def route_drain64(key_idx, deltas, n_shards: int, rows_per_shard: int):
+    """`route_drain` for kernels that take u64 payload columns directly
+    (TLOG's segment tensors) instead of hi/lo u32 planes."""
     return _route(key_idx, deltas, n_shards, rows_per_shard, bucket_width=True)
 
 
@@ -303,6 +310,94 @@ def patch_sharded_treg(mesh, vid, local_rows, patch_vid):
         in_specs=(P("keys"), P("keys"), P("keys")),
         out_specs=P("keys"),
     )(vid, local_rows, patch_vid)
+
+
+# ---- TLOG sharded drain ----------------------------------------------------
+#
+# TLOG's keyspace is (K, L) u64 segment tensors + (K,) length/cutoff
+# vectors (ops/tlog.py). Deltas route as u64 payload columns
+# [ts(ld) | rank(ld) | vid(ld) | cutoff], unpacked per device block; the
+# vmap'd sort-dedup-mask merge runs shard-local. NOT donated: the caller
+# retries from the pre-merge state when a row overflows its slot budget.
+
+
+def _local_drain_tlog(ts, rank, vid, length, cutoff, rows_blk, payload, ld):
+    from ..ops import tlog as tlog_ops
+
+    state = tlog_ops.TLogState(ts, rank, vid, length, cutoff)
+    d_ts = payload[:, :ld]
+    d_rank = payload[:, ld : 2 * ld]
+    d_vid = payload[:, 2 * ld : 3 * ld].astype(jnp.int64)
+    d_cut = payload[:, 3 * ld]
+    st, ovf = tlog_ops.converge_batch(state, rows_blk, d_ts, d_rank, d_vid, d_cut)
+    return (*st, ovf, st.length[rows_blk], st.cutoff[rows_blk])
+
+
+@partial(jax.jit, static_argnames=("mesh", "ld"))
+def drain_sharded_tlog(mesh, ts, rank, vid, length, cutoff, local_rows, payload, ld):
+    """TLOG sharded drain; returns (5 state tensors, per-slot overflow
+    flags, per-slot lengths, per-slot cutoffs)."""
+    return jax.shard_map(
+        partial(_local_drain_tlog, ld=ld),
+        mesh=mesh,
+        in_specs=(
+            P("keys", None),
+            P("keys", None),
+            P("keys", None),
+            P("keys"),
+            P("keys"),
+            P("keys"),
+            P("keys", None),
+        ),
+        out_specs=(
+            P("keys", None),
+            P("keys", None),
+            P("keys", None),
+            P("keys"),
+            P("keys"),
+            P("keys"),
+            P("keys"),
+            P("keys"),
+        ),
+    )(ts, rank, vid, length, cutoff, local_rows, payload)
+
+
+def _local_trim_tlog(ts, rank, vid, length, cutoff, rows_blk, payload):
+    from ..ops import tlog as tlog_ops
+
+    counts = payload[:, 0].astype(jnp.int64)
+    st = tlog_ops.trim_batch(
+        tlog_ops.TLogState(ts, rank, vid, length, cutoff), rows_blk, counts
+    )
+    return (*st, st.length[rows_blk], st.cutoff[rows_blk])
+
+
+@partial(jax.jit, static_argnames=("mesh",), donate_argnums=(1, 2, 3, 4, 5))
+def trim_sharded_tlog(mesh, ts, rank, vid, length, cutoff, local_rows, payload):
+    """TLOG sharded TRIM/TRIMAT/CLR; the count rides as one routed u64
+    payload column (pad slots' rows are out of range and drop)."""
+    return jax.shard_map(
+        _local_trim_tlog,
+        mesh=mesh,
+        in_specs=(
+            P("keys", None),
+            P("keys", None),
+            P("keys", None),
+            P("keys"),
+            P("keys"),
+            P("keys"),
+            P("keys", None),
+        ),
+        out_specs=(
+            P("keys", None),
+            P("keys", None),
+            P("keys", None),
+            P("keys"),
+            P("keys"),
+            P("keys"),
+            P("keys"),
+        ),
+    )(ts, rank, vid, length, cutoff, local_rows, payload)
 
 
 def _tree_join(hi_blk, lo_blk):

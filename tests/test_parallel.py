@@ -225,6 +225,60 @@ def test_sharded_treg_convergence_and_ties():
             assert t.out == [want_val, want_ts], (k, t.out)
 
 
+def test_sharded_tlog_convergence_trim_and_overflow():
+    """TLOG in mesh mode: cross-node log convergence, TRIM through the
+    routed trim kernel, and the overflow-retry grow path."""
+    from jylis_tpu.models.repo_tlog import RepoTLOG
+
+    class _T:
+        def __init__(self):
+            self.out = []
+
+        def ok(self):
+            pass
+
+        def array_start(self, n):
+            self.out.append(("arr", n))
+
+        def string(self, s):
+            self.out.append(s)
+
+        def u64(self, v):
+            self.out.append(v)
+
+    a, b = RepoTLOG(identity=1, len_cap=4), RepoTLOG(identity=2, len_cap=4)
+    assert a._mesh is not None
+    assert len(a._state.ts.addressable_shards) == 8
+    keys = [b"log%d" % i for i in range(40)]
+    for repo, base in ((a, 0), (b, 1000)):
+        for k in keys:
+            for t in range(6):  # 6 entries > len_cap 4: exercises grow
+                repo.apply(_T(), [b"INS", k, b"e%d" % (base + t), b"%d" % (base + t + 1)])
+    for src, dst in ((a, b), (b, a)):
+        for key, delta in src.flush_deltas():
+            dst.converge(key, delta)
+    for k in keys:
+        ra, rb = _T(), _T()
+        a.apply(ra, [b"GET", k])
+        b.apply(rb, [b"GET", k])
+        assert ra.out == rb.out and ra.out[0] == ("arr", 12), k
+    # sizes agree cross-node after the sharded drains
+    sa, sb = _T(), _T()
+    a.apply(sa, [b"SIZE", keys[0]])
+    b.apply(sb, [b"SIZE", keys[0]])
+    assert sa.out == sb.out == [12]
+    # TRIM through the routed kernel: keep 3 newest, cutoff replicates
+    a.apply(_T(), [b"TRIM", keys[0], b"3"])
+    st = _T()
+    a.apply(st, [b"SIZE", keys[0]])
+    assert st.out == [3]
+    for key, delta in a.flush_deltas():
+        b.converge(key, delta)
+    sb2 = _T()
+    b.apply(sb2, [b"SIZE", keys[0]])
+    assert sb2.out == [3]
+
+
 def test_join_replica_axis_is_lattice_join():
     rng = np.random.default_rng(1)
     S, K = 8, 64  # 2 local rows per rep shard: exercises the local fold
