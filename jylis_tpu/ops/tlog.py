@@ -110,7 +110,11 @@ def _canonicalize(ts, rank, vid, valid):
 
 def _compact(ts, rank, vid, keep):
     """Stable compaction of an already-ordered row: push ~keep entries to
-    the tail (single u32 sort key, order among kept entries preserved)."""
+    the tail (single u32 sort key, order among kept entries preserved).
+
+    Measured alternative: a cumsum-position + scatter partition (O(n) in
+    compares) ran ~70x SLOWER than this sort on the v5e — vmap'd
+    computed-index scatters do not vectorise; the sort network does."""
     inv = (~keep).astype(U32)
     nth, ntl = _split_neg64(ts)
     nrh, nrl = _split_neg64(rank)
