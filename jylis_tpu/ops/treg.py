@@ -146,8 +146,12 @@ def converge_many(
 ) -> tuple[TRegState, jax.Array]:
     """Fold several replica batches: inputs are (N, B)-shaped; scans over N.
 
-    Returns (state, tie_mask (N, B)). One compiled program for the whole
-    anti-entropy round (BASELINE.json config 3: 1M keys, random-ts merge).
+    Returns (state, tie_mask (N, B)). One compiled program for a whole
+    multi-batch anti-entropy round. NOT on the serving path: the repo
+    coalesces concurrent deltas per key host-side with the exact LWW rule
+    (full strings, no rank-collision ambiguity — repo_treg.py:_write), so
+    a drain always carries one winner per key; this kernel exists for
+    bench/offline folds where batches arrive pre-formed.
     """
 
     def step(st, batch):
