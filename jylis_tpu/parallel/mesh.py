@@ -46,6 +46,12 @@ def serving_mesh() -> Mesh | None:
     must share one Mesh object.
     """
     if not _SERVING_MESH:
-        n = len(jax.devices())
+        # local devices, deliberately: a jylis node is one process on one
+        # host, and its mesh is that host's chips. Spanning hosts inside
+        # one node would make every drain a multi-controller SPMD program
+        # — the wrong tool for an event-driven server. Cross-host scale is
+        # the CLUSTER layer's job (gossip over DCN), same as the
+        # reference's one-process-one-node model. See parallel/PLAN.md.
+        n = len(jax.local_devices())
         _SERVING_MESH.append(make_mesh(n) if n > 1 else None)
     return _SERVING_MESH[0]
