@@ -113,31 +113,56 @@ def bench_cpu() -> float:
 
 
 def config_gcount_smoke() -> dict:
-    """Config 1: GCOUNT single-key INC/GET smoke through the engine seam
-    (repo_gcount.pony) — commands/sec including host dispatch + device
-    serving reads. Baseline: the reference's per-command work (data +
-    delta-state map updates, value sum) on the host lattice. This config
-    is a dispatch smoke — single-key commands never touch the batched
-    merge path where the TPU wins (the north star), so the expected
-    posture is sub-1x (measured ~0.3-0.5x: full command routing against
-    a bare dict loop), not a target."""
-    from jylis_tpu.models.database import Database, _NullRespond
+    """Config 1: GCOUNT single-key INC/GET smoke, one node
+    (repo_gcount.pony) — measured through the node's REAL serving
+    surface: pipelined RESP over a loopback socket, parse + apply +
+    reply. With a toolchain present the whole burst runs in the native
+    counter engine (native/counter_engine.cpp) in one FFI call per read.
+    Baseline: the reference's per-command work (data + delta-state map
+    updates, value sum) as a bare Python dict loop."""
+    import asyncio
+
+    from jylis_tpu.models.database import Database
     from jylis_tpu.ops.hostref import GCounter
+    from jylis_tpu.server.server import Server
+    from jylis_tpu.utils.config import Config
+    from jylis_tpu.utils.log import Log
 
-    db = Database(identity=1)
-    resp = _NullRespond()
-    db.apply(resp, [b"GCOUNT", b"INC", b"k", b"1"])
-    db.apply(resp, [b"GCOUNT", b"GET", b"k"])  # compile
-    n = 2000
+    n = 5000  # commands per pipelined burst (half INC, half GET)
+    payload = b"GCOUNT INC k 1\r\nGCOUNT GET k\r\n" * (n // 2)
 
-    def once():
-        t0 = time.perf_counter()
-        for _ in range(n):
-            db.apply(resp, [b"GCOUNT", b"INC", b"k", b"1"])
-            db.apply(resp, [b"GCOUNT", b"GET", b"k"])
-        return 2 * n, time.perf_counter() - t0
+    async def measure():
+        cfg = Config()
+        cfg.port = "0"
+        cfg.log = Log.create_none()
+        db = Database(identity=1)
+        server = Server(cfg, db)
+        await server.start()
+        try:
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", server.port
+            )
 
-    dev = _median_rate(once)
+            async def burst():
+                writer.write(payload)
+                await writer.drain()
+                got = 0
+                while got < n:  # one \r\n per reply (+OK / :N)
+                    chunk = await reader.read(1 << 20)
+                    got += chunk.count(b"\r\n")
+
+            await burst()  # warmup (jit-free path, but primes buffers)
+            rates = []
+            for _ in range(TIMED_RUNS):
+                t0 = time.perf_counter()
+                await burst()
+                rates.append(n / (time.perf_counter() - t0))
+            writer.close()
+            return statistics.median(rates)
+        finally:
+            await server.dispose()
+
+    dev = asyncio.run(measure())
 
     data: dict[bytes, GCounter] = {}
     deltas: dict[bytes, GCounter] = {}

@@ -18,13 +18,18 @@ from .repo_ujson import RepoUJSON
 
 class Database:
     def __init__(self, identity: int, system_repo: RepoSYSTEM | None = None):
+        from ..native.engine import make_engine
+
         self.system = system_repo if system_repo is not None else RepoSYSTEM(identity)
+        # ONE native engine shared by both counter repos AND the server's
+        # batch applier (server/server.py): single source of host truth
+        self.native_engine = make_engine()
         self._map: dict[bytes, RepoManager] = {}
         for repo in (
             RepoTREG(identity),
             RepoTLOG(identity),
-            RepoGCOUNT(identity),
-            RepoPNCOUNT(identity),
+            RepoGCOUNT(identity, engine=self.native_engine),
+            RepoPNCOUNT(identity, engine=self.native_engine),
             RepoUJSON(identity),
             self.system,
         ):

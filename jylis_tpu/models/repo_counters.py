@@ -6,12 +6,21 @@ whole keyspace is ONE (keys x replicas) tensor per polarity (ops/gcount,
 ops/pncount), and all mutations — local INCs and incoming anti-entropy
 deltas alike — funnel into a coalesced pending batch that drains as a
 single fused scatter-max + row-sum XLA call. The drain's row sums feed a
-host cache, so GET is a host dict lookup and the device only ever sees
+host value cache, so GET is a table lookup and the device only ever sees
 large batches (the BASELINE.json north-star structure).
+
+Host bookkeeping (keys, own contributions, value cache, dirty/pending/
+foreign flags) lives behind the table backends in counter_table.py:
+pure-Python dicts as the oracle, or the native C++ engine — the SAME
+state the server's native batch applier (native/counter_engine.cpp)
+mutates, so commands applied natively and Python-side drains/flushes
+share one source of truth. Foreign delta columns (sparse per-replica
+maps from the cluster) stay in Python dicts; they merge with the
+exported pending-own values at drain time.
 
 Delta wire shape: GCOUNT -> dict {replica_id: u64}; PNCOUNT -> a
 (p_dict, n_dict) pair. Outbound deltas carry only this node's own column
-(absolute values — joinable delta-state), which the host tracks exactly,
+(absolute values — joinable delta-state), which the table tracks exactly,
 so flushes never need a device read.
 """
 
@@ -22,6 +31,7 @@ from functools import partial
 import jax
 import numpy as np
 
+from ..native.engine import G as ENG_G, PN as ENG_PN, make_engine
 from ..ops import gcount, planes, pncount
 from ..parallel import (
     drain_sharded_g,
@@ -31,6 +41,7 @@ from ..parallel import (
     shard_plane,
 )
 from .base import ParseError, bucket, need, pad_rows, parse_u64, U64_MAX
+from .counter_table import NativeTable, PyTable
 from ..utils.metrics import timed_drain
 from .help import RepoHelp
 
@@ -80,11 +91,17 @@ def _wrap_i64(v: int) -> int:
 class _CounterRepo:
     """Shared machinery; subclasses bind the ops module and command set."""
 
+    _which: int  # native engine table id
+
     def __init__(
-        self, identity: int, key_cap: int = 1024, rep_cap: int = 8, mesh="auto"
+        self,
+        identity: int,
+        key_cap: int = 1024,
+        rep_cap: int = 8,
+        mesh="auto",
+        engine="auto",
     ):
         self._identity = identity
-        self._keys: dict[bytes, int] = {}  # key -> row
         self._rids: dict[int, int] = {}  # replica id -> column
         # mesh mode (SURVEY.md §5.8): with >1 visible device the keyspace
         # planes live keys-sharded over the serving mesh and drains route
@@ -96,29 +113,27 @@ class _CounterRepo:
         self._n_shards = self._mesh.devices.size if self._mesh is not None else 1
         self._key_cap = self._round_cap(key_cap)
         self._rep_cap = rep_cap
-        self._values: dict[int, int] = {}  # row -> cached serving value
-        self._dirty: set[bytes] = set()  # keys with unflushed deltas
-        # rows whose pending batch contains FOREIGN deltas: only those make
-        # the host value cache stale. Local INC/DEC adjust the cache
-        # eagerly and exactly (own columns are private and monotone), so a
-        # GET after purely-local writes never needs a device round-trip —
-        # the read-your-writes host shadow from SURVEY.md section 7(c).
-        self._foreign: set[int] = set()
+        if engine == "auto":
+            engine = make_engine()
+        elif engine == "python":
+            engine = None
+        self.engine = engine  # shared across both counter repos when set
+        self._tbl = (
+            NativeTable(engine, self._which) if engine is not None else PyTable()
+        )
+        # foreign delta columns buffered per row per polarity (sparse
+        # {col: max-value} maps from cluster converges)
+        self._pending_f: tuple[dict[int, dict[int, int]], ...] = ({}, {})
 
-    def _get_value(self, key: bytes) -> int:
-        row = self._keys.get(key)
-        if row is None:
+    def _get_raw(self, key: bytes) -> int:
+        """Serving value bits for a key (drains first when foreign deltas
+        make the cache stale; local writes keep it exact)."""
+        row = self._tbl.find(key)
+        if row < 0:
             return 0
-        if row in self._foreign:
+        if self._tbl.is_foreign(row):
             self.drain()
-        return self._values.get(row, 0)
-
-    def _row_for(self, key: bytes) -> int:
-        row = self._keys.get(key)
-        if row is None:
-            row = len(self._keys)
-            self._keys[key] = row
-        return row
+        return self._tbl.value(row)
 
     def _col_for(self, rid: int) -> int:
         col = self._rids.get(rid)
@@ -139,14 +154,14 @@ class _CounterRepo:
         return type(state)(*(shard_plane(self._mesh, p) for p in state))
 
     def _grow_to_fit(self) -> None:
-        k = self._round_cap(bucket(max(len(self._keys), 1), self._key_cap))
+        k = self._round_cap(bucket(max(self._tbl.rows(), 1), self._key_cap))
         r = bucket(max(len(self._rids), 1), self._rep_cap)
         if k != self._key_cap or r != self._rep_cap:
             self._key_cap, self._rep_cap = k, r
             self._state = self._place(self._ops.grow(self._state, k, r))
 
     def deltas_size(self) -> int:
-        return len(self._dirty)
+        return self._tbl.dirty_count()
 
     def may_drain(self, args: list[bytes]) -> bool:
         """Will this command hit the device? Only a GET over a row holding
@@ -154,20 +169,75 @@ class _CounterRepo:
         cache exact); the server offloads such commands to a thread."""
         if len(args) < 2 or args[0] != b"GET":
             return False
-        row = self._keys.get(args[1])
-        return row is not None and row in self._foreign
+        row = self._tbl.find(args[1])
+        return row >= 0 and self._tbl.is_foreign(row)
+
+    def _pend_size(self) -> int:
+        """Exact drain batch size: own-pending rows unioned with the
+        buffered foreign rows (metrics, read before the drain runs)."""
+        own_rows, _vp, _vn = self._tbl.export_pending(clear=False)
+        rows = set(own_rows)
+        rows.update(self._pending_f[0])
+        rows.update(self._pending_f[1])
+        return len(rows)
+
+    def converge_polarity(self, key: bytes, polarity: int, delta: dict) -> None:
+        row = self._tbl.upsert(key)
+        p = self._pending_f[polarity].setdefault(row, {})
+        for rid, v in delta.items():
+            col = self._col_for(rid)
+            if v > p.get(col, 0):
+                p[col] = v
+        self._tbl.set_foreign(row)
+
+    def _collect_rows(self):
+        """The drain batch: pending-own values merged with the buffered
+        foreign columns -> (rows, per-row {col: val} per polarity).
+        Reads WITHOUT clearing: the window clears in `_finish_drain`, so
+        a device failure mid-drain keeps every contribution for the
+        retry (the old dict path's exception-safety contract)."""
+        own_rows, vp, vn = self._tbl.export_pending(clear=False)
+        own_col = self._col_for(self._identity)
+        per_pol: tuple[dict[int, dict[int, int]], ...] = ({}, {})
+        for pol, own_vals in ((0, vp), (1, vn)):
+            fdict = self._pending_f[pol]
+            for row, v in zip(own_rows, own_vals):
+                if v:
+                    per_pol[pol][row] = {own_col: v}
+            for row, cols in fdict.items():
+                d = per_pol[pol].setdefault(row, {})
+                for col, v in cols.items():
+                    if v > d.get(col, 0):
+                        d[col] = v
+        rows = list(dict.fromkeys(list(per_pol[0]) + list(per_pol[1])))
+        return rows, per_pol
+
+    def _finish_drain(self, rows, values_bits) -> None:
+        self._tbl.apply_drain(rows, values_bits)
+        self._tbl.export_pending(clear=True)  # drain succeeded: clear window
+        self._pending_f[0].clear()
+        self._pending_f[1].clear()
+
+    # -- snapshot plumbing shared by both types ------------------------------
+
+    def _sorted_keys(self):
+        return sorted(
+            (self._tbl.key_of(r), r) for r in range(self._tbl.rows())
+        )
 
 
 class RepoGCOUNT(_CounterRepo):
     name = "GCOUNT"
     help = GCOUNT_HELP
     _ops = gcount
+    _which = ENG_G
 
     def __init__(self, identity: int, **kw):
         super().__init__(identity, **kw)
         self._state = self._place(gcount.init(self._key_cap, self._rep_cap))
-        self._own: dict[bytes, int] = {}  # my column, absolute (u64 wrap)
-        self._pending: dict[int, dict[int, int]] = {}  # row -> col -> max val
+
+    def _get_value(self, key: bytes) -> int:
+        return self._get_raw(key)
 
     # -- commands (repo_gcount.pony:25-60) ---------------------------------
 
@@ -179,43 +249,27 @@ class RepoGCOUNT(_CounterRepo):
         if op == b"INC":
             key = need(args, 1)
             amount = parse_u64(need(args, 2))
-            self._inc(key, amount)
+            self._tbl.inc(self._tbl.upsert(key), 0, amount)
             resp.ok()
             return True
         raise ParseError()
 
-    def _inc(self, key: bytes, amount: int) -> None:
-        new = (self._own.get(key, 0) + amount) & U64_MAX
-        self._own[key] = new
-        col = self._col_for(self._identity)
-        row = self._row_for(key)
-        p = self._pending.setdefault(row, {})
-        p[col] = max(p.get(col, 0), new)
-        self._dirty.add(key)
-        # own column grew by exactly `amount`: adjust the value cache
-        self._values[row] = (self._values.get(row, 0) + amount) & U64_MAX
-
     # -- lattice plumbing ---------------------------------------------------
 
     def converge(self, key: bytes, delta: dict) -> None:
-        row = self._row_for(key)
-        p = self._pending.setdefault(row, {})
-        for rid, v in delta.items():
-            col = self._col_for(rid)
-            if v > p.get(col, 0):
-                p[col] = v
-        self._foreign.add(row)
+        self.converge_polarity(key, 0, delta)
 
-    @timed_drain("GCOUNT", lambda self: len(self._pending))
+    @timed_drain("GCOUNT", _CounterRepo._pend_size)
     def drain(self) -> None:
-        if not self._pending:
+        rows, per_pol = self._collect_rows()
+        if not rows:
             return
         self._grow_to_fit()
-        rows = list(self._pending)  # dict keys: unique, as converge requires
+        pending = per_pol[0]
         if self._mesh is not None:
             deltas = np.zeros((len(rows), self._rep_cap), np.uint64)
             for i, row in enumerate(rows):
-                for col, v in self._pending[row].items():
+                for col, v in pending.get(row, {}).items():
                     deltas[i, col] = v
             lr, d_hi, d_lo, slots = route_drain(
                 np.asarray(rows, np.int64),
@@ -228,40 +282,36 @@ class RepoGCOUNT(_CounterRepo):
             )
             self._state = gcount.GCountState(hi, lo)
             sums = np.asarray(sums)
-            for j, g in enumerate(slots):
-                if g >= 0:
-                    self._values[int(g)] = int(sums[j])
+            live = [(int(g), sums[j]) for j, g in enumerate(slots) if g >= 0]
+            self._finish_drain([r for r, _ in live], [v for _, v in live])
         elif len(rows) * DENSE_FRACTION >= self._key_cap:
             dense = np.zeros((self._key_cap, self._rep_cap), np.uint64)
             for row in rows:
-                for col, v in self._pending[row].items():
+                for col, v in pending.get(row, {}).items():
                     dense[row, col] = v
             d_hi, d_lo = planes.split64_np(dense)
             self._state, sums = _drain_g_dense(self._state, d_hi, d_lo)
             sums = np.asarray(sums)
-            for row in rows:
-                self._values[row] = int(sums[row])
+            self._finish_drain(rows, [sums[row] for row in rows])
         else:
             b = bucket(len(rows))
             ki = pad_rows(b)
             ki[: len(rows)] = rows
             deltas = np.zeros((b, self._rep_cap), np.uint64)
             for i, row in enumerate(rows):
-                for col, v in self._pending[row].items():
+                for col, v in pending.get(row, {}).items():
                     deltas[i, col] = v
             d_hi, d_lo = planes.split64_np(deltas)
             self._state, sums = _drain_g(self._state, ki, d_hi, d_lo)
             sums = np.asarray(sums)
-            for i, row in enumerate(rows):
-                self._values[row] = int(sums[i])
-        self._pending.clear()
-        self._foreign.clear()
+            self._finish_drain(rows, [sums[i] for i in range(len(rows))])
 
     def flush_deltas(self):
-        out = [
-            (k, {self._identity: self._own[k]}) for k in sorted(self._dirty)
-        ]
-        self._dirty.clear()
+        rows, op, _on, _sb = self._tbl.export_dirty()
+        out = sorted(
+            (self._tbl.key_of(r), {self._identity: int(v)})
+            for r, v in zip(rows, op)
+        )
         return out
 
     # -- snapshot (persist.py): full state in the wire-delta shape ----------
@@ -271,7 +321,7 @@ class RepoGCOUNT(_CounterRepo):
         counts = gcount.to_counts(self._state)
         cols = {col: rid for rid, col in self._rids.items()}
         out = []
-        for key, row in sorted(self._keys.items()):
+        for key, row in self._sorted_keys():
             d = {
                 cols[c]: int(v)
                 for c, v in enumerate(counts[row, : len(cols)])
@@ -287,8 +337,8 @@ class RepoGCOUNT(_CounterRepo):
             # my own column is my private monotonic state: losing it would
             # make future INCs disappear under the pending max
             if self._identity in delta:
-                self._own[key] = max(
-                    self._own.get(key, 0), delta[self._identity]
+                self._tbl.own_max(
+                    self._tbl.upsert(key), 0, delta[self._identity]
                 )
 
 
@@ -296,15 +346,14 @@ class RepoPNCOUNT(_CounterRepo):
     name = "PNCOUNT"
     help = PNCOUNT_HELP
     _ops = pncount
+    _which = ENG_PN
 
     def __init__(self, identity: int, **kw):
         super().__init__(identity, **kw)
         self._state = self._place(pncount.init(self._key_cap, self._rep_cap))
-        self._own_p: dict[bytes, int] = {}
-        self._own_n: dict[bytes, int] = {}
-        # row -> (col -> max val), one map per polarity
-        self._pending_p: dict[int, dict[int, int]] = {}
-        self._pending_n: dict[int, dict[int, int]] = {}
+
+    def _get_value(self, key: bytes) -> int:
+        return _wrap_i64(self._get_raw(key))
 
     # -- commands (repo_pncount.pony:26-67) --------------------------------
 
@@ -316,53 +365,33 @@ class RepoPNCOUNT(_CounterRepo):
         if op in (b"INC", b"DEC"):
             key = need(args, 1)
             amount = parse_u64(need(args, 2))
-            own, pend = (
-                (self._own_p, self._pending_p)
-                if op == b"INC"
-                else (self._own_n, self._pending_n)
+            self._tbl.inc(
+                self._tbl.upsert(key), 0 if op == b"INC" else 1, amount
             )
-            new = (own.get(key, 0) + amount) & U64_MAX
-            own[key] = new
-            col = self._col_for(self._identity)
-            row = self._row_for(key)
-            p = pend.setdefault(row, {})
-            p[col] = max(p.get(col, 0), new)
-            self._dirty.add(key)
-            # exact eager adjust, wrapped to the signed-64 read domain
-            signed = amount if op == b"INC" else -amount
-            self._values[row] = _wrap_i64(self._values.get(row, 0) + signed)
             resp.ok()
             return True
         raise ParseError()
 
     def converge(self, key: bytes, delta: tuple) -> None:
         dp, dn = delta
-        row = self._row_for(key)
-        for pend, d in ((self._pending_p, dp), (self._pending_n, dn)):
-            p = pend.setdefault(row, {})
-            for rid, v in d.items():
-                col = self._col_for(rid)
-                if v > p.get(col, 0):
-                    p[col] = v
-        self._foreign.add(row)
+        self.converge_polarity(key, 0, dp)
+        self.converge_polarity(key, 1, dn)
 
-    @timed_drain(
-        "PNCOUNT",
-        lambda self: len(set(self._pending_p) | set(self._pending_n)),
-    )
+    @timed_drain("PNCOUNT", _CounterRepo._pend_size)
     def drain(self) -> None:
-        if not self._pending_p and not self._pending_n:
+        rows, per_pol = self._collect_rows()
+        if not rows:
             return
         self._grow_to_fit()
-        rows = sorted(set(self._pending_p) | set(self._pending_n))
+        pend_p, pend_n = per_pol
         if self._mesh is not None:
             # polarity-stacked (B, 2R) so one routing pass serves both
             stacked = np.zeros((len(rows), 2 * self._rep_cap), np.uint64)
             r = self._rep_cap
             for i, row in enumerate(rows):
-                for col, v in self._pending_p.get(row, {}).items():
+                for col, v in pend_p.get(row, {}).items():
                     stacked[i, col] = v
-                for col, v in self._pending_n.get(row, {}).items():
+                for col, v in pend_n.get(row, {}).items():
                     stacked[i, r + col] = v
             lr, d_hi, d_lo, slots = route_drain(
                 np.asarray(rows, np.int64),
@@ -374,26 +403,24 @@ class RepoPNCOUNT(_CounterRepo):
                 self._mesh, *self._state, lr, d_hi, d_lo
             )
             self._state = pncount.PNCountState(p_hi, p_lo, n_hi, n_lo)
-            sums = np.asarray(sums)
-            for j, g in enumerate(slots):
-                if g >= 0:
-                    self._values[int(g)] = int(sums[j])
+            sums = np.asarray(sums).view(np.uint64)
+            live = [(int(g), sums[j]) for j, g in enumerate(slots) if g >= 0]
+            self._finish_drain([r for r, _ in live], [v for _, v in live])
         elif len(rows) * DENSE_FRACTION >= self._key_cap:
             dp = np.zeros((self._key_cap, self._rep_cap), np.uint64)
             dn = np.zeros((self._key_cap, self._rep_cap), np.uint64)
             for row in rows:
-                for col, v in self._pending_p.get(row, {}).items():
+                for col, v in pend_p.get(row, {}).items():
                     dp[row, col] = v
-                for col, v in self._pending_n.get(row, {}).items():
+                for col, v in pend_n.get(row, {}).items():
                     dn[row, col] = v
             dp_hi, dp_lo = planes.split64_np(dp)
             dn_hi, dn_lo = planes.split64_np(dn)
             self._state, sums = _drain_pn_dense(
                 self._state, dp_hi, dp_lo, dn_hi, dn_lo
             )
-            sums = np.asarray(sums)
-            for row in rows:
-                self._values[row] = int(sums[row])
+            sums = np.asarray(sums).view(np.uint64)
+            self._finish_drain(rows, [sums[row] for row in rows])
         else:
             b = bucket(len(rows))
             ki = pad_rows(b)
@@ -401,29 +428,26 @@ class RepoPNCOUNT(_CounterRepo):
             dp = np.zeros((b, self._rep_cap), np.uint64)
             dn = np.zeros((b, self._rep_cap), np.uint64)
             for i, row in enumerate(rows):
-                for col, v in self._pending_p.get(row, {}).items():
+                for col, v in pend_p.get(row, {}).items():
                     dp[i, col] = v
-                for col, v in self._pending_n.get(row, {}).items():
+                for col, v in pend_n.get(row, {}).items():
                     dn[i, col] = v
             dp_hi, dp_lo = planes.split64_np(dp)
             dn_hi, dn_lo = planes.split64_np(dn)
             self._state, sums = _drain_pn(
                 self._state, ki, dp_hi, dp_lo, dn_hi, dn_lo
             )
-            sums = np.asarray(sums)
-            for i, row in enumerate(rows):
-                self._values[row] = int(sums[i])
-        self._pending_p.clear()
-        self._pending_n.clear()
-        self._foreign.clear()
+            sums = np.asarray(sums).view(np.uint64)
+            self._finish_drain(rows, [sums[i] for i in range(len(rows))])
 
     def flush_deltas(self):
+        rows, op, on, sb = self._tbl.export_dirty()
         out = []
-        for k in sorted(self._dirty):
-            dp = {self._identity: self._own_p[k]} if k in self._own_p else {}
-            dn = {self._identity: self._own_n[k]} if k in self._own_n else {}
-            out.append((k, (dp, dn)))
-        self._dirty.clear()
+        for r, p, n, bits in zip(rows, op, on, sb):
+            dp = {self._identity: int(p)} if bits & 1 else {}
+            dn = {self._identity: int(n)} if bits & 2 else {}
+            out.append((self._tbl.key_of(r), (dp, dn)))
+        out.sort()
         return out
 
     # -- snapshot (persist.py): full state in the wire-delta shape ----------
@@ -438,7 +462,7 @@ class RepoPNCOUNT(_CounterRepo):
             np.asarray(self._state.n_hi), np.asarray(self._state.n_lo)
         )
         out = []
-        for key, row in sorted(self._keys.items()):
+        for key, row in self._sorted_keys():
             dp = {cols[c]: int(v) for c, v in enumerate(p[row, : len(cols)]) if v}
             dn = {cols[c]: int(v) for c, v in enumerate(n[row, : len(cols)]) if v}
             if dp or dn:
@@ -448,11 +472,8 @@ class RepoPNCOUNT(_CounterRepo):
     def load_state(self, batch) -> None:
         for key, (dp, dn) in batch:
             self.converge(key, (dp, dn))
+            row = self._tbl.upsert(key)
             if self._identity in dp:
-                self._own_p[key] = max(
-                    self._own_p.get(key, 0), dp[self._identity]
-                )
+                self._tbl.own_max(row, 0, dp[self._identity])
             if self._identity in dn:
-                self._own_n[key] = max(
-                    self._own_n.get(key, 0), dn[self._identity]
-                )
+                self._tbl.own_max(row, 1, dn[self._identity])

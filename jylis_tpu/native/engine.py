@@ -1,0 +1,223 @@
+"""ctypes wrapper for the native counter engine (native/counter_engine.cpp).
+
+`CounterEngine` owns the GCOUNT/PNCOUNT host state (key table, own
+contributions, serving values, dirty/pending/foreign bookkeeping) and
+applies whole pipelined command bursts per FFI call. The Python dict
+backend in models/repo_counters.py remains the semantic oracle and the
+fallback when no toolchain is available; differential tests pin the
+equivalence.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from . import lib
+
+G = 0
+PN = 1
+
+_OUT_CAP = 1 << 16
+_MAX_ARGS = 1024
+
+
+def _declare(c: ctypes.CDLL) -> None:
+    ct = ctypes
+    c.jy_eng_new.restype = ct.c_void_p
+    c.jy_eng_free.argtypes = [ct.c_void_p]
+    c.jy_eng_rows.restype = ct.c_int64
+    c.jy_eng_rows.argtypes = [ct.c_void_p, ct.c_int32]
+    c.jy_eng_upsert.restype = ct.c_int64
+    c.jy_eng_upsert.argtypes = [ct.c_void_p, ct.c_int32, ct.c_char_p, ct.c_int64]
+    c.jy_eng_find.restype = ct.c_int64
+    c.jy_eng_find.argtypes = [ct.c_void_p, ct.c_int32, ct.c_char_p, ct.c_int64]
+    c.jy_eng_key.argtypes = [
+        ct.c_void_p, ct.c_int32, ct.c_int64,
+        ct.POINTER(ct.c_void_p), ct.POINTER(ct.c_int64),
+    ]
+    c.jy_eng_inc.argtypes = [
+        ct.c_void_p, ct.c_int32, ct.c_int64, ct.c_int32, ct.c_uint64,
+    ]
+    c.jy_eng_is_foreign.restype = ct.c_int32
+    c.jy_eng_is_foreign.argtypes = [ct.c_void_p, ct.c_int32, ct.c_int64]
+    c.jy_eng_set_foreign.argtypes = [ct.c_void_p, ct.c_int32, ct.c_int64]
+    c.jy_eng_value.restype = ct.c_uint64
+    c.jy_eng_value.argtypes = [ct.c_void_p, ct.c_int32, ct.c_int64]
+    c.jy_eng_own.restype = ct.c_uint64
+    c.jy_eng_own.argtypes = [ct.c_void_p, ct.c_int32, ct.c_int64, ct.c_int32]
+    c.jy_eng_own_max.argtypes = [
+        ct.c_void_p, ct.c_int32, ct.c_int64, ct.c_int32, ct.c_uint64,
+    ]
+    c.jy_eng_apply_drain.argtypes = [
+        ct.c_void_p, ct.c_int32, ct.c_void_p, ct.c_void_p, ct.c_int64,
+    ]
+    c.jy_eng_export_pending.restype = ct.c_int64
+    c.jy_eng_export_pending.argtypes = [
+        ct.c_void_p, ct.c_int32, ct.c_void_p, ct.c_void_p, ct.c_void_p,
+        ct.c_int64, ct.c_int32,
+    ]
+    c.jy_eng_dirty_count.restype = ct.c_int64
+    c.jy_eng_dirty_count.argtypes = [ct.c_void_p, ct.c_int32]
+    c.jy_eng_pend_count.restype = ct.c_int64
+    c.jy_eng_pend_count.argtypes = [ct.c_void_p, ct.c_int32]
+    c.jy_eng_export_dirty.restype = ct.c_int64
+    c.jy_eng_export_dirty.argtypes = [
+        ct.c_void_p, ct.c_int32, ct.c_void_p, ct.c_void_p, ct.c_void_p,
+        ct.c_void_p, ct.c_int64,
+    ]
+    c.jy_eng_own_set.restype = ct.c_int32
+    c.jy_eng_own_set.argtypes = [ct.c_void_p, ct.c_int32, ct.c_int64]
+    c.jy_eng_scan_apply.restype = ct.c_int32
+    c.jy_eng_scan_apply.argtypes = [
+        ct.c_void_p, ct.c_void_p, ct.c_int64,                      # buf
+        ct.c_void_p, ct.c_int64, ct.POINTER(ct.c_int64),           # out
+        ct.POINTER(ct.c_int64),                                    # consumed
+        ct.c_void_p, ct.c_void_p, ct.c_int32, ct.POINTER(ct.c_int32),
+        ct.POINTER(ct.c_int32), ct.POINTER(ct.c_int32),            # changed
+    ]
+
+
+_declared = False
+
+
+class CounterEngine:
+    """One native engine instance = both counter tables of one node."""
+
+    def __init__(self, cdll):
+        global _declared
+        if not _declared:
+            _declare(cdll)
+            _declared = True
+        self._lib = cdll
+        self._h = cdll.jy_eng_new()
+        self._out = (ctypes.c_uint8 * _OUT_CAP)()
+        self._offs = (ctypes.c_int64 * _MAX_ARGS)()
+        self._lens = (ctypes.c_int64 * _MAX_ARGS)()
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.jy_eng_free(self._h)
+            self._h = None
+
+    # ---- table ops ---------------------------------------------------------
+
+    def rows(self, which: int) -> int:
+        return self._lib.jy_eng_rows(self._h, which)
+
+    def upsert(self, which: int, key: bytes) -> int:
+        return self._lib.jy_eng_upsert(self._h, which, key, len(key))
+
+    def find(self, which: int, key: bytes) -> int:
+        return self._lib.jy_eng_find(self._h, which, key, len(key))
+
+    def key_of(self, which: int, row: int) -> bytes:
+        ptr = ctypes.c_void_p()
+        n = ctypes.c_int64()
+        self._lib.jy_eng_key(self._h, which, row, ctypes.byref(ptr), ctypes.byref(n))
+        return ctypes.string_at(ptr, n.value)
+
+    def inc(self, which: int, row: int, polarity: int, amount: int) -> None:
+        self._lib.jy_eng_inc(self._h, which, row, polarity, amount)
+
+    def is_foreign(self, which: int, row: int) -> bool:
+        return bool(self._lib.jy_eng_is_foreign(self._h, which, row))
+
+    def set_foreign(self, which: int, row: int) -> None:
+        self._lib.jy_eng_set_foreign(self._h, which, row)
+
+    def value(self, which: int, row: int) -> int:
+        return self._lib.jy_eng_value(self._h, which, row)
+
+    def own(self, which: int, row: int, polarity: int) -> int:
+        return self._lib.jy_eng_own(self._h, which, row, polarity)
+
+    def own_max(self, which: int, row: int, polarity: int, v: int) -> None:
+        self._lib.jy_eng_own_max(self._h, which, row, polarity, v)
+
+    def apply_drain(self, which: int, rows, values) -> None:
+        rows = np.ascontiguousarray(rows, np.int64)
+        values = np.ascontiguousarray(values, np.uint64)
+        self._lib.jy_eng_apply_drain(
+            self._h, which,
+            rows.ctypes.data, values.ctypes.data, len(rows),
+        )
+
+    def export_pending(self, which: int, clear: bool = True):
+        cap = 256
+        while True:
+            rows = np.empty(cap, np.int64)
+            vp = np.empty(cap, np.uint64)
+            vn = np.empty(cap, np.uint64)
+            n = self._lib.jy_eng_export_pending(
+                self._h, which,
+                rows.ctypes.data, vp.ctypes.data, vn.ctypes.data, cap,
+                1 if clear else 0,
+            )
+            if n >= 0:
+                return rows[:n], vp[:n], vn[:n]
+            cap = -n
+
+    def dirty_count(self, which: int) -> int:
+        return self._lib.jy_eng_dirty_count(self._h, which)
+
+    def pend_count(self, which: int) -> int:
+        return self._lib.jy_eng_pend_count(self._h, which)
+
+    def export_dirty(self, which: int):
+        cap = 256
+        while True:
+            rows = np.empty(cap, np.int64)
+            op = np.empty(cap, np.uint64)
+            on = np.empty(cap, np.uint64)
+            sb = np.empty(cap, np.uint8)
+            n = self._lib.jy_eng_export_dirty(
+                self._h, which,
+                rows.ctypes.data, op.ctypes.data, on.ctypes.data,
+                sb.ctypes.data, cap,
+            )
+            if n >= 0:
+                return rows[:n], op[:n], on[:n], sb[:n]
+            cap = -n
+
+    def own_set(self, which: int, row: int) -> int:
+        """bit0 = P own ever written, bit1 = N own ever written."""
+        return self._lib.jy_eng_own_set(self._h, which, row)
+
+    # ---- the batch applier -------------------------------------------------
+
+    def scan_apply(self, buf):
+        """Apply a pipelined burst. Returns
+        (rc, consumed, replies: bytes, unhandled: list[bytes] | None,
+        changed_g, changed_pn); rc as documented in counter_engine.cpp."""
+        if not buf:
+            return 0, 0, b"", None, 0, 0
+        base = ctypes.addressof(ctypes.c_char.from_buffer(buf))
+        out_len = ctypes.c_int64()
+        consumed = ctypes.c_int64()
+        n_args = ctypes.c_int32()
+        ch_g = ctypes.c_int32()
+        ch_pn = ctypes.c_int32()
+        rc = self._lib.jy_eng_scan_apply(
+            self._h, ctypes.c_void_p(base), len(buf),
+            self._out, _OUT_CAP, ctypes.byref(out_len),
+            ctypes.byref(consumed),
+            self._offs, self._lens, _MAX_ARGS, ctypes.byref(n_args),
+            ctypes.byref(ch_g), ctypes.byref(ch_pn),
+        )
+        replies = ctypes.string_at(self._out, out_len.value)
+        unhandled = None
+        if rc == 1:
+            view = memoryview(buf)
+            unhandled = [
+                bytes(view[self._offs[i] : self._offs[i] + self._lens[i]])
+                for i in range(n_args.value)
+            ]
+            del view
+        return rc, consumed.value, replies, unhandled, ch_g.value, ch_pn.value
+
+
+def make_engine() -> CounterEngine | None:
+    cdll = lib()
+    return CounterEngine(cdll) if cdll is not None else None

@@ -85,6 +85,11 @@ class RespParser:
     def append(self, data: bytes) -> None:
         self._buf += data
 
+    def has_pending(self) -> bool:
+        """Unconsumed bytes held (a split command's head): while true the
+        stream's head belongs to this parser, not the native engine."""
+        return bool(self._buf)
+
     def __iter__(self):
         return self
 

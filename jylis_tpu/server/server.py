@@ -51,11 +51,33 @@ class Server:
     ) -> None:
         parser = make_parser()  # native scanner when built, Python fallback
         resp = Respond(writer.write)
+        engine = getattr(self._database, "native_engine", None)
+        use_native = engine is not None
+        buf = bytearray()
         try:
             while True:
                 data = await reader.read(1 << 16)
                 if not data:
                     break
+                if use_native:
+                    if self._native_busy(parser):
+                        # a drain holds a counter lock (or the parser holds
+                        # a partial command): route THIS burst through the
+                        # per-repo Python path so unrelated repos never
+                        # wait on the engine's two-lock boundary
+                        parser.append(bytes(buf))
+                        buf.clear()
+                    else:
+                        buf += data
+                        use_native = await self._apply_native(
+                            engine, buf, parser, resp, writer
+                        )
+                        if use_native is None:  # protocol error: drop
+                            break
+                        if use_native:
+                            await writer.drain()
+                            continue
+                        data = b""  # demoted: tail already moved into parser
                 parser.append(data)
                 try:
                     for cmd in parser:
@@ -72,6 +94,53 @@ class Server:
                 await writer.wait_closed()
             except (ConnectionResetError, BrokenPipeError):
                 pass
+
+    def _native_busy(self, parser) -> bool:
+        g = self._database.manager("GCOUNT")
+        pn = self._database.manager("PNCOUNT")
+        return g._lock.locked() or pn._lock.locked() or parser.has_pending()
+
+    async def _apply_native(self, engine, buf, parser, resp, writer):
+        """Drain `buf` through the native counter engine; commands it
+        can't settle route through the normal per-repo async path in
+        order. Returns True (stay native), False (demote this connection
+        to the Python path; tail moved into `parser`), or None (protocol
+        error: caller drops the connection)."""
+        g_mgr = self._database.manager("GCOUNT")
+        pn_mgr = self._database.manager("PNCOUNT")
+        while True:
+            if g_mgr._shutdown or pn_mgr._shutdown:
+                parser.append(bytes(buf))
+                buf.clear()
+                return False
+            # both counter tables mutate inside one native call: hold both
+            # repo locks (fixed order), exactly the boundary apply_async
+            # enforces per repo
+            async with g_mgr._lock:
+                async with pn_mgr._lock:
+                    rc, consumed, replies, unhandled, ch_g, ch_pn = (
+                        engine.scan_apply(buf)
+                    )
+                    if replies:
+                        writer.write(replies)
+                    if ch_g:
+                        g_mgr._maybe_proactive_flush()
+                    if ch_pn:
+                        pn_mgr._maybe_proactive_flush()
+            del buf[:consumed]
+            if rc == 1:  # one command for the Python path, in order
+                await self._database.apply_async(resp, unhandled)
+                continue
+            if rc == 2:  # reply buffer flushed; keep going
+                continue
+            if rc == -1:
+                resp.err("protocol error")
+                return None
+            if rc == -2:  # oversized command: Python handles from here on
+                parser.append(bytes(buf))
+                buf.clear()
+                return False
+            return True  # rc == 0: consumed all complete commands
 
     async def dispose(self) -> None:
         """Stop listening (client connections wind down as they close —

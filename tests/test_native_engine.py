@@ -1,0 +1,218 @@
+"""Differential tests: native counter engine vs the pure-Python backend.
+
+The Python dict backend (models/counter_table.PyTable) is the semantic
+oracle; the native engine must be observationally identical through
+every surface — repo commands, cluster converge, drains, flushes,
+snapshots, and the server's batch applier with all its bail-out paths.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import jylis_tpu  # noqa: F401
+from jylis_tpu.models.repo_counters import RepoGCOUNT, RepoPNCOUNT
+from jylis_tpu.native.engine import make_engine
+
+async def send_recv_all(port: int, payload: bytes) -> bytes:
+    """Write, then read until the server goes quiet (mixed native/python
+    replies arrive in several chunks)."""
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(payload)
+    await writer.drain()
+    out = b""
+    while True:
+        try:
+            chunk = await asyncio.wait_for(reader.read(1 << 16), timeout=0.6)
+        except asyncio.TimeoutError:
+            break
+        if not chunk:
+            break
+        out += chunk
+    writer.close()
+    return out
+
+
+class R:
+    def __init__(self):
+        self.vals = []
+
+    def __getattr__(self, name):
+        return lambda *a: self.vals.extend((name, *a))
+
+
+def have_native() -> bool:
+    return make_engine() is not None
+
+
+pytestmark = pytest.mark.skipif(
+    not have_native(), reason="native engine unavailable (no toolchain)"
+)
+
+
+@pytest.mark.parametrize("cls", [RepoGCOUNT, RepoPNCOUNT])
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_repo_differential_random_workload(cls, seed):
+    rng = np.random.default_rng(seed)
+    native = cls(identity=5)
+    oracle = cls(identity=5, engine="python")
+    from jylis_tpu.models.counter_table import NativeTable, PyTable
+
+    assert isinstance(native._tbl, NativeTable)
+    assert isinstance(oracle._tbl, PyTable)
+    keys = [b"k%d" % i for i in range(8)]
+    flushes_n, flushes_o = [], []
+    for step in range(300):
+        roll = rng.integers(8)
+        k = keys[rng.integers(len(keys))]
+        if roll < 3:
+            op = b"INC" if cls is RepoGCOUNT or rng.integers(2) else b"DEC"
+            amt = str(int(rng.integers(0, 1000))).encode()
+            for repo in (native, oracle):
+                repo.apply(R(), [op, k, amt])
+        elif roll < 5:
+            rid = int(rng.integers(3, 6))
+            v = int(rng.integers(1, 10_000))
+            delta = {rid: v} if cls is RepoGCOUNT else ({rid: v}, {rid + 7: v // 2})
+            for repo in (native, oracle):
+                repo.converge(k, delta)
+        elif roll == 5:
+            ra, rb = R(), R()
+            native.apply(ra, [b"GET", k])
+            oracle.apply(rb, [b"GET", k])
+            assert ra.vals == rb.vals, (step, k)
+        elif roll == 6:
+            assert native.deltas_size() == oracle.deltas_size()
+            flushes_n.append(native.flush_deltas())
+            flushes_o.append(oracle.flush_deltas())
+            assert flushes_n[-1] == flushes_o[-1], step
+        else:
+            native.drain()
+            oracle.drain()
+    for k in keys:
+        ra, rb = R(), R()
+        native.apply(ra, [b"GET", k])
+        oracle.apply(rb, [b"GET", k])
+        assert ra.vals == rb.vals, k
+    assert native.dump_state() == oracle.dump_state()
+
+
+def test_int64_min_reply_formatting():
+    """PNCOUNT at exactly INT64_MIN formats identically on both paths
+    (the native formatter negates in the unsigned domain)."""
+
+    async def run(force_python: bool) -> bytes:
+        from jylis_tpu.models.database import Database
+        from jylis_tpu.server.server import Server
+        from jylis_tpu.utils.config import Config
+        from jylis_tpu.utils.log import Log
+
+        cfg = Config()
+        cfg.port = "0"
+        cfg.log = Log.create_none()
+        db = Database(identity=1)
+        if force_python:
+            db.native_engine = None
+        server = Server(cfg, db)
+        await server.start()
+        try:
+            return await send_recv_all(
+                server.port,
+                b"PNCOUNT DEC k 9223372036854775808\r\nPNCOUNT GET k\r\n",
+            )
+        finally:
+            await server.dispose()
+
+    a = asyncio.run(run(False))
+    b = asyncio.run(run(True))
+    assert a == b == b"+OK\r\n:-9223372036854775808\r\n"
+
+
+def test_load_state_roundtrip_differential():
+    src = RepoPNCOUNT(identity=2)
+    for i in range(10):
+        src.apply(R(), [b"INC", b"a%d" % i, b"%d" % (i * 3 + 1)])
+        src.apply(R(), [b"DEC", b"a%d" % i, b"%d" % i])
+    src.converge(b"a0", ({9: 55}, {9: 11}))
+    dumped = src.dump_state()
+    for engine in ("auto", "python"):
+        dst = RepoPNCOUNT(identity=2, engine=engine)
+        dst.load_state(dumped)
+        assert dst.dump_state() == dumped
+        # own columns survived the restore: future INCs keep growing
+        dst.apply(R(), [b"INC", b"a3", b"1"])
+        r = R()
+        dst.apply(r, [b"GET", b"a3"])
+        assert r.vals == ["i64", (3 * 3 + 1) - 3 + 1]
+
+
+MIXED = (
+    b"GCOUNT INC hits 3\r\n"
+    b"PNCOUNT INC bal 10\r\nPNCOUNT DEC bal 4\r\n"
+    b"*3\r\n$6\r\nGCOUNT\r\n$3\r\nGET\r\n$4\r\nhits\r\n"
+    b"PNCOUNT GET bal\r\n"
+    b"TREG SET m v 5\r\nTREG GET m\r\n"     # non-counter interleave
+    b"GCOUNT INC hits notanumber\r\n"        # ParseError -> help
+    b"GCOUNT GET nope\r\n"
+    b"BOGUS X\r\n"                           # datatype help
+    b"PNCOUNT GET bal\r\n"
+)
+
+
+def test_server_replies_identical_native_vs_python():
+    async def run_one(force_python: bool) -> bytes:
+        from jylis_tpu.models.database import Database
+        from jylis_tpu.server.server import Server
+        from jylis_tpu.utils.config import Config
+        from jylis_tpu.utils.log import Log
+
+        cfg = Config()
+        cfg.port = "0"
+        cfg.log = Log.create_none()
+        db = Database(identity=1)
+        if force_python:
+            db.native_engine = None
+        server = Server(cfg, db)
+        await server.start()
+        try:
+            # a foreign-delta GET exercises the native bail + threaded drain
+            db.manager("GCOUNT").repo.converge(b"hits", {77: 100})
+            out = await send_recv_all(server.port, MIXED)
+        finally:
+            await server.dispose()
+        return out
+
+    a = asyncio.run(run_one(False))
+    b = asyncio.run(run_one(True))
+    assert a == b
+    assert b":103\r\n" in a  # foreign-converged GET served post-drain
+
+
+def test_server_protocol_error_still_drops_native():
+    async def main():
+        from jylis_tpu.models.database import Database
+        from jylis_tpu.server.server import Server
+        from jylis_tpu.utils.config import Config
+        from jylis_tpu.utils.log import Log
+
+        cfg = Config()
+        cfg.port = "0"
+        cfg.log = Log.create_none()
+        db = Database(identity=1)
+        assert db.native_engine is not None
+        server = Server(cfg, db)
+        await server.start()
+        try:
+            reader, writer = await asyncio.open_connection("127.0.0.1", server.port)
+            writer.write(b"GCOUNT INC k 1\r\n*not-a-number\r\n")
+            await writer.drain()
+            got = await asyncio.wait_for(reader.read(1 << 16), timeout=2.0)
+            assert got == b"+OK\r\n-protocol error\r\n"
+            eof = await asyncio.wait_for(reader.read(1 << 16), timeout=2.0)
+            assert eof == b""  # dropped
+            writer.close()
+        finally:
+            await server.dispose()
+
+    asyncio.run(main())
