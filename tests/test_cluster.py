@@ -456,6 +456,86 @@ def test_backpressured_connection_dropped_on_broadcast():
     assert cl._held == []  # delivery succeeded, nothing held
 
 
+def test_node_restart_from_snapshot_rejoins_and_converges(tmp_path):
+    """Failure recovery end to end (SURVEY §5.3/§5.4): a node snapshots,
+    dies, restarts from disk on the SAME advertised identity, rejoins the
+    mesh, and both its restored state and writes it missed while down
+    converge — through the real wire path."""
+    from jylis_tpu import persist
+
+    snap = str(tmp_path / "bar.snapshot")
+
+    async def main():
+        p_foo, p_bar = grab_ports(2)
+        foo_addr = Address("127.0.0.1", str(p_foo), "foo")
+        foo = Node("foo", p_foo)
+        bar = Node("bar", p_bar, seeds=[foo_addr])
+        await foo.start()
+        await bar.start()
+        assert await converge_wait(lambda: meshed(foo, bar))
+
+        # writes on both sides, all five types on bar's side of the fence
+        assert await resp_call(bar.server.port, b"GCOUNT INC hits 7\r\n")
+        assert await resp_call(bar.server.port, b"PNCOUNT DEC bal 3\r\n")
+        assert await resp_call(bar.server.port, b"TREG SET m keep 9\r\n")
+        assert await resp_call(bar.server.port, b"TLOG INS lg x 4\r\n")
+        assert await resp_call(bar.server.port, b"UJSON SET cfg on true\r\n")
+
+        # bar snapshots and dies (clean shutdown path)
+        bar.database.clean_shutdown()
+        persist.save_snapshot(bar.database, snap)
+        await bar.stop()
+
+        # foo takes a write while bar is down
+        assert await resp_call(foo.server.port, b"GCOUNT INC hits 5\r\n")
+
+        # bar restarts from disk with the same identity and seeds
+        bar2 = Node("bar", p_bar, seeds=[foo_addr])
+        restored = persist.load_snapshot(bar2.database, snap)
+        assert restored > 0
+        await bar2.start()
+        assert await converge_wait(lambda: meshed(foo, bar2))
+
+        # restored state survived locally...
+        assert await resp_call(bar2.server.port, b"TREG GET m\r\n") == (
+            b"*2\r\n$4\r\nkeep\r\n:9\r\n"
+        )
+        assert await resp_call(bar2.server.port, b"UJSON GET cfg on\r\n") == (
+            b"$4\r\ntrue\r\n"
+        )
+        # ...replicates to foo, and the missed write reaches bar2
+        async def both_converged():
+            got_foo = await resp_call(foo.server.port, b"PNCOUNT GET bal\r\n")
+            got_bar = await resp_call(bar2.server.port, b"GCOUNT GET hits\r\n")
+            return got_foo == b":-3\r\n" and got_bar == b":12\r\n"
+
+        for _ in range(60):
+            if await both_converged():
+                break
+            await asyncio.sleep(TICK)
+        assert await both_converged()
+
+        # bar2's own-column identity survived: further INCs don't regress
+        assert await resp_call(bar2.server.port, b"GCOUNT INC hits 1\r\n")
+        await converge_wait(lambda: True, 4)  # let it flush
+
+        async def final():
+            a = await resp_call(foo.server.port, b"GCOUNT GET hits\r\n")
+            b = await resp_call(bar2.server.port, b"GCOUNT GET hits\r\n")
+            return a == b == b":13\r\n"
+
+        for _ in range(60):
+            if await final():
+                break
+            await asyncio.sleep(TICK)
+        assert await final()
+
+        await bar2.stop()
+        await foo.stop()
+
+    asyncio.run(main())
+
+
 def test_stale_name_blacklisted():
     """An address gossiped with my host:port but another name is permanently
     removed (cluster.pony:215-230)."""
