@@ -189,6 +189,83 @@ def test_server_replies_identical_native_vs_python():
     assert b":103\r\n" in a  # foreign-converged GET served post-drain
 
 
+@pytest.mark.parametrize("seed", [0, 1])
+def test_server_random_stream_differential(seed):
+    """Randomized socket-level fuzz: the same command stream (counters,
+    other types, parse errors, split packets) must produce byte-identical
+    reply streams on the native and pure-Python servers."""
+    rng = np.random.default_rng(seed)
+    keys = [b"k%d" % i for i in range(5)]
+    cmds = []
+    for _ in range(300):
+        k = keys[rng.integers(len(keys))]
+        roll = rng.integers(12)
+        if roll < 3:
+            cmds.append(b"GCOUNT INC %s %d" % (k, rng.integers(0, 1000)))
+        elif roll < 5:
+            op = b"INC" if rng.integers(2) else b"DEC"
+            cmds.append(b"PNCOUNT %s %s %d" % (op, k, rng.integers(0, 1000)))
+        elif roll < 7:
+            cmds.append(b"GCOUNT GET %s" % k)
+        elif roll < 9:
+            cmds.append(b"PNCOUNT GET %s" % k)
+        elif roll == 9:
+            cmds.append(b"TREG SET %s v%d %d" % (k, rng.integers(9), rng.integers(1, 99)))
+        elif roll == 10:
+            cmds.append(b"GCOUNT INC %s nope" % k)  # help path
+        else:
+            cmds.append(b"TREG GET %s" % k)
+    wire = b"".join(c + b"\r\n" for c in cmds)
+    # split the stream into random packet boundaries (exercises the
+    # engine's incomplete-tail handling and parser handoff)
+    cuts = sorted(rng.integers(1, len(wire), size=12).tolist())
+    packets = [wire[a:b] for a, b in zip([0] + cuts, cuts + [len(wire)])]
+
+    async def run_one(force_python: bool) -> bytes:
+        from jylis_tpu.models.database import Database
+        from jylis_tpu.server.server import Server
+        from jylis_tpu.utils.config import Config
+        from jylis_tpu.utils.log import Log
+
+        cfg = Config()
+        cfg.port = "0"
+        cfg.log = Log.create_none()
+        db = Database(identity=1)
+        if force_python:
+            db.native_engine = None
+        db.manager("GCOUNT").repo.converge(keys[0], {44: 5})
+        server = Server(cfg, db)
+        await server.start()
+        try:
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", server.port
+            )
+            out = b""
+            for p in packets:
+                writer.write(p)
+                await writer.drain()
+                try:
+                    out += await asyncio.wait_for(reader.read(1 << 20), 0.05)
+                except asyncio.TimeoutError:
+                    pass
+            while True:
+                try:
+                    chunk = await asyncio.wait_for(reader.read(1 << 20), 0.5)
+                except asyncio.TimeoutError:
+                    break
+                if not chunk:
+                    break
+                out += chunk
+            writer.close()
+            return out
+        finally:
+            await server.dispose()
+
+    a = asyncio.run(run_one(False))
+    b = asyncio.run(run_one(True))
+    assert a == b
+
+
 def test_server_protocol_error_still_drops_native():
     async def main():
         from jylis_tpu.models.database import Database
