@@ -33,6 +33,11 @@ from .help import RepoHelp
 
 TREG_HELP = RepoHelp("TREG", {"GET": "key", "SET": "key value timestamp"})
 
+# pending writes/deltas flush to the device once they pile this high:
+# reads never need the drain (GET computes the winner host-side), so this
+# bounds host memory while keeping device batches large
+PENDING_DRAIN_THRESHOLD = 4096
+
 
 @partial(jax.jit, donate_argnums=0)
 def _drain(state, ki, ts_hi, ts_lo, rank_hi, rank_lo, vid):
@@ -96,15 +101,25 @@ class RepoTREG:
     def apply(self, resp, args: list[bytes]) -> bool:
         op = need(args, 0)
         if op == b"GET":
-            self.drain()
+            # LWW winner = max over (drained cache, un-drained pending) by
+            # the exact (ts, value) rule — an O(1) host compare, so a GET
+            # NEVER pays a device round-trip (the counters' host-shadow
+            # posture; drains happen on write thresholds and snapshots)
             row = self._keys.get(need(args, 1))
-            hit = self._cache.get(row) if row is not None else None
-            if hit is None or hit[1] < 0:
+            cand = None
+            if row is not None:
+                hit = self._cache.get(row)
+                if hit is not None and hit[1] >= 0:
+                    cand = (hit[0], self._interner.lookup(hit[1]))
+                pend = self._pending.get(row)
+                if pend is not None and (cand is None or pend > cand):
+                    cand = pend
+            if cand is None:
                 resp.null()
             else:
-                ts, vid = hit
+                ts, value = cand
                 resp.array_start(2)
-                resp.string(self._interner.lookup(vid))
+                resp.string(value)
                 resp.u64(ts)
             return False
         if op == b"SET":
@@ -116,6 +131,8 @@ class RepoTREG:
             cur = self._deltas.get(key)
             if cur is None or (ts, value) > (cur[1], cur[0]):
                 self._deltas[key] = (value, ts)
+            if len(self._pending) >= PENDING_DRAIN_THRESHOLD:
+                self.drain()
             resp.ok()
             return True
         raise ParseError()
@@ -129,14 +146,20 @@ class RepoTREG:
     def converge(self, key: bytes, delta: tuple) -> None:
         value, ts = delta
         self._write(key, value, ts)
+        if len(self._pending) >= PENDING_DRAIN_THRESHOLD:
+            self.drain()
 
     def deltas_size(self) -> int:
         return len(self._deltas)
 
     def may_drain(self, args: list[bytes]) -> bool:
-        """GET drains when any writes/deltas are pending; the server
-        offloads those to a thread (manager.apply_async)."""
-        return bool(self._pending) and bool(args) and args[0] == b"GET"
+        """GET never drains (host winner compare); a SET may trigger the
+        threshold drain, which the server offloads to a thread."""
+        return (
+            bool(args)
+            and args[0] == b"SET"
+            and len(self._pending) >= PENDING_DRAIN_THRESHOLD
+        )
 
     def flush_deltas(self):
         out = sorted(self._deltas.items())

@@ -114,6 +114,34 @@ def test_tlog_surface(db):
     assert run(db, "TLOG", "SIZE", "chat") == b":0\r\n"
 
 
+def test_treg_reads_never_touch_device(db, monkeypatch):
+    """TREG GET computes the LWW winner from the host cache + pending
+    coalesce — ZERO device calls even right after writes and converges,
+    and the answer matches the post-drain truth."""
+    from jylis_tpu.models import repo_treg
+
+    run(db, "TREG", "SET", "m", "alpha", "5")
+    repo = db.manager("TREG").repo
+    repo.converge(b"m", (b"zeta", 5))  # ts tie: larger value wins
+
+    calls = {"n": 0}
+    for name in ("_drain", "_drain_dense", "_patch_vids"):
+        monkeypatch.setattr(
+            repo_treg, name,
+            lambda *a, **k: calls.__setitem__("n", calls["n"] + 1),
+        )
+    monkeypatch.setattr(
+        type(repo), "_drain_sharded",
+        lambda *a: calls.__setitem__("n", calls["n"] + 1),
+    )
+    assert run(db, "TREG", "GET", "m") == b"*2\r\n$4\r\nzeta\r\n:5\r\n"
+    assert run(db, "TREG", "GET", "nope") == b"$-1\r\n"
+    assert calls["n"] == 0
+    monkeypatch.undo()
+    repo.drain()  # post-drain truth agrees with the host compare
+    assert run(db, "TREG", "GET", "m") == b"*2\r\n$4\r\nzeta\r\n:5\r\n"
+
+
 def test_tlog_quiescent_reads_skip_device(db, monkeypatch):
     """After a drain, repeated GET/SIZE/CUTOFF perform ZERO device calls:
     GET serves from the rendered row cache, SIZE/CUTOFF from the host
