@@ -104,6 +104,7 @@ class Cluster:
         # oldest batches drop past the cap.
         self._held: list[bytes] = []
         self._held_cap = 1024
+        self._flush_tasks: set = set()  # strong refs; asyncio's are weak
 
     # ---- lifecycle --------------------------------------------------------
 
@@ -144,11 +145,22 @@ class Cluster:
             self._broadcast_msg(MsgAnnounceAddrs(self._known_addrs.copy()))
         self._flush_held()
         # flush as a task taking each repo's lock: a repo mid-drain delays
-        # only its own flush, never the tick (eviction/announce/dial above)
-        asyncio.get_running_loop().create_task(
+        # only its own flush, never the tick (eviction/announce/dial
+        # above). Hold a strong reference — asyncio keeps only weak task
+        # refs — and surface exceptions through the log
+        task = asyncio.get_running_loop().create_task(
             self._database.flush_deltas_async(self.broadcast_deltas)
         )
+        self._flush_tasks.add(task)
+        task.add_done_callback(self._flush_task_done)
         self._sync_actives()
+
+    def _flush_task_done(self, task) -> None:
+        self._flush_tasks.discard(task)
+        if not task.cancelled() and task.exception() is not None:
+            self._log.err() and self._log.e(
+                f"heartbeat flush failed: {task.exception()!r}"
+            )
 
     def _evict_idle(self) -> None:
         for conn, last in list(self._last_activity.items()):

@@ -54,14 +54,28 @@ class Dispose:
         if self._disposing:
             return
         self._disposing = True
+        # signal callback: stop intake NOW (sync-safe), then run the
+        # lock-holding shutdown sequence as a task — the final flush and
+        # snapshot must serialise with any in-flight threaded drain
+        self._database.stop_intake()
+        asyncio.get_running_loop().create_task(self._shutdown())
+
+    async def _shutdown(self) -> None:
         # device drains can raise at shutdown; the listeners must still stop
         # and `done` must still be set, or a second SIGINT would no-op
         # (_disposing already True) and the process would only die to SIGKILL
         try:
-            self._database.clean_shutdown()  # final flush rides broadcast_deltas
+            # final flush rides broadcast_deltas; per-repo locks wait out
+            # threaded drains and fence off late-queued commands
+            await self._database.clean_shutdown_async()
             if self._snapshot_path:
                 try:
-                    persist.save_snapshot(self._database, self._snapshot_path)
+                    async with self._database.all_locks():
+                        await asyncio.to_thread(
+                            persist.save_snapshot,
+                            self._database,
+                            self._snapshot_path,
+                        )
                 except Exception as e:
                     if self._log is not None:
                         self._log.err() and self._log.e(f"snapshot failed: {e}")
@@ -74,11 +88,8 @@ class Dispose:
             metrics.stop_profiling()
         finally:
             self._cluster.dispose()
-            asyncio.get_running_loop().create_task(self._finish())
-
-    async def _finish(self) -> None:
-        await self._server.dispose()
-        self.done.set()
+            await self._server.dispose()
+            self.done.set()
 
 
 async def run(argv: list[str] | None = None) -> None:

@@ -82,8 +82,41 @@ class Database:
             mgr.repo.drain()
 
     def clean_shutdown(self) -> None:
+        """Single-threaded shutdown (tests / direct drivers); the serving
+        stack uses clean_shutdown_async, which serialises with in-flight
+        threaded drains."""
         for mgr in self._map.values():
             mgr.clean_shutdown()
+
+    def stop_intake(self) -> None:
+        """Reject new commands immediately (safe from a signal callback)."""
+        for mgr in self._map.values():
+            mgr._shutdown = True
+
+    async def clean_shutdown_async(self) -> None:
+        for mgr in self._map.values():
+            await mgr.clean_shutdown_async()
+
+    def all_locks(self):
+        """Async context holding every repo lock (fixed order): the
+        shutdown snapshot dumps under it so nothing mutates mid-dump."""
+        from contextlib import AsyncExitStack
+
+        stack = AsyncExitStack()
+
+        async def _enter():
+            for mgr in self._map.values():
+                await stack.enter_async_context(mgr._lock)
+            return stack
+
+        class _Ctx:
+            async def __aenter__(self):
+                return await _enter()
+
+            async def __aexit__(self, *exc):
+                return await stack.__aexit__(*exc)
+
+        return _Ctx()
 
 
 class _NullRespond:

@@ -94,6 +94,12 @@ class RepoManager:
             resp.err(SHUTDOWN_ERR)
             return
         async with self._lock:
+            if self._shutdown:
+                # shutdown won the lock race while we queued behind a
+                # drain: the final flush already ran — accepting now
+                # would acknowledge a write that never replicates
+                resp.err(SHUTDOWN_ERR)
+                return
             may = getattr(self.repo, "may_drain", None)
             if may is not None and may(cmd[1:]):
                 replay = _ReplayResp()
@@ -106,11 +112,27 @@ class RepoManager:
 
     async def converge_async(self, batch) -> None:
         async with self._lock:
+            if self._shutdown:
+                return  # fire-and-forget: late deltas re-deliver elsewhere
+            # when this batch will tip the repo over its drain threshold,
+            # drain in a worker thread FIRST — converge() draining inline
+            # would stall the event loop for a device dispatch
+            needs = getattr(self.repo, "needs_background_drain", None)
+            if needs is not None and needs(len(batch)):
+                await asyncio.to_thread(self.repo.drain)
             self.converge_deltas(batch)
 
     async def flush_async(self, fn) -> None:
         async with self._lock:
             self.flush_deltas(fn)
+
+    async def clean_shutdown_async(self) -> None:
+        """Lock-holding shutdown: waits out any in-flight threaded drain,
+        then stops intake and performs the final flush atomically."""
+        self._shutdown = True  # reject commands queued behind the lock
+        async with self._lock:
+            if self._deltas_fn is not None:
+                self.flush_deltas(self._deltas_fn)
 
     def _maybe_proactive_flush(self) -> None:
         if self._deltas_fn is None:

@@ -154,12 +154,20 @@ class RepoTREG:
 
     def may_drain(self, args: list[bytes]) -> bool:
         """GET never drains (host winner compare); a SET may trigger the
-        threshold drain, which the server offloads to a thread."""
+        threshold drain, which the server offloads to a thread. +1: the
+        SET about to run adds a row, so the threshold it will see inside
+        apply is one higher than what is pending now."""
         return (
             bool(args)
             and args[0] == b"SET"
-            and len(self._pending) >= PENDING_DRAIN_THRESHOLD
+            and len(self._pending) + 1 >= PENDING_DRAIN_THRESHOLD
         )
+
+    def needs_background_drain(self, incoming: int) -> bool:
+        """Cluster converge path: drain in a worker thread BEFORE a batch
+        that would tip the threshold (converge's inline drain would stall
+        the event loop for a device dispatch)."""
+        return len(self._pending) + incoming >= PENDING_DRAIN_THRESHOLD
 
     def flush_deltas(self):
         out = sorted(self._deltas.items())

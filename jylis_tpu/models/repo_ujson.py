@@ -148,10 +148,15 @@ class RepoUJSON:
             return
         doc = self._data_for(key)
         if len(deltas) >= DEVICE_FANIN_MIN:
-            doc.converge(self._device_fold(deltas))
-        else:
-            for d in deltas:
-                doc.converge(d)
+            try:
+                doc.converge(self._device_fold(deltas))
+                return
+            except OverflowError:
+                # seqs beyond the device layouts (u32 planes): the host
+                # lattice handles unbounded ints — fall through
+                pass
+        for d in deltas:
+            doc.converge(d)
 
     def _device_fold(self, deltas: list[UJSON]) -> UJSON:
         """Fold a large per-key fan-in on the TPU in one dispatch."""

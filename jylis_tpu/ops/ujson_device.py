@@ -259,6 +259,9 @@ def fold_and_broadcast(
 def plan_shift(docs, n_rep: int) -> int:
     """Pick the dot layout for a batch: int32 with the smallest workable
     shift when every seq fits (native TPU sorts), else the u64/32 layout.
+    The all-ones seq is reserved in the narrow layout: the top replica
+    column with an all-ones seq would pack to exactly PAD32 and vanish
+    as padding.
     """
     rid_bits = max(int(n_rep - 1).bit_length(), 1)
     seq_bits = 31 - rid_bits
@@ -270,7 +273,7 @@ def plan_shift(docs, n_rep: int) -> int:
             max_seq = max(max_seq, s)
         for _, s in doc.ctx.cloud:
             max_seq = max(max_seq, s)
-    return seq_bits if max_seq < (1 << seq_bits) else 32
+    return seq_bits if max_seq < (1 << seq_bits) - 1 else 32
 
 
 def encode_docs(
@@ -294,7 +297,11 @@ def encode_docs(
         vv = np.zeros(n_rep, np.uint32)
         for rid, s in doc.ctx.vv.items():
             col = rid_cols.setdefault(rid, len(rid_cols))
-            vv[col] = min(s, 0xFFFFFFFF)
+            if s >= seq_cap or s > 0xFFFFFFFF:
+                # clamping would SHRINK coverage and resurrect removed
+                # entries — refuse; callers fall back to the host lattice
+                raise OverflowError(f"vv seq {s} needs a wider layout")
+            vv[col] = s
         cloud = []
         for rid, seq in doc.ctx.cloud:
             col = rid_cols.setdefault(rid, len(rid_cols))
@@ -302,10 +309,13 @@ def encode_docs(
                 raise OverflowError(f"seq {seq} needs a wider layout than {shift}")
             cloud.append((col << shift) | seq)
         rows.append((sorted(dots), vv, sorted(cloud)))
-    if len(rid_cols) > n_rep:
-        raise ValueError(f"n_rep {n_rep} too small for {len(rid_cols)} replicas")
     dtype = np.int32 if shift < 32 else np.uint64
     pad = _pad_of(dtype)
+    for drow, _vrow, crow in rows:
+        if (drow and drow[-1][0] == int(pad)) or (crow and crow[-1] == int(pad)):
+            raise OverflowError("dot collides with the pad sentinel")
+    if len(rid_cols) > n_rep:
+        raise ValueError(f"n_rep {n_rep} too small for {len(rid_cols)} replicas")
     wl = bucket(max((len(r[0]) for r in rows), default=1), 4)
     wc = bucket(max((len(r[2]) for r in rows), default=1), 4)
     b = len(rows)

@@ -139,6 +139,65 @@ def test_same_repo_ordering_across_connections():
     asyncio.run(main())
 
 
+def test_shutdown_serializes_with_inflight_drain_and_fences_queued_writes():
+    """clean_shutdown_async must wait out a threaded drain before the
+    final flush, and a write queued BEHIND that drain must be rejected
+    (not silently lost after the final flush)."""
+
+    async def main():
+        server, db = make_server()
+        await server.start()
+        flushed = []
+        db.flush_deltas(flushed.append)  # register the sink
+        flushed.clear()
+        try:
+            slow_down_drain(db, "GCOUNT")
+            db.manager("GCOUNT").repo.converge(b"k", {99: 5})
+            # a write that lands BEFORE shutdown: must be in the final flush
+            await send_recv(server.port, b"GCOUNT INC k 2\r\n")
+            slow_task = asyncio.create_task(
+                send_recv(server.port, b"GCOUNT GET k\r\n")
+            )
+            await asyncio.sleep(0.05)  # the slow drain now holds the lock
+            late_task = asyncio.create_task(
+                send_recv(server.port, b"GCOUNT INC k 100\r\n")
+            )
+            await asyncio.sleep(0.05)
+            await db.clean_shutdown_async()
+            assert await slow_task == b":7\r\n"
+            late = await late_task
+            assert late.startswith(b"-SHUTDOWN"), late
+            # the pre-shutdown INC flushed; the fenced one did not
+            gcount = [b for name, b in flushed if name == "GCOUNT"]
+            assert any(
+                k == b"k" and d == {db.manager("GCOUNT").repo._identity: 2}
+                for batch in gcount
+                for k, d in batch
+            )
+            assert not any(
+                d.get(db.manager("GCOUNT").repo._identity, 0) >= 100
+                for batch in gcount
+                for _k, d in batch
+            )
+        finally:
+            await server.dispose()
+
+    asyncio.run(main())
+
+
+def test_treg_threshold_offload_predicate():
+    """may_drain must predict the drain the SET is about to trigger
+    (+1 for the row it adds), so threshold drains go to a worker thread."""
+    from jylis_tpu.models import repo_treg
+
+    repo = repo_treg.RepoTREG(identity=1)
+    for i in range(repo_treg.PENDING_DRAIN_THRESHOLD - 1):
+        repo._write(b"t%d" % i, b"v", 1)
+    assert repo.may_drain([b"SET", b"tX", b"v", b"1"])
+    assert not repo.may_drain([b"GET", b"tX"])
+    assert repo.needs_background_drain(1)
+
+
 def test_pipelined_connection_replies_stay_in_order():
     """One connection pipelines a device-bound GET and host-only commands;
     RESP replies must come back in request order."""

@@ -105,6 +105,49 @@ def test_plan_shift_narrow_and_wide():
     big = UJSON()
     big.ctx.vv[2] = 1 << 30  # seq too large for a narrow layout
     assert dev.plan_shift([a, big], n_rep=8) == 32
+    # the all-ones seq is reserved: it would pack to the PAD sentinel
+    edge = UJSON()
+    edge.ctx.vv[2] = (1 << 28) - 1
+    assert dev.plan_shift([edge], n_rep=8) == 32
+
+
+def test_encode_rejects_seqs_beyond_device_layouts():
+    """vv seqs past u32 cannot be represented on device; encode refuses
+    (clamping would shrink coverage and resurrect removed entries) and
+    the serving repo falls back to the host lattice."""
+    big = UJSON()
+    big.ctx.vv[3] = 1 << 33
+    with pytest.raises(OverflowError):
+        dev.encode_docs([big], {}, lambda p, t: 0, n_rep=4, shift=32)
+
+    from jylis_tpu.models import repo_ujson as mod
+
+    remote = UJSON()
+    remote.ctx.vv[7] = 1 << 33  # huge causal history
+    d = UJSON()
+    remote.ins(7, ("k",), "5", delta=d)
+    d.ctx.vv[7] = 1 << 33  # delta carries the wide context
+
+    repo = mod.RepoUJSON(identity=1)
+    import pytest as _pytest  # noqa: F401
+
+    old = mod.DEVICE_FANIN_MIN
+    try:
+        mod.DEVICE_FANIN_MIN = 1  # force the device path attempt
+        repo.converge(b"doc", d)
+        r = []
+
+        class _R:
+            def string(self, s):
+                r.append(s)
+
+            def ok(self):
+                pass
+
+        repo.apply(_R(), [b"GET", b"doc", b"k"])
+        assert r == ["5"]  # host fallback converged it
+    finally:
+        mod.DEVICE_FANIN_MIN = old
 
 
 def test_add_wins_concurrent_rm_ins():
