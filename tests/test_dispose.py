@@ -1,0 +1,72 @@
+"""Clean-shutdown driver sequencing (main.Dispose): intake stops
+immediately, the final flush and snapshot serialize with repo locks, the
+listeners stop, and `done` is always set — even while a threaded drain
+is in flight."""
+
+import asyncio
+import time
+
+import jylis_tpu  # noqa: F401
+from jylis_tpu import persist
+from jylis_tpu.main import Dispose
+from jylis_tpu.models.database import Database
+from jylis_tpu.server.server import Server
+from jylis_tpu.utils.config import Config
+from jylis_tpu.utils.log import Log
+
+from test_server import send_recv
+
+
+class _FakeCluster:
+    def __init__(self):
+        self.disposed = False
+
+    def dispose(self):
+        self.disposed = True
+
+
+def test_dispose_sequence_with_inflight_drain(tmp_path):
+    snap = str(tmp_path / "node.snapshot")
+
+    async def main():
+        cfg = Config()
+        cfg.port = "0"
+        cfg.log = Log.create_none()
+        db = Database(identity=3)
+        server = Server(cfg, db)
+        await server.start()
+        cluster = _FakeCluster()
+        disp = Dispose(db, server, cluster, snapshot_path=snap, log=cfg.log)
+
+        await send_recv(server.port, b"GCOUNT INC k 9\r\n")
+        # a slow threaded drain in flight when the signal lands
+        repo = db.manager("GCOUNT").repo
+        orig = repo.drain
+        repo.drain = lambda: (time.sleep(0.4), orig())[1]
+        repo.converge(b"k", {55: 1})
+        slow = asyncio.create_task(send_recv(server.port, b"GCOUNT GET k\r\n"))
+        await asyncio.sleep(0.05)
+
+        disp.dispose()
+        disp.dispose()  # idempotent
+        # intake rejected immediately, before the drain finishes
+        rejected = await send_recv(server.port, b"GCOUNT INC k 5\r\n")
+        assert rejected.startswith(b"-SHUTDOWN")
+        await asyncio.wait_for(disp.done.wait(), timeout=10)
+        assert cluster.disposed
+        assert await slow == b":10\r\n"  # in-flight read still completed
+
+        # the snapshot exists and restores the pre-shutdown state
+        db2 = Database(identity=3)
+        assert persist.load_snapshot(db2, snap) > 0
+        out = []
+
+        class _R:
+            def u64(self, v):
+                out.append(v)
+
+        db2.manager("GCOUNT").repo.drain()
+        db2.manager("GCOUNT").repo.apply(_R(), [b"GET", b"k"])
+        assert out == [10]
+
+    asyncio.run(main())
