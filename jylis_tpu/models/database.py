@@ -7,6 +7,8 @@ words, fans flush/converge to the repos, and joins shutdown.
 
 from __future__ import annotations
 
+from contextlib import AsyncExitStack, asynccontextmanager
+
 from .help import DATATYPE_HELP, respond_help
 from .manager import RepoManager
 from .repo_counters import RepoGCOUNT, RepoPNCOUNT
@@ -97,26 +99,14 @@ class Database:
         for mgr in self._map.values():
             await mgr.clean_shutdown_async()
 
-    def all_locks(self):
+    @asynccontextmanager
+    async def all_locks(self):
         """Async context holding every repo lock (fixed order): the
         shutdown snapshot dumps under it so nothing mutates mid-dump."""
-        from contextlib import AsyncExitStack
-
-        stack = AsyncExitStack()
-
-        async def _enter():
+        async with AsyncExitStack() as stack:
             for mgr in self._map.values():
                 await stack.enter_async_context(mgr._lock)
-            return stack
-
-        class _Ctx:
-            async def __aenter__(self):
-                return await _enter()
-
-            async def __aexit__(self, *exc):
-                return await stack.__aexit__(*exc)
-
-        return _Ctx()
+            yield
 
 
 class _NullRespond:

@@ -126,6 +126,11 @@ class RepoManager:
         async with self._lock:
             self.flush_deltas(fn)
 
+    def busy(self) -> bool:
+        """True while a (possibly threaded) repo access holds the lock —
+        the server's native fast path defers to Python while true."""
+        return self._lock.locked()
+
     async def clean_shutdown_async(self) -> None:
         """Lock-holding shutdown: waits out any in-flight threaded drain,
         then stops intake and performs the final flush atomically."""

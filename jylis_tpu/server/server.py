@@ -98,7 +98,7 @@ class Server:
     def _native_busy(self, parser) -> bool:
         g = self._database.manager("GCOUNT")
         pn = self._database.manager("PNCOUNT")
-        return g._lock.locked() or pn._lock.locked() or parser.has_pending()
+        return g.busy() or pn.busy() or parser.has_pending()
 
     async def _apply_native(self, engine, buf, parser, resp, writer):
         """Drain `buf` through the native counter engine; commands it

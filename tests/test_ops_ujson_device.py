@@ -129,8 +129,6 @@ def test_encode_rejects_seqs_beyond_device_layouts():
     d.ctx.vv[7] = 1 << 33  # delta carries the wide context
 
     repo = mod.RepoUJSON(identity=1)
-    import pytest as _pytest  # noqa: F401
-
     old = mod.DEVICE_FANIN_MIN
     try:
         mod.DEVICE_FANIN_MIN = 1  # force the device path attempt
