@@ -30,6 +30,7 @@ class Server:
         self._database = database
         self._log = config.log
         self._server: asyncio.base_events.Server | None = None
+        self._conns: set[asyncio.StreamWriter] = set()
 
     async def start(self) -> None:
         try:
@@ -54,6 +55,7 @@ class Server:
         engine = getattr(self._database, "native_engine", None)
         use_native = engine is not None
         buf = bytearray()
+        self._conns.add(writer)
         try:
             while True:
                 data = await reader.read(1 << 16)
@@ -89,6 +91,7 @@ class Server:
         except (ConnectionResetError, BrokenPipeError, asyncio.CancelledError):
             pass
         finally:
+            self._conns.discard(writer)
             writer.close()
             try:
                 await writer.wait_closed()
@@ -143,8 +146,12 @@ class Server:
             return True  # rc == 0: consumed all complete commands
 
     async def dispose(self) -> None:
-        """Stop listening (client connections wind down as they close —
-        the reference has the same posture, server.pony:16-20)."""
+        """Stop listening and close client connections (the reference
+        stops its listener and lets process exit end connections,
+        server.pony:16-20; Python 3.12's wait_closed would otherwise
+        block shutdown until every idle client hung up on its own)."""
         if self._server is not None:
             self._server.close()
+            for w in list(self._conns):
+                w.close()
             await self._server.wait_closed()

@@ -25,6 +25,30 @@ class _FakeCluster:
         self.disposed = True
 
 
+def test_dispose_completes_with_idle_open_connection():
+    """An idle client that never hangs up must not block shutdown
+    (Python 3.12's Server.wait_closed waits for handlers; dispose closes
+    client connections like the reference's listener-stop posture)."""
+
+    async def main():
+        cfg = Config()
+        cfg.port = "0"
+        cfg.log = Log.create_none()
+        db = Database(identity=4)
+        server = Server(cfg, db)
+        await server.start()
+        reader, writer = await asyncio.open_connection("127.0.0.1", server.port)
+        writer.write(b"GCOUNT INC k 1\r\n")
+        await writer.drain()
+        await asyncio.wait_for(reader.read(5), timeout=2)
+        # client stays connected and silent; dispose must still finish
+        await asyncio.wait_for(server.dispose(), timeout=5)
+        eof = await asyncio.wait_for(reader.read(1 << 10), timeout=2)
+        assert eof == b""
+
+    asyncio.run(main())
+
+
 def test_dispose_sequence_with_inflight_drain(tmp_path):
     snap = str(tmp_path / "node.snapshot")
 
