@@ -32,6 +32,12 @@ from .base import PAD_ROW, ParseError, bucket, need, parse_opt_count, parse_u64
 from ..utils.metrics import timed_drain
 from .help import RepoHelp
 
+# pending work flushes to the device at these sizes: reads never need a
+# drain (the merged view computes host-side), so the thresholds bound
+# host memory while keeping device batches large
+ROW_DRAIN_THRESHOLD = 1024  # entries pending on one row
+PENDING_DRAIN_THRESHOLD = 4096  # rows with pending work
+
 TLOG_HELP = RepoHelp(
     "TLOG",
     {
@@ -136,20 +142,24 @@ class RepoTLOG:
             value = need(args, 2)
             ts = parse_u64(need(args, 3))
             row = self._row_for(key)
-            self._pend_entries.setdefault(row, []).append((ts, value))
+            lst = self._pend_entries.setdefault(row, [])
+            lst.append((ts, value))
             if ts >= self._cut_cache.get(row, 0):
                 self._delta_for(key).insert(value, ts)
+            if (
+                len(lst) >= ROW_DRAIN_THRESHOLD
+                or len(self._pend_entries) >= PENDING_DRAIN_THRESHOLD
+            ):
+                self.drain()
             resp.ok()
             return True
         if op == b"SIZE":
-            self.drain()
             row = self._keys.get(need(args, 1))
-            resp.u64(self._len_cache.get(row, 0) if row is not None else 0)
+            resp.u64(len(self._merged_view(row)[0]) if row is not None else 0)
             return False
         if op == b"CUTOFF":
-            self.drain()
             row = self._keys.get(need(args, 1))
-            resp.u64(self._cut_cache.get(row, 0) if row is not None else 0)
+            resp.u64(self._cutoff_view(row) if row is not None else 0)
             return False
         if op == b"TRIMAT":
             key = need(args, 1)
@@ -169,24 +179,51 @@ class RepoTLOG:
             return True
         raise ParseError()
 
+    def _drained_entries(self, row: int) -> list[tuple[int, bytes]]:
+        """The drained part of a row, (ts, value) desc — the render cache,
+        rebuilt from ONE device row gather when a drain/trim dropped it."""
+        ents = self._render.get(row)
+        if ents is None:
+            length = self._len_cache.get(row, 0)
+            if length == 0:
+                ents = []
+            else:
+                ts_row, vid_row = _get_row(self._state, row)
+                ts_row = np.asarray(ts_row)
+                vid_row = np.asarray(vid_row)
+                ents = [
+                    (int(ts_row[i]), self._interner.lookup(int(vid_row[i])))
+                    for i in range(length)
+                ]
+                ents.sort(reverse=True)
+            self._render[row] = ents
+        return ents
+
+    def _cutoff_view(self, row: int) -> int:
+        return max(self._cut_cache.get(row, 0), self._pend_cutoff.get(row, 0))
+
+    def _merged_view(self, row: int) -> tuple[list[tuple[int, bytes]], int]:
+        """The exact log as a drain would leave it — drained ∪ pending,
+        deduped (equal ts AND value), cutoff-filtered, (ts, value) desc —
+        computed on the host: reads NEVER pay a device drain (at most one
+        row gather for the drained base). The lattice merge is a set
+        union, so the host and device merges agree exactly
+        (tlog.md:116-133 semantics)."""
+        cut = self._cutoff_view(row)
+        base = self._drained_entries(row)
+        pend = self._pend_entries.get(row)
+        if not pend and cut == self._cut_cache.get(row, 0):
+            return base, cut  # quiescent: the cache IS the answer
+        merged = {e for e in base if e[0] >= cut}
+        merged.update(e for e in pend or () if e[0] >= cut)
+        return sorted(merged, reverse=True), cut
+
     def _cmd_get(self, resp, key: bytes, count: int) -> None:
-        self.drain()
         row = self._keys.get(key)
         if row is None:
             resp.array_start(0)
             return
-        ents = self._render.get(row)
-        if ents is None:
-            length = self._len_cache.get(row, 0)
-            ts_row, vid_row = _get_row(self._state, row)
-            ts_row = np.asarray(ts_row)
-            vid_row = np.asarray(vid_row)
-            ents = [
-                (int(ts_row[i]), self._interner.lookup(int(vid_row[i])))
-                for i in range(length)
-            ]
-            ents.sort(key=lambda e: (e[0], e[1]), reverse=True)
-            self._render[row] = ents
+        ents, _cut = self._merged_view(row)
         n = min(count, len(ents))
         resp.array_start(n)
         for ts, value in ents[:n]:
@@ -243,9 +280,13 @@ class RepoTLOG:
         entries, cutoff = delta
         row = self._row_for(key)
         if entries:
-            self._pend_entries.setdefault(row, []).extend(
-                (ts, value) for value, ts in entries
-            )
+            lst = self._pend_entries.setdefault(row, [])
+            lst.extend((ts, value) for value, ts in entries)
+            if (
+                len(lst) >= ROW_DRAIN_THRESHOLD
+                or len(self._pend_entries) >= PENDING_DRAIN_THRESHOLD
+            ):
+                self.drain()
         if cutoff:
             self._pend_cutoff[row] = max(self._pend_cutoff.get(row, 0), cutoff)
 
@@ -254,16 +295,28 @@ class RepoTLOG:
 
     def may_drain(self, args: list[bytes]) -> bool:
         """Device-bound commands the server offloads to a thread: trims
-        always dispatch a device call; reads only when deltas are pending
-        (quiescent reads serve from the host render/len/cut caches)."""
+        always dispatch; an INS that will tip a drain threshold does.
+        Reads NEVER drain — GET/SIZE/CUTOFF serve the exact merged view
+        host-side (_merged_view); at most a GET rebuilds the render base
+        with one row gather, cheap enough to stay inline."""
         if not args:
             return False
         op = args[0]
         if op in (b"TRIM", b"TRIMAT", b"CLR"):
             return True
-        if op in (b"GET", b"SIZE", b"CUTOFF"):
-            return bool(self._pend_entries or self._pend_cutoff)
+        if op == b"INS" and len(args) >= 2:
+            row = self._keys.get(args[1])
+            in_row = len(self._pend_entries.get(row, ())) if row is not None else 0
+            return (
+                in_row + 1 >= ROW_DRAIN_THRESHOLD
+                or len(self._pend_entries) + 1 >= PENDING_DRAIN_THRESHOLD
+            )
         return False
+
+    def needs_background_drain(self, incoming: int) -> bool:
+        """Cluster converge path: pre-drain in a worker thread before a
+        batch that would tip the row-count threshold."""
+        return len(self._pend_entries) + incoming >= PENDING_DRAIN_THRESHOLD
 
     def flush_deltas(self):
         out = [

@@ -142,6 +142,41 @@ def test_treg_reads_never_touch_device(db, monkeypatch):
     assert run(db, "TREG", "GET", "m") == b"*2\r\n$4\r\nzeta\r\n:5\r\n"
 
 
+def test_tlog_reads_never_drain(db, monkeypatch):
+    """GET/SIZE/CUTOFF with pending entries serve the exact merged view
+    host-side — no drain dispatch; answers equal the post-drain truth
+    (union + dedup + cutoff filter, tlog.md:116-133)."""
+    from jylis_tpu.models import repo_tlog
+
+    run(db, "TLOG", "INS", "m", "base", "10")
+    run(db, "TLOG", "GET", "m")  # drain + render cache for the base
+    repo = db.manager("TLOG").repo
+    run(db, "TLOG", "INS", "m", "new", "20")
+    repo.converge(b"m", ([(b"base", 10), (b"old", 1)], 5))  # dup + cutoff 5
+
+    calls = {"n": 0}
+    monkeypatch.setattr(
+        repo_tlog, "_drain",
+        lambda *a: calls.__setitem__("n", calls["n"] + 1),
+    )
+    monkeypatch.setattr(
+        type(repo), "_drain_sharded",
+        lambda *a: calls.__setitem__("n", calls["n"] + 1),
+    )
+    want = (
+        b"*2\r\n*2\r\n$3\r\nnew\r\n:20\r\n*2\r\n$4\r\nbase\r\n:10\r\n"
+    )
+    assert run(db, "TLOG", "GET", "m") == want  # deduped, desc
+    assert run(db, "TLOG", "SIZE", "m") == b":2\r\n"
+    assert run(db, "TLOG", "CUTOFF", "m") == b":5\r\n"
+    assert calls["n"] == 0
+    monkeypatch.undo()
+    repo.drain()  # the device agrees with the host merge
+    assert run(db, "TLOG", "GET", "m") == want
+    assert run(db, "TLOG", "SIZE", "m") == b":2\r\n"
+    assert run(db, "TLOG", "CUTOFF", "m") == b":5\r\n"
+
+
 def test_tlog_quiescent_reads_skip_device(db, monkeypatch):
     """After a drain, repeated GET/SIZE/CUTOFF perform ZERO device calls:
     GET serves from the rendered row cache, SIZE/CUTOFF from the host
