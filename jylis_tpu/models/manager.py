@@ -114,13 +114,13 @@ class RepoManager:
         async with self._lock:
             if self._shutdown:
                 return  # fire-and-forget: late deltas re-deliver elsewhere
-            # when this batch will tip the repo over its drain threshold,
-            # drain in a worker thread FIRST — converge() draining inline
-            # would stall the event loop for a device dispatch
-            needs = getattr(self.repo, "needs_background_drain", None)
-            if needs is not None and needs(len(batch)):
+            self.converge_deltas(batch)  # buffers only: host-fast
+            # threshold drains run AFTER buffering, in a worker thread —
+            # never inline on the event loop; the post-state check is
+            # exact where any pre-batch prediction can miss per-row sizes
+            overdue = getattr(self.repo, "drain_overdue", None)
+            if overdue is not None and overdue():
                 await asyncio.to_thread(self.repo.drain)
-            self.converge_deltas(batch)
 
     async def flush_async(self, fn) -> None:
         async with self._lock:

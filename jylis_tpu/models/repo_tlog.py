@@ -95,6 +95,8 @@ class RepoTLOG:
         # quiescent GETs never dispatch to the device (the counter repos'
         # host-shadow pattern, repo_counters.py)
         self._render: dict[int, list[tuple[int, bytes]]] = {}
+        # row -> ((pend_len, cutoff), merged list): read-time merge memo
+        self._merged: dict[int, tuple] = {}
         # row -> (entries [(ts, value)], incoming-delta cutoff)
         self._pend_entries: dict[int, list[tuple[int, bytes]]] = {}
         self._pend_cutoff: dict[int, int] = {}
@@ -155,7 +157,12 @@ class RepoTLOG:
             return True
         if op == b"SIZE":
             row = self._keys.get(need(args, 1))
-            resp.u64(len(self._merged_view(row)[0]) if row is not None else 0)
+            if row is None:
+                resp.u64(0)
+            elif self._quiescent(row):
+                resp.u64(self._len_cache.get(row, 0))  # O(1), no gather
+            else:
+                resp.u64(len(self._merged_view(row)[0]))
             return False
         if op == b"CUTOFF":
             row = self._keys.get(need(args, 1))
@@ -202,21 +209,34 @@ class RepoTLOG:
     def _cutoff_view(self, row: int) -> int:
         return max(self._cut_cache.get(row, 0), self._pend_cutoff.get(row, 0))
 
+    def _quiescent(self, row: int) -> bool:
+        return row not in self._pend_entries and self._cutoff_view(
+            row
+        ) == self._cut_cache.get(row, 0)
+
     def _merged_view(self, row: int) -> tuple[list[tuple[int, bytes]], int]:
         """The exact log as a drain would leave it — drained ∪ pending,
         deduped (equal ts AND value), cutoff-filtered, (ts, value) desc —
         computed on the host: reads NEVER pay a device drain (at most one
         row gather for the drained base). The lattice merge is a set
         union, so the host and device merges agree exactly
-        (tlog.md:116-133 semantics)."""
+        (tlog.md:116-133 semantics). Merges memoise on the pending state,
+        so read-heavy bursts between writes pay one merge, not one per
+        read."""
         cut = self._cutoff_view(row)
+        if self._quiescent(row):
+            return self._drained_entries(row), cut
+        state = (len(self._pend_entries.get(row, ())), cut)
+        hit = self._merged.get(row)
+        if hit is not None and hit[0] == state:
+            return hit[1], cut
         base = self._drained_entries(row)
         pend = self._pend_entries.get(row)
-        if not pend and cut == self._cut_cache.get(row, 0):
-            return base, cut  # quiescent: the cache IS the answer
         merged = {e for e in base if e[0] >= cut}
         merged.update(e for e in pend or () if e[0] >= cut)
-        return sorted(merged, reverse=True), cut
+        out = sorted(merged, reverse=True)
+        self._merged[row] = (state, out)
+        return out, cut
 
     def _cmd_get(self, resp, key: bytes, count: int) -> None:
         row = self._keys.get(key)
@@ -260,6 +280,7 @@ class RepoTLOG:
             j = int(np.nonzero(slots >= 0)[0][0])
             lens, cuts = np.asarray(out[5]), np.asarray(out[6])
             self._render.pop(row, None)
+            self._merged.pop(row, None)
             self._len_cache[row] = int(lens[j])
             self._cut_cache[row] = int(cuts[j])
         else:
@@ -270,6 +291,7 @@ class RepoTLOG:
             counts[0] = count
             self._state, lens, cuts = _trim(self._state, ki, counts)
             self._render.pop(row, None)
+            self._merged.pop(row, None)
             self._len_cache[row] = int(np.asarray(lens)[0])
             self._cut_cache[row] = int(np.asarray(cuts)[0])
         self._delta_for(key).raise_cutoff(self._cut_cache[row])
@@ -277,16 +299,14 @@ class RepoTLOG:
     # -- lattice plumbing ---------------------------------------------------
 
     def converge(self, key: bytes, delta: tuple) -> None:
+        # buffer only: the serving path drains via drain_overdue in a
+        # worker thread; sync callers (snapshot restore) drain explicitly
         entries, cutoff = delta
         row = self._row_for(key)
         if entries:
-            lst = self._pend_entries.setdefault(row, [])
-            lst.extend((ts, value) for value, ts in entries)
-            if (
-                len(lst) >= ROW_DRAIN_THRESHOLD
-                or len(self._pend_entries) >= PENDING_DRAIN_THRESHOLD
-            ):
-                self.drain()
+            self._pend_entries.setdefault(row, []).extend(
+                (ts, value) for value, ts in entries
+            )
         if cutoff:
             self._pend_cutoff[row] = max(self._pend_cutoff.get(row, 0), cutoff)
 
@@ -313,10 +333,13 @@ class RepoTLOG:
             )
         return False
 
-    def needs_background_drain(self, incoming: int) -> bool:
-        """Cluster converge path: pre-drain in a worker thread before a
-        batch that would tip the row-count threshold."""
-        return len(self._pend_entries) + incoming >= PENDING_DRAIN_THRESHOLD
+    def drain_overdue(self) -> bool:
+        """Cluster converge path: after buffering a batch, the manager
+        offloads the drain to a worker thread when any threshold trips."""
+        return len(self._pend_entries) >= PENDING_DRAIN_THRESHOLD or any(
+            len(lst) >= ROW_DRAIN_THRESHOLD
+            for lst in self._pend_entries.values()
+        )
 
     def flush_deltas(self):
         out = [
@@ -401,6 +424,7 @@ class RepoTLOG:
             cuts = np.asarray(cuts)
             for i, row in enumerate(rows):
                 self._render.pop(row, None)
+                self._merged.pop(row, None)
                 self._len_cache[row] = int(lens[i])
                 self._cut_cache[row] = int(cuts[i])
             self._pend_entries.clear()
@@ -452,6 +476,7 @@ class RepoTLOG:
                     continue
                 row = int(g)
                 self._render.pop(row, None)
+                self._merged.pop(row, None)
                 self._len_cache[row] = int(lens[j])
                 self._cut_cache[row] = int(cuts[j])
             self._pend_entries.clear()

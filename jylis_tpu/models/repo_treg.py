@@ -144,10 +144,10 @@ class RepoTREG:
             self._pending[row] = (ts, value)
 
     def converge(self, key: bytes, delta: tuple) -> None:
+        # buffer only: the serving path drains via drain_overdue in a
+        # worker thread; sync callers (snapshot restore) drain explicitly
         value, ts = delta
         self._write(key, value, ts)
-        if len(self._pending) >= PENDING_DRAIN_THRESHOLD:
-            self.drain()
 
     def deltas_size(self) -> int:
         return len(self._deltas)
@@ -163,11 +163,10 @@ class RepoTREG:
             and len(self._pending) + 1 >= PENDING_DRAIN_THRESHOLD
         )
 
-    def needs_background_drain(self, incoming: int) -> bool:
-        """Cluster converge path: drain in a worker thread BEFORE a batch
-        that would tip the threshold (converge's inline drain would stall
-        the event loop for a device dispatch)."""
-        return len(self._pending) + incoming >= PENDING_DRAIN_THRESHOLD
+    def drain_overdue(self) -> bool:
+        """Cluster converge path: after buffering a batch, the manager
+        offloads the drain to a worker thread when this trips."""
+        return len(self._pending) >= PENDING_DRAIN_THRESHOLD
 
     def flush_deltas(self):
         out = sorted(self._deltas.items())

@@ -31,6 +31,7 @@ class Server:
         self._log = config.log
         self._server: asyncio.base_events.Server | None = None
         self._conns: set[asyncio.StreamWriter] = set()
+        self._closing = False
 
     async def start(self) -> None:
         try:
@@ -50,6 +51,11 @@ class Server:
     async def _handle_client(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
+        if self._closing:
+            # accepted just before dispose: the close loop could not see
+            # this writer yet, and wait_closed would wait on it forever
+            writer.close()
+            return
         parser = make_parser()  # native scanner when built, Python fallback
         resp = Respond(writer.write)
         engine = getattr(self._database, "native_engine", None)
@@ -150,6 +156,7 @@ class Server:
         stops its listener and lets process exit end connections,
         server.pony:16-20; Python 3.12's wait_closed would otherwise
         block shutdown until every idle client hung up on its own)."""
+        self._closing = True  # handlers not yet in _conns self-close
         if self._server is not None:
             self._server.close()
             for w in list(self._conns):

@@ -195,7 +195,8 @@ def test_treg_threshold_offload_predicate():
         repo._write(b"t%d" % i, b"v", 1)
     assert repo.may_drain([b"SET", b"tX", b"v", b"1"])
     assert not repo.may_drain([b"GET", b"tX"])
-    assert repo.needs_background_drain(1)
+    repo.converge(b"tX", (b"v", 1))  # tips the threshold: buffered only
+    assert repo.drain_overdue()
 
 
 def test_pipelined_connection_replies_stay_in_order():
