@@ -2,13 +2,15 @@
 
 Reference analog: repo_tlog.pony:16-111 (Map[key -> TLog], per-key list
 insertion). Here the keyspace is the padded ops/tlog block; local INS and
-incoming delta logs coalesce host-side per key and drain as one vmap'd
-merge kernel call. TRIM/TRIMAT/CLR are batched device ops whose returned
-(length, cutoff) pairs maintain the host serving cache, so SIZE/CUTOFF are
-host lookups; GET serves from a per-row rendered host cache (exact
-documented ordering even on rank-prefix collisions), rebuilt by a one-row
-device gather only on the first read after a merge or trim touches the
-row — a quiescent GET performs zero device calls.
+incoming delta logs buffer host-side per key and drain as one vmap'd
+merge kernel call at write thresholds and snapshots. TRIM/TRIMAT/CLR are
+batched device ops whose returned (length, cutoff) pairs maintain the
+host caches. Reads never drain: GET/SIZE/CUTOFF serve the exact merged
+view (_merged_view — union + dedup + cutoff filter over the drained
+render cache and the pending buffer, memoised per row); the only device
+touch a read can make is the one-row gather that rebuilds the render
+base after a drain or trim, and a quiescent read performs zero device
+calls.
 
 Delta wire shape: (entries: list[(value: bytes, ts: u64)], cutoff: u64).
 """
