@@ -76,6 +76,82 @@ def test_tlog_random_ops_match_hostref(seed):
         assert int(np.asarray(state.length[k])) == refs[k].size()
 
 
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_repo_reads_match_hostref_without_drains(seed):
+    """REPO-level differential: the drain-free read path (host merged
+    view) must answer GET/SIZE/CUTOFF exactly like the oracle at every
+    point of a random INS/converge/TRIM/read interleaving — regardless
+    of when drains actually happen."""
+    from jylis_tpu.models.repo_tlog import RepoTLOG
+
+    class _T:
+        def __init__(self):
+            self.out = []
+
+        def ok(self):
+            pass
+
+        def array_start(self, n):
+            self.out.append(("arr", n))
+
+        def string(self, s):
+            self.out.append(s)
+
+        def u64(self, v):
+            self.out.append(v)
+
+    rng = np.random.default_rng(seed)
+    repo = RepoTLOG(identity=1)
+    keys = [b"r%d" % i for i in range(4)]
+    refs = {k: hostref.TLog() for k in keys}
+
+    def check(k):
+        t = _T()
+        repo.apply(t, [b"GET", k])
+        want = [("arr", refs[k].size())]
+        for value, ts in refs[k].latest():
+            want += [("arr", 2), value, ts]
+        assert t.out == want, (k, t.out, want)
+        t = _T()
+        repo.apply(t, [b"SIZE", k])
+        assert t.out == [refs[k].size()]
+        t = _T()
+        repo.apply(t, [b"CUTOFF", k])
+        assert t.out == [refs[k].cutoff]
+
+    for _ in range(250):
+        k = keys[rng.integers(len(keys))]
+        roll = rng.random()
+        if roll < 0.45:
+            v = bytes([97 + int(rng.integers(3))])
+            t = int(rng.integers(0, 25))
+            repo.apply(_T(), [b"INS", k, v, b"%d" % t])
+            refs[k].insert(v, t)
+        elif roll < 0.6:
+            # remote delta: entries + cutoff in one converge
+            v = bytes([100 + int(rng.integers(3))])
+            t = int(rng.integers(0, 25))
+            cut = int(rng.integers(0, 8))
+            repo.converge(k, ([(v, t)], cut))
+            other = hostref.TLog()
+            other.insert(v, t)
+            other.raise_cutoff(cut)
+            refs[k].converge(other)
+        elif roll < 0.7:
+            c = int(rng.integers(0, 5))
+            repo.apply(_T(), [b"TRIM", k, b"%d" % c])
+            refs[k].trim(c)
+        elif roll < 0.75:
+            repo.drain()  # arbitrary drain points must not change answers
+        else:
+            check(k)
+    for k in keys:
+        check(k)
+    repo.drain()
+    for k in keys:
+        check(k)
+
+
 def test_tlog_merge_order_independent():
     """Three replicas write disjoint + overlapping entries; all delivery
     orders converge to the oracle merge."""
