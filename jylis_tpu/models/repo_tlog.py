@@ -102,6 +102,7 @@ class RepoTLOG:
         # row -> (entries [(ts, value)], incoming-delta cutoff)
         self._pend_entries: dict[int, list[tuple[int, bytes]]] = {}
         self._pend_cutoff: dict[int, int] = {}
+        self._row_overdue = False  # some row crossed ROW_DRAIN_THRESHOLD
         self._deltas: dict[bytes, hostref.TLog] = {}
 
     def _round_cap(self, k: int) -> int:
@@ -306,9 +307,10 @@ class RepoTLOG:
         entries, cutoff = delta
         row = self._row_for(key)
         if entries:
-            self._pend_entries.setdefault(row, []).extend(
-                (ts, value) for value, ts in entries
-            )
+            lst = self._pend_entries.setdefault(row, [])
+            lst.extend((ts, value) for value, ts in entries)
+            if len(lst) >= ROW_DRAIN_THRESHOLD:
+                self._row_overdue = True
         if cutoff:
             self._pend_cutoff[row] = max(self._pend_cutoff.get(row, 0), cutoff)
 
@@ -337,10 +339,11 @@ class RepoTLOG:
 
     def drain_overdue(self) -> bool:
         """Cluster converge path: after buffering a batch, the manager
-        offloads the drain to a worker thread when any threshold trips."""
-        return len(self._pend_entries) >= PENDING_DRAIN_THRESHOLD or any(
-            len(lst) >= ROW_DRAIN_THRESHOLD
-            for lst in self._pend_entries.values()
+        offloads the drain to a worker thread when any threshold trips.
+        O(1): converge flags row-threshold crossings as it appends."""
+        return (
+            self._row_overdue
+            or len(self._pend_entries) >= PENDING_DRAIN_THRESHOLD
         )
 
     def flush_deltas(self):
@@ -431,6 +434,7 @@ class RepoTLOG:
                 self._cut_cache[row] = int(cuts[i])
             self._pend_entries.clear()
             self._pend_cutoff.clear()
+            self._row_overdue = False
             return
 
     def _drain_sharded(self, rows) -> None:
@@ -483,4 +487,5 @@ class RepoTLOG:
                 self._cut_cache[row] = int(cuts[j])
             self._pend_entries.clear()
             self._pend_cutoff.clear()
+            self._row_overdue = False
             return

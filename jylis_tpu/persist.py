@@ -81,4 +81,8 @@ def load_snapshot(database, path: str) -> int:
     # fully validated: only now touch the database
     for msg in msgs:
         database.manager(msg.name).repo.load_state(list(msg.batch))
+    # restored state lands on the device NOW: converge only buffers, and
+    # leaving a whole snapshot in host pending buffers would bypass the
+    # drain thresholds and tax every read with the merge path
+    database.drain_all()
     return len(msgs)
