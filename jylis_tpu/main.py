@@ -43,6 +43,7 @@ class Dispose:
         self._snapshot_path = snapshot_path
         self._log = log
         self._disposing = False
+        self._shutdown_task: asyncio.Task | None = None
         self.done = asyncio.Event()
 
     def on_signal(self) -> None:
@@ -56,9 +57,13 @@ class Dispose:
         self._disposing = True
         # signal callback: stop intake NOW (sync-safe), then run the
         # lock-holding shutdown sequence as a task — the final flush and
-        # snapshot must serialise with any in-flight threaded drain
+        # snapshot must serialise with any in-flight threaded drain.
+        # The loop holds only a weak ref to tasks; keep a strong one so
+        # the shutdown (final flush + snapshot) can't be collected mid-run
         self._database.stop_intake()
-        asyncio.get_running_loop().create_task(self._shutdown())
+        self._shutdown_task = asyncio.get_running_loop().create_task(
+            self._shutdown()
+        )
 
     async def _shutdown(self) -> None:
         # device drains can raise at shutdown; the listeners must still stop

@@ -321,8 +321,11 @@ class RepoTLOG:
         """Device-bound commands the server offloads to a thread: trims
         always dispatch; an INS that will tip a drain threshold does.
         Reads NEVER drain — GET/SIZE/CUTOFF serve the exact merged view
-        host-side (_merged_view); at most a GET rebuilds the render base
-        with one row gather, cheap enough to stay inline."""
+        host-side (_merged_view) — but the first read after a drain/trim
+        rebuilds the render base with one device row gather, and over a
+        tunneled chip one dispatch can cost ~100 ms: offload it too so it
+        never stalls the event loop (the counter repos' foreign-GET
+        pattern)."""
         if not args:
             return False
         op = args[0]
@@ -335,6 +338,13 @@ class RepoTLOG:
                 in_row + 1 >= ROW_DRAIN_THRESHOLD
                 or len(self._pend_entries) + 1 >= PENDING_DRAIN_THRESHOLD
             )
+        if op in (b"GET", b"SIZE") and len(args) >= 2:
+            row = self._keys.get(args[1])
+            if row is None:
+                return False
+            if op == b"SIZE" and self._quiescent(row):
+                return False  # O(1) length-cache answer, no gather
+            return row not in self._render and self._len_cache.get(row, 0) > 0
         return False
 
     def drain_overdue(self) -> bool:

@@ -25,6 +25,10 @@ from .help import RepoHelp
 # pending deltas per key at which the fold moves to the device: below
 # this the host loop wins against a dispatch round-trip
 DEVICE_FANIN_MIN = 256
+# buffered remote deltas across all keys before the converge path forces
+# a drain: bounds host memory for write-hot, never-read keys the same way
+# TLOG's PENDING_DRAIN_THRESHOLD does (repo_tlog.py:41)
+PENDING_TOTAL_MAX = 4096
 
 UJSON_HELP = RepoHelp(
     "UJSON",
@@ -51,6 +55,8 @@ class RepoUJSON:
         self._data: dict[bytes, UJSON] = {}
         self._deltas: dict[bytes, UJSON] = {}
         self._pend: dict[bytes, list[UJSON]] = {}  # buffered remote deltas
+        self._pend_total = 0  # deltas across keys, O(1) overdue check
+        self._overdue = False  # some key's fan-in reached DEVICE_FANIN_MIN
 
     def _data_for(self, key: bytes) -> UJSON:
         d = self._data.get(key)
@@ -128,7 +134,18 @@ class RepoUJSON:
         raise ParseError()
 
     def converge(self, key: bytes, delta: UJSON) -> None:
-        self._pend.setdefault(key, []).append(delta)
+        lst = self._pend.setdefault(key, [])
+        lst.append(delta)
+        self._pend_total += 1
+        if len(lst) >= DEVICE_FANIN_MIN:
+            self._overdue = True
+
+    def drain_overdue(self) -> bool:
+        """Cluster converge path: the manager offloads a full drain to a
+        worker thread when a key's fan-in reaches device size or the
+        total buffered deltas hit the cap — a write-hot, never-read key
+        stays bounded like every other type."""
+        return self._overdue or self._pend_total >= PENDING_TOTAL_MAX
 
     may_drain_OPS = (b"GET", b"SET", b"CLR", b"RM")
 
@@ -146,6 +163,7 @@ class RepoUJSON:
         deltas = self._pend.pop(key, None)
         if not deltas:
             return
+        self._pend_total -= len(deltas)
         doc = self._data_for(key)
         if len(deltas) >= DEVICE_FANIN_MIN:
             try:
@@ -213,3 +231,4 @@ class RepoUJSON:
     def drain(self) -> None:
         for key in list(self._pend):
             self._drain_key(key)
+        self._overdue = False

@@ -54,7 +54,15 @@ class NativeRespParser:
         if self._ready:
             return self._ready.popleft()
         if self._bad:
-            raise RespError("protocol error")
+            # the scanner stops with the malformed bytes at the buffer
+            # head (resp_scan_many serves the prefix first); hand them to
+            # the oracle parser so the error message — client-visible
+            # bytes — matches the pure-Python serving path exactly
+            oracle = RespParser()
+            oracle.append(bytes(self._buf))
+            for _ in oracle:  # raises the specific RespError
+                pass
+            raise RespError("protocol error")  # scanner/oracle disagree
         raise StopIteration
 
     def _scan_burst(self) -> None:

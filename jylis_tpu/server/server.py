@@ -80,8 +80,6 @@ class Server:
                         use_native = await self._apply_native(
                             engine, buf, parser, resp, writer
                         )
-                        if use_native is None:  # protocol error: drop
-                            break
                         if use_native:
                             await writer.drain()
                             continue
@@ -112,16 +110,21 @@ class Server:
     async def _apply_native(self, engine, buf, parser, resp, writer):
         """Drain `buf` through the native counter engine; commands it
         can't settle route through the normal per-repo async path in
-        order. Returns True (stay native), False (demote this connection
-        to the Python path; tail moved into `parser`), or None (protocol
-        error: caller drops the connection)."""
+        order. Returns True (stay native) or False (demote this
+        connection to the Python path; tail moved into `parser` — on
+        malformed input the Python parser then renders its specific
+        error and the connection drops)."""
         g_mgr = self._database.manager("GCOUNT")
         pn_mgr = self._database.manager("PNCOUNT")
+
+        def demote() -> bool:
+            parser.append(bytes(buf))
+            buf.clear()
+            return False
+
         while True:
             if g_mgr._shutdown or pn_mgr._shutdown:
-                parser.append(bytes(buf))
-                buf.clear()
-                return False
+                return demote()
             # both counter tables mutate inside one native call: hold both
             # repo locks (fixed order), exactly the boundary apply_async
             # enforces per repo
@@ -142,13 +145,13 @@ class Server:
                 continue
             if rc == 2:  # reply buffer flushed; keep going
                 continue
-            if rc == -1:
-                resp.err("protocol error")
-                return None
-            if rc == -2:  # oversized command: Python handles from here on
-                parser.append(bytes(buf))
-                buf.clear()
-                return False
+            if rc < 0:
+                # rc -1: malformed input — the Python parser (the oracle)
+                # renders its specific error message so both serving paths
+                # stay byte-identical on protocol errors, then drops the
+                # connection. rc -2: oversized command — Python handles
+                # this connection from here on.
+                return demote()
             return True  # rc == 0: consumed all complete commands
 
     async def dispose(self) -> None:

@@ -216,3 +216,70 @@ def test_pipelined_connection_replies_stay_in_order():
             await server.dispose()
 
     asyncio.run(main())
+
+
+def test_ujson_converge_path_is_bounded():
+    """A write-hot, never-read UJSON key must not buffer deltas without
+    bound: the converge path reports overdue at device-fold size (or the
+    total cap) and a drain converges + empties the buffer."""
+    from jylis_tpu.models import repo_ujson
+    from jylis_tpu.ops.ujson_host import UJSON
+
+    repo = repo_ujson.RepoUJSON(identity=1)
+    src = repo_ujson.RepoUJSON(identity=2)
+
+    class _Null:
+        def __getattr__(self, name):
+            return lambda *a: None
+
+    for i in range(repo_ujson.DEVICE_FANIN_MIN):
+        src.apply(_Null(), [b"SET", b"doc", b"n", b"%d" % i])
+        for key, delta in src.flush_deltas():
+            repo.converge(key, delta)
+    assert repo.drain_overdue()
+    repo.drain()
+    assert not repo.drain_overdue()
+    assert not repo._pend and repo._pend_total == 0
+    got = []
+
+    class _R:
+        def string(self, s):
+            got.append(s)
+
+    repo.apply(_R(), [b"GET", b"doc", b"n"])
+    assert got == ["%d" % (repo_ujson.DEVICE_FANIN_MIN - 1)]
+
+    # the total-cap path: many keys, small fan-ins each
+    repo2 = repo_ujson.RepoUJSON(identity=1)
+    doc = UJSON()
+    delta = UJSON()
+    doc.set_doc(7, ("a",), "1", delta)
+    for i in range(repo_ujson.PENDING_TOTAL_MAX):
+        repo2.converge(b"k%d" % i, delta)
+    assert repo2.drain_overdue()
+    repo2.drain()
+    assert repo2._pend_total == 0 and not repo2.drain_overdue()
+
+
+def test_tlog_read_gather_offload_predicate():
+    """The first GET/SIZE after a drain rebuilds the render base with a
+    device row gather: may_drain must route it to the worker thread; a
+    quiescent cached read stays inline."""
+    from jylis_tpu.models.repo_tlog import RepoTLOG
+
+    repo = RepoTLOG(identity=1, mesh=None)
+
+    class _Null:
+        def __getattr__(self, name):
+            return lambda *a: None
+
+    repo.apply(_Null(), [b"INS", b"k", b"v1", b"5"])
+    repo.drain()  # render cache for the row is now dropped
+    assert repo.may_drain([b"GET", b"k"])
+    assert not repo.may_drain([b"SIZE", b"k"])  # quiescent: O(1) len cache
+    assert not repo.may_drain([b"GET", b"missing"])
+    repo.converge(b"k", ([(b"v2", 6)], 0))  # pending: SIZE must merge now
+    assert repo.may_drain([b"SIZE", b"k"])
+    repo.apply(_Null(), [b"GET", b"k"])  # rebuilds the render cache
+    assert not repo.may_drain([b"GET", b"k"])
+    assert not repo.may_drain([b"SIZE", b"k"])

@@ -94,3 +94,24 @@ def test_dispose_sequence_with_inflight_drain(tmp_path):
         assert out == [10]
 
     asyncio.run(main())
+
+
+def test_dispose_holds_strong_shutdown_task_ref():
+    """asyncio keeps only weak task refs: Dispose must hold the shutdown
+    task strongly or a GC pass can collect it mid-flight — final flush
+    and snapshot lost, `done` never set."""
+
+    async def main():
+        cfg = Config()
+        cfg.port = "0"
+        cfg.log = Log.create_none()
+        db = Database(identity=5)
+        server = Server(cfg, db)
+        await server.start()
+        disp = Dispose(db, server, _FakeCluster())
+        disp.dispose()
+        assert disp._shutdown_task is not None
+        await asyncio.wait_for(disp.done.wait(), timeout=10)
+        await disp._shutdown_task  # surfaced exceptions, if any
+
+    asyncio.run(main())

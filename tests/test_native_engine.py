@@ -285,7 +285,7 @@ def test_server_protocol_error_still_drops_native():
             writer.write(b"GCOUNT INC k 1\r\n*not-a-number\r\n")
             await writer.drain()
             got = await asyncio.wait_for(reader.read(1 << 16), timeout=2.0)
-            assert got == b"+OK\r\n-protocol error\r\n"
+            assert got == b"+OK\r\n-protocol error: bad array header\r\n"
             eof = await asyncio.wait_for(reader.read(1 << 16), timeout=2.0)
             assert eof == b""  # dropped
             writer.close()

@@ -120,3 +120,14 @@ def test_fuzz_differential():
 
 def test_make_parser_returns_native_here():
     assert isinstance(make_parser(), NativeRespParser)
+
+
+@pytest.mark.parametrize("data", ERROR_CASES)
+def test_protocol_error_messages_match_oracle(data):
+    """Both serving paths must reply identical error BYTES on malformed
+    input, not merely both error (client-visible parity)."""
+    with pytest.raises(RespError) as want:
+        drain(RespParser(), data)
+    with pytest.raises(RespError) as got:
+        drain(make_native(), data)
+    assert str(got.value) == str(want.value)
