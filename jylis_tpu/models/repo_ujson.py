@@ -6,12 +6,14 @@ SET/INS/RM), and everything between is a path of nested-map keys
 (repo_ujson.pony:45-49). GET/CLR take key + optional path only.
 
 Authoritative state lives on host (ops/ujson_host.py explains why);
-incoming anti-entropy deltas buffer per key and converge at drain time,
-like every device-backed repo. A key whose pending fan-in is large folds
-its deltas on the TPU in ONE dispatch (ops/ujson_device.fold_deltas —
-log-depth associative fold) and host-converges the single folded delta;
-small fan-ins stay on the host loop, which beats a device round-trip at
-small sizes (measured: bench.py --config ujson-32).
+incoming anti-entropy deltas buffer per key — bounded by drain_overdue
+thresholds like every device-backed repo — and converge at drain time.
+A full drain folds EVERY key whose fan-in earns device work in ONE
+segmented dispatch (ops/ujson_device.fold_segments, the (K, D, W)
+log-depth associative fold; keys-sharded over the serving mesh when one
+is active), then host-converges one folded delta per key. Small fan-ins
+stay on the host loop, which beats a device round-trip at small sizes
+(measured crossover: bench.py --config ujson-multikey).
 
 Delta wire shape: the UJSON object itself (entries + causal context).
 """
@@ -22,9 +24,17 @@ from ..ops.ujson_host import UJSON
 from .base import ParseError, need
 from .help import RepoHelp
 
-# pending deltas per key at which the fold moves to the device: below
-# this the host loop wins against a dispatch round-trip
+# pending deltas per key at which a SINGLE key's fold moves to the
+# device: below this the host loop wins against an unshared dispatch
+# round-trip
 DEVICE_FANIN_MIN = 256
+# per-key fan-in worth joining a SEGMENTED drain: when many keys drain
+# together the dispatch is shared, so smaller fan-ins than
+# DEVICE_FANIN_MIN pay for their slice of the launch (one (K, D, W)
+# fold_segments call for all of them). Measured crossover vs the host
+# loop on single-entry deltas: ~64-128 per key (bench.py --config
+# ujson-multikey; the host fold is O(D^2) per key, encode is O(D))
+SEG_FANIN_MIN = 64
 # buffered remote deltas across all keys before the converge path forces
 # a drain: bounds host memory for write-hot, never-read keys the same way
 # TLOG's PENDING_DRAIN_THRESHOLD does (repo_tlog.py:41)
@@ -50,8 +60,14 @@ class RepoUJSON:
     name = "UJSON"
     help = UJSON_HELP
 
-    def __init__(self, identity: int):
+    def __init__(self, identity: int, mesh="auto"):
+        from ..parallel import serving_mesh
+
         self._identity = identity
+        # mesh mode: the segmented drain's key axis shards over the
+        # serving mesh (parallel.shard_docbatch) — the fold runs SPMD
+        # with zero collectives, like every plane-backed type
+        self._mesh = serving_mesh() if mesh == "auto" else mesh
         self._data: dict[bytes, UJSON] = {}
         self._deltas: dict[bytes, UJSON] = {}
         self._pend: dict[bytes, list[UJSON]] = {}  # buffered remote deltas
@@ -167,7 +183,7 @@ class RepoUJSON:
         doc = self._data_for(key)
         if len(deltas) >= DEVICE_FANIN_MIN:
             try:
-                doc.converge(self._device_fold(deltas))
+                doc.converge(self._device_fold_keys([deltas])[0])
                 return
             except OverflowError:
                 # seqs beyond the device layouts (u32 planes): the host
@@ -176,18 +192,29 @@ class RepoUJSON:
         for d in deltas:
             doc.converge(d)
 
-    def _device_fold(self, deltas: list[UJSON]) -> UJSON:
-        """Fold a large per-key fan-in on the TPU in one dispatch."""
+    def _device_fold_keys(self, groups: list[list[UJSON]]) -> list[UJSON]:
+        """Fold K keys' fan-ins on the TPU in ONE dispatch (segmented
+        fold, one layout spanning every group); in mesh mode the key
+        axis is sharded across the serving mesh."""
         from ..ops import ujson_device as dev
+        from ..parallel import shard_docbatch
         from ..utils.batching import bucket
 
+        n_keys = len(groups)
+        # bucket the key axis (and round to the mesh's keys axis): every
+        # distinct K would otherwise be a fresh XLA compile of the fold
+        target = bucket(max(n_keys, 1), 1)
+        if self._mesh is not None:
+            target += -target % self._mesh.devices.size
+        groups = groups + [[] for _ in range(target - n_keys)]
+        flat = [d for g in groups for d in g]
         rids: set[int] = set()
-        for d in deltas:
+        for d in flat:
             rids.update(r for r, _ in d.entries)
             rids.update(d.ctx.vv)
             rids.update(r for r, _ in d.ctx.cloud)
         n_rep = bucket(max(len(rids), 1), 4)
-        shift = dev.plan_shift(deltas, n_rep)
+        shift = dev.plan_shift(flat, n_rep)
         pays: dict[tuple, int] = {}
         rev: list[tuple] = []
 
@@ -199,10 +226,13 @@ class RepoUJSON:
             return pays[k]
 
         rid_cols: dict[int, int] = {}
-        batch = dev.encode_docs(deltas, rid_cols, pay_ids, n_rep, shift=shift)
-        folded = dev.fold_deltas(batch, shift=shift)
+        batch = dev.encode_doc_groups(groups, rid_cols, pay_ids, n_rep, shift=shift)
+        if self._mesh is not None:
+            batch = shard_docbatch(self._mesh, batch)
+        folded = dev.fold_segments(batch, shift=shift)
         cols_rid = {c: r for r, c in rid_cols.items()}
-        return dev.decode_doc(folded, 0, cols_rid, rev.__getitem__, shift=shift)
+        docs = dev.decode_batch(folded, cols_rid, rev.__getitem__, shift=shift)
+        return docs[:n_keys]
 
     # -- snapshot (persist.py): full state in the wire-delta shape ----------
 
@@ -229,6 +259,22 @@ class RepoUJSON:
         return out
 
     def drain(self) -> None:
+        # segmented device pass first: every key whose fan-in earns a
+        # slice of a shared launch folds in ONE dispatch; what remains
+        # (small fan-ins, or everything on layout overflow) host-loops
+        big = [
+            k for k, lst in self._pend.items() if len(lst) >= SEG_FANIN_MIN
+        ]
+        if big:
+            try:
+                folded = self._device_fold_keys([self._pend[k] for k in big])
+            except OverflowError:
+                pass  # host lattice handles unbounded ints below
+            else:
+                for key, delta in zip(big, folded):
+                    deltas = self._pend.pop(key)
+                    self._pend_total -= len(deltas)
+                    self._data_for(key).converge(delta)
         for key in list(self._pend):
             self._drain_key(key)
         self._overdue = False

@@ -208,6 +208,43 @@ def fold_deltas(batch: DocBatch, shift: int = 32) -> DocBatch:
     return _fold_body(batch, shift)
 
 
+@partial(jax.jit, static_argnames=("shift",))
+def fold_segments(batch: DocBatch, shift: int = 32) -> DocBatch:
+    """Segmented multi-key fan-in: planes shaped (K, D, W); every key's D
+    delta rows fold to ONE document, all keys in the SAME dispatch — K
+    keys' anti-entropy fan-ins for a single launch's latency. The
+    reference converges one delta at a time per key
+    (repo_ujson.pony:96-110); here the whole drain is one device program.
+    The key axis is a plain vmap over the single-key fold body, so the
+    two paths can never diverge."""
+    folded = jax.vmap(lambda b: _fold_body(b, shift))(batch)
+    return DocBatch(*(p[:, 0] for p in folded))
+
+
+def encode_doc_groups(
+    groups, rid_cols: dict[int, int], pay_ids, n_rep: int, shift: int = 32
+) -> DocBatch:
+    """Pack K keys' delta lists into the (K, D, W) grid `fold_segments`
+    takes; short groups pad with identity docs (the join's neutral
+    element), so the fold result per key is exactly the fold of its own
+    deltas."""
+    from .ujson_host import UJSON
+
+    d = bucket(max((len(g) for g in groups), default=1), 1)
+    empty = UJSON()
+    flat = []
+    for g in groups:
+        flat.extend(g)
+        flat.extend([empty] * (d - len(g)))
+    b = _encode_docs_np(flat, rid_cols, pay_ids, n_rep, shift=shift)
+    return DocBatch(
+        *(
+            jnp.asarray(p.reshape((len(groups), d) + p.shape[1:]))
+            for p in b
+        )
+    )
+
+
 def _tile(delta_row: DocBatch, b: int) -> DocBatch:
     return DocBatch(
         *(jnp.broadcast_to(p, (b,) + p.shape[1:]) for p in delta_row)
@@ -276,15 +313,12 @@ def plan_shift(docs, n_rep: int) -> int:
     return seq_bits if max_seq < (1 << seq_bits) - 1 else 32
 
 
-def encode_docs(
+def _encode_docs_np(
     docs, rid_cols: dict[int, int], pay_ids, n_rep: int, shift: int = 32
 ) -> DocBatch:
-    """Pack host `UJSON` documents into one DocBatch at the given layout
-    (see `plan_shift`).
-
-    rid_cols: replica-id -> column (shared, grows on host like the
-    counter repos' _rids). pay_ids: callable (path, token) -> int32 id.
-    """
+    """`encode_docs` core, returning host numpy planes (callers that
+    reshape or concatenate do it host-side, then transfer ONCE — a jnp
+    reshape is a device dispatch, ruinous over a tunneled chip)."""
     seq_cap = 1 << shift
     rows = []
     for doc in docs:
@@ -323,15 +357,47 @@ def encode_docs(
     pay = np.full((b, wl), -1, np.int32)
     vv = np.zeros((b, n_rep), np.uint32)
     cloud = np.full((b, wc), pad, dtype)
+    # flatten to index/value lists and fill with ONE fancy-index scatter
+    # per plane — per-element np scalar assignment dominated encode time
+    ri: list[int] = []
+    ci: list[int] = []
+    dv: list[int] = []
+    pv: list[int] = []
+    cri: list[int] = []
+    cci: list[int] = []
+    cv: list[int] = []
     for i, (drow, vrow, crow) in enumerate(rows):
-        for j, (d, p) in enumerate(drow):
-            dots[i, j] = d
-            pay[i, j] = p
+        ri.extend([i] * len(drow))
+        ci.extend(range(len(drow)))
+        dv.extend(d for d, _ in drow)
+        pv.extend(p for _, p in drow)
         vv[i] = vrow
-        for j, c in enumerate(crow):
-            cloud[i, j] = c
+        cri.extend([i] * len(crow))
+        cci.extend(range(len(crow)))
+        cv.extend(crow)
+    if ri:
+        rows_i = np.asarray(ri, np.int64)
+        cols_i = np.asarray(ci, np.int64)
+        dots[rows_i, cols_i] = np.asarray(dv, dtype)
+        pay[rows_i, cols_i] = np.asarray(pv, np.int32)
+    if cri:
+        cloud[np.asarray(cri, np.int64), np.asarray(cci, np.int64)] = np.asarray(
+            cv, dtype
+        )
+    return DocBatch(dots, pay, vv, cloud)
+
+
+def encode_docs(
+    docs, rid_cols: dict[int, int], pay_ids, n_rep: int, shift: int = 32
+) -> DocBatch:
+    """Pack host `UJSON` documents into one DocBatch at the given layout
+    (see `plan_shift`).
+
+    rid_cols: replica-id -> column (shared, grows on host like the
+    counter repos' _rids). pay_ids: callable (path, token) -> int32 id.
+    """
     return DocBatch(
-        jnp.asarray(dots), jnp.asarray(pay), jnp.asarray(vv), jnp.asarray(cloud)
+        *(jnp.asarray(p) for p in _encode_docs_np(docs, rid_cols, pay_ids, n_rep, shift))
     )
 
 

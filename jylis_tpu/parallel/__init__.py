@@ -25,6 +25,7 @@ from .sharded import (
     route_batch,
     route_drain,
     route_drain64,
+    shard_docbatch,
     shard_plane,
     shard_vec,
     trim_sharded_tlog,
@@ -33,6 +34,7 @@ from .sharded import (
 __all__ = [
     "make_mesh",
     "serving_mesh",
+    "shard_docbatch",
     "shard_plane",
     "shard_vec",
     "route_batch",

@@ -57,6 +57,24 @@ def shard_vec(mesh, arr):
     return jax.device_put(arr, NamedSharding(mesh, P("keys")))
 
 
+def shard_docbatch(mesh, batch):
+    """Place a (K, D, W)-planed UJSON DocBatch keys-sharded on the mesh.
+
+    The segmented fold (ops/ujson_device.fold_segments) is embarrassingly
+    parallel over its key axis, so with the leading axis sharded the same
+    jitted program runs SPMD across the mesh with ZERO collectives —
+    UJSON's drain scales with chips exactly like the plane-backed types.
+    K must divide evenly by the keys axis (pad with identity groups)."""
+    return type(batch)(
+        *(
+            jax.device_put(
+                p, NamedSharding(mesh, P("keys", *([None] * (p.ndim - 1))))
+            )
+            for p in batch
+        )
+    )
+
+
 def _route(key_idx, deltas, n_shards: int, rows_per_shard: int, bucket_width=False):
     """Shared routing core: coalesce, bucket per shard, pad to a common
     width. Returns (local_rows, d_hi, d_lo, slot_rows) where slot_rows maps

@@ -298,3 +298,84 @@ def test_compact_preserves_rows():
     cols_rid = {c: r for r, c in rid_cols.items()}
     got_a = dev.decode_doc(slim, 0, cols_rid, pay.lookup, shift=shift)
     assert_same_doc(got_a, a)
+
+
+@pytest.mark.parametrize("shift_mode", ["planned", 32])
+def test_fold_segments_matches_per_key_folds(shift_mode):
+    """Segmented fold: K keys' fan-ins in one (K, D, W) dispatch must
+    equal each key's own sequential host convergence — including ragged
+    group sizes that pad with identity rows."""
+    rng = np.random.default_rng(23)
+    groups = []
+    for k, size in enumerate([1, 3, 7, 4]):
+        doc = UJSON()
+        g = []
+        for _ in range(size):
+            d = UJSON()
+            random_mutations(rng, doc, replica=100 + k, n_ops=2, delta=d)
+            g.append(d)
+        groups.append(g)
+
+    flat = [d for g in groups for d in g]
+    pay = PayInterner()
+    rid_cols: dict[int, int] = {}
+    shift = dev.plan_shift(flat, n_rep=8) if shift_mode == "planned" else 32
+    batch = dev.encode_doc_groups(groups, rid_cols, pay, n_rep=8, shift=shift)
+    assert batch.dots.ndim == 3 and batch.dots.shape[0] == len(groups)
+    folded = dev.fold_segments(batch, shift=shift)
+    cols_rid = {c: r for r, c in rid_cols.items()}
+    got = dev.decode_batch(folded, cols_rid, pay.lookup, shift=shift)
+
+    for g, got_doc in zip(groups, got):
+        want = UJSON()
+        for d in g:
+            want.converge(d)
+        assert_same_doc(got_doc, want)
+
+
+def test_repo_segmented_drain_matches_host_loop(monkeypatch):
+    """A full drain with many pending keys takes the segmented device
+    path (one dispatch) and must match the pure host loop repo."""
+    from jylis_tpu.models import repo_ujson as mod
+
+    class _R:
+        def __init__(self):
+            self.vals = []
+
+        def string(self, s):
+            self.vals.append(s)
+
+        def ok(self):
+            pass
+
+    def feed(repo):
+        rng = np.random.default_rng(31)
+        for k in range(5):
+            key = b"doc%d" % k
+            doc = UJSON()
+            for r in range(4):
+                for _ in range(2):
+                    d = UJSON()
+                    random_mutations(
+                        rng, doc, replica=50 + r, n_ops=1, delta=d
+                    )
+                    repo.converge(key, d)
+
+    monkeypatch.setattr(mod, "SEG_FANIN_MIN", 4)  # force the segmented path
+    seg_repo = mod.RepoUJSON(identity=1)
+    feed(seg_repo)
+    seg_repo.drain()
+    assert seg_repo._pend_total == 0 and not seg_repo._pend
+
+    monkeypatch.setattr(mod, "SEG_FANIN_MIN", 10_000)
+    monkeypatch.setattr(mod, "DEVICE_FANIN_MIN", 10_000)  # pure host loop
+    host_repo = mod.RepoUJSON(identity=1)
+    feed(host_repo)
+    host_repo.drain()
+
+    for k in range(5):
+        r1, r2 = _R(), _R()
+        seg_repo.apply(r1, [b"GET", b"doc%d" % k])
+        host_repo.apply(r2, [b"GET", b"doc%d" % k])
+        assert r1.vals == r2.vals, k
+        assert r1.vals[0] != ""
