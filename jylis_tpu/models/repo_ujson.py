@@ -265,6 +265,10 @@ class RepoUJSON:
         big = [
             k for k, lst in self._pend.items() if len(lst) >= SEG_FANIN_MIN
         ]
+        # SEG_FANIN_MIN only pays when the dispatch is SHARED: a lone key
+        # below the single-dispatch crossover stays on the host loop
+        if len(big) == 1 and len(self._pend[big[0]]) < DEVICE_FANIN_MIN:
+            big = []
         if big:
             try:
                 folded = self._device_fold_keys([self._pend[k] for k in big])

@@ -13,6 +13,8 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..utils.batching import bucket
+
 _PAD = b"\x00" * 8
 
 
@@ -28,11 +30,14 @@ def prefix_rank(s: bytes) -> int:
 class Interner:
     """Bidirectional bytes<->id table with a device-shippable rank array.
 
-    Ids are dense and never reused; id equality is exact string equality,
-    which is what the device dedup kernels rely on (e.g. TLOG duplicate
-    detection requires equal timestamp AND equal value,
-    docs/_docs/types/tlog.md:122).
-    """
+    Ids are dense and never reused BETWEEN compactions; id equality is
+    exact string equality, which is what the device dedup kernels rely on
+    (e.g. TLOG duplicate detection requires equal timestamp AND equal
+    value, docs/_docs/types/tlog.md:122). Long-running write churn
+    (TREG overwrites, TLOG trims) strands dead ids; owners periodically
+    `compact` with their live-id set and remap every stored id — host
+    caches and device planes alike — so memory tracks the LIVE state,
+    not the write history."""
 
     __slots__ = ("_to_id", "_strings", "_ranks", "_cap")
 
@@ -80,3 +85,26 @@ class Interner:
 
     def contains(self, s: bytes) -> bool:
         return s in self._to_id
+
+    def compact(self, live_ids) -> np.ndarray:
+        """Drop every string not in `live_ids` (ints, repeats fine).
+
+        Returns the remap array: old id -> new id, -1 for dead ids. The
+        caller MUST apply it to every place an old id is stored (host
+        caches, device planes) before interning anything new — old and
+        new ids share the same space."""
+        remap = np.full(len(self._strings), -1, np.int64)
+        new_strings: list[bytes] = []
+        for oid in live_ids:
+            oid = int(oid)
+            if remap[oid] < 0:
+                remap[oid] = len(new_strings)
+                new_strings.append(self._strings[oid])
+        self._strings = new_strings
+        self._to_id = {s: i for i, s in enumerate(new_strings)}
+        self._cap = bucket(len(new_strings), 16)
+        ranks = np.zeros(self._cap, dtype=np.uint64)
+        for i, s in enumerate(new_strings):
+            ranks[i] = prefix_rank(s)
+        self._ranks = ranks
+        return remap
