@@ -38,22 +38,33 @@ from ..utils.net import ipv4_port
 from . import codec
 from .framing import FrameReader, FramingError, frame
 from .heart import Heart
-from .msg import MsgAnnounceAddrs, MsgExchangeAddrs, MsgPong, MsgPushDeltas
+from .msg import (
+    MsgAnnounceAddrs,
+    MsgExchangeAddrs,
+    MsgPong,
+    MsgPushDeltas,
+    MsgSyncRequest,
+)
 
 IDLE_TICKS_LIMIT = 10  # cluster.pony:118-121
 ANNOUNCE_EVERY = 3  # cluster.pony:123-128
+# bootstrap/rejoin sync: at most one full-state request per peer per this
+# many ticks (re-establishment after any gap may have missed deltas —
+# fire-and-forget has no retransmit; see MsgSyncRequest)
+SYNC_REQUEST_COOLDOWN = 10
 
 
 class _Conn:
     """One cluster TCP connection (either role), with its read task."""
 
-    __slots__ = ("writer", "active_addr", "established", "task")
+    __slots__ = ("writer", "active_addr", "established", "task", "sync_served")
 
     def __init__(self, writer, active_addr: Address | None):
         self.writer = writer
         self.active_addr = active_addr  # None for passive conns
         self.established = False
         self.task: asyncio.Task | None = None
+        self.sync_served = False  # one full-state sync per connection
 
     # a peer that keeps ponging but stops reading would otherwise grow the
     # transport write buffer without bound
@@ -105,6 +116,9 @@ class Cluster:
         self._held: list[bytes] = []
         self._held_cap = 1024
         self._flush_tasks: set = set()  # strong refs; asyncio's are weak
+        self._sync_req_tick: dict[Address, int] = {}  # rate limit per peer
+        self._sync_waiters: list[_Conn] = []  # conns awaiting a sync dump
+        self._sync_dump_inflight = False  # one dump task at a time
 
     # ---- lifecycle --------------------------------------------------------
 
@@ -159,7 +173,7 @@ class Cluster:
         self._flush_tasks.discard(task)
         if not task.cancelled() and task.exception() is not None:
             self._log.err() and self._log.e(
-                f"heartbeat flush failed: {task.exception()!r}"
+                f"cluster background task failed: {task.exception()!r}"
             )
 
     def _evict_idle(self) -> None:
@@ -241,8 +255,12 @@ class Cluster:
                         frames.set_max_frame(1 << 30)  # authenticated peer
                         self._mark_activity(conn)
                         if active:
-                            # we initiated: announce our membership view
+                            # we initiated: announce our membership view,
+                            # then ask for missed state — this connection
+                            # just (re)opened, so any deltas flushed while
+                            # it was down are gone (fire-and-forget)
                             self._send(conn, MsgExchangeAddrs(self._known_addrs.copy()))
+                            self._maybe_request_sync(conn)
                         else:
                             # passive side echoes the signature back
                             conn.send_raw(frame(self._serial))
@@ -255,7 +273,7 @@ class Cluster:
                         self._drop(conn)
                         return
                     if active:
-                        self._active_msg(conn, msg)
+                        await self._active_msg(conn, msg)
                     else:
                         await self._passive_msg(conn, msg)
         except (ConnectionError, asyncio.CancelledError, FramingError):
@@ -265,11 +283,17 @@ class Cluster:
 
     # ---- message handling --------------------------------------------------
 
-    def _active_msg(self, conn: _Conn, msg) -> None:
+    async def _active_msg(self, conn: _Conn, msg) -> None:
         if isinstance(msg, MsgPong):
             return  # liveness only
         if isinstance(msg, MsgExchangeAddrs):
             self._converge_addrs(msg.known_addrs)
+            return
+        if isinstance(msg, MsgPushDeltas):
+            # full-state sync response to our MsgSyncRequest: converge
+            # like any push — the join is idempotent, so overlap with
+            # live deltas is harmless
+            await self._database.converge_async((msg.name, list(msg.batch)))
             return
         self._log.err() and self._log.e(
             f"unexpected active message: {type(msg).__name__}"
@@ -295,10 +319,76 @@ class Cluster:
             self._converge_addrs(msg.known_addrs)
             self._send(conn, MsgPong())
             return
+        if isinstance(msg, MsgSyncRequest):
+            # serve as a TASK: the dump can take seconds (repo locks +
+            # device drains + cold compiles), and blocking this read loop
+            # would stop activity-marking AND Pong replies on the conn
+            # pair — both sides would idle-evict before the state arrives.
+            # Concurrent requesters queue and share ONE dump (a heal can
+            # bring several rejoiners at once; each must get the state).
+            if conn.sync_served:
+                self._send(conn, MsgPong())
+                return
+            conn.sync_served = True
+            self._sync_waiters.append(conn)
+            if self._sync_dump_inflight:
+                return  # the running dump task will serve this waiter too
+            self._sync_dump_inflight = True
+            task = asyncio.get_running_loop().create_task(self._serve_syncs())
+            self._flush_tasks.add(task)
+            task.add_done_callback(self._flush_task_done)
+            return
         self._log.err() and self._log.e(
             f"unexpected passive message: {type(msg).__name__}"
         )
         self._drop(conn)
+
+    # ---- bootstrap / rejoin full-state sync --------------------------------
+
+    def _maybe_request_sync(self, conn: _Conn) -> None:
+        """Ask a freshly-established peer for its full state, rate-limited
+        per address. Covers both bootstrap (new node joins, gets
+        everything) and partition heal (deltas pushed while we were
+        unreachable are not retransmitted; the reference loses them
+        permanently — cluster.pony:250-252 converges only what arrives)."""
+        addr = conn.active_addr
+        last = self._sync_req_tick.get(addr)
+        if last is not None and self._tick - last < SYNC_REQUEST_COOLDOWN:
+            return
+        self._sync_req_tick[addr] = self._tick
+        self._send(conn, MsgSyncRequest())
+
+    async def _serve_syncs(self) -> None:
+        """Drain the sync-waiter queue: ONE full dump (encoded off the
+        event loop) serves every queued requester, with writer.drain()
+        between frames so a large state streams under backpressure
+        instead of tripping the 16 MB kill limit mid-sync."""
+        try:
+            while self._sync_waiters:
+                waiters, self._sync_waiters = self._sync_waiters, []
+                dump = await self._database.dump_state_async()
+                frames = await asyncio.to_thread(
+                    lambda: [
+                        frame(codec.encode(MsgPushDeltas(name, tuple(batch))))
+                        for name, batch in dump
+                    ]
+                )
+                for conn in waiters:
+                    await self._stream_sync(conn, frames)
+        finally:
+            self._sync_dump_inflight = False
+
+    async def _stream_sync(self, conn: _Conn, frames: list[bytes]) -> None:
+        for data in frames:
+            if not conn.send_raw(data):
+                self._drop(conn)
+                return
+            try:
+                await conn.writer.drain()
+            except (ConnectionError, RuntimeError):
+                self._drop(conn)
+                return
+        self._send(conn, MsgPong())
 
     def _converge_addrs(self, other: P2Set) -> None:
         """Membership gossip convergence with stale-name self-healing

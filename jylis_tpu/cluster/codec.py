@@ -34,9 +34,16 @@ import hashlib
 from ..ops.p2set import P2Set
 from ..ops.ujson_host import UJSON
 from ..utils.address import Address
-from .msg import Msg, MsgAnnounceAddrs, MsgExchangeAddrs, MsgPong, MsgPushDeltas
+from .msg import (
+    Msg,
+    MsgAnnounceAddrs,
+    MsgExchangeAddrs,
+    MsgPong,
+    MsgPushDeltas,
+    MsgSyncRequest,
+)
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
 
 # The canonical schema text: any change to the wire format MUST change this
 # string (bump SCHEMA_VERSION), which changes the signature, which makes
@@ -49,6 +56,7 @@ msg0=Pong
 msg1=ExchangeAddrs(p2set)
 msg2=AnnounceAddrs(p2set)
 msg3=PushDeltas(name:str batch:[(key:bytes delta)])
+msg4=SyncRequest
 delta/TREG=(value:bytes ts:varint)
 delta/TLOG=delta/SYSTEM=(entries:[(value:bytes ts:varint)] cutoff:varint)
 delta/GCOUNT=[(rid:varint v:varint)]
@@ -266,6 +274,7 @@ _TAG_PONG = 0
 _TAG_EXCHANGE = 1
 _TAG_ANNOUNCE = 2
 _TAG_PUSH = 3
+_TAG_SYNC_REQ = 4
 
 
 def encode(msg: Msg) -> bytes:
@@ -295,6 +304,8 @@ def _encode_oracle(msg: Msg) -> bytes:
         for key, delta in msg.batch:
             _w_bytes(out, key)
             _w_delta(out, msg.name, delta)
+    elif isinstance(msg, MsgSyncRequest):
+        out.append(_TAG_SYNC_REQ)
     else:
         raise CodecError(f"cannot encode {type(msg).__name__}")
     return bytes(out)
@@ -328,6 +339,8 @@ def _decode_oracle(body: bytes) -> Msg:
             (r.bytes_(), _r_delta(r, name)) for _ in range(r.varint())
         )
         msg = MsgPushDeltas(name, batch)
+    elif tag == _TAG_SYNC_REQ:
+        msg = MsgSyncRequest()
     else:
         raise CodecError(f"unknown message tag: {tag}")
     if not r.done():

@@ -45,4 +45,14 @@ class MsgPushDeltas:
     batch: tuple  # tuple[(key: bytes, delta), ...]
 
 
-Msg = MsgPong | MsgExchangeAddrs | MsgAnnounceAddrs | MsgPushDeltas
+@dataclass(frozen=True)
+class MsgSyncRequest:
+    """Bootstrap/rejoin full-state sync (beyond the reference, which can
+    permanently miss deltas flushed while a peer was away —
+    cluster.pony:250-252 converges only what is pushed). The requester
+    sends this after establishing an active connection; the peer replies
+    with its full state as ordinary MsgPushDeltas batches (the snapshot
+    wire shape, persist.py), which converge idempotently."""
+
+
+Msg = MsgPong | MsgExchangeAddrs | MsgAnnounceAddrs | MsgPushDeltas | MsgSyncRequest

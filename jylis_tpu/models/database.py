@@ -7,6 +7,7 @@ words, fans flush/converge to the repos, and joins shutdown.
 
 from __future__ import annotations
 
+import asyncio
 from contextlib import AsyncExitStack, asynccontextmanager
 
 from .help import DATATYPE_HELP, respond_help
@@ -82,6 +83,19 @@ class Database:
     def drain_all(self) -> None:
         for mgr in self._map.values():
             mgr.repo.drain()
+
+    async def dump_state_async(self):
+        """Full state per type for the cluster sync path: [(name, batch)].
+        Each repo dumps under its own lock with device touches in a
+        worker thread, so serving stalls only per-type and briefly —
+        unlike the shutdown snapshot, no cross-repo atomicity is needed
+        (the receiver's lattice join absorbs any in-between writes)."""
+        out = []
+        for mgr in self._map.values():
+            async with mgr._lock:
+                batch = await asyncio.to_thread(mgr.repo.dump_state)
+            out.append((mgr.name, batch))
+        return out
 
     def clean_shutdown(self) -> None:
         """Single-threaded shutdown (tests / direct drivers); the serving

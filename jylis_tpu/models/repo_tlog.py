@@ -414,7 +414,12 @@ class RepoTLOG:
         new_vid = np.full(all_vid.shape, -1, np.int64)
         for row, length in self._len_cache.items():
             if length > 0:
-                new_vid[row, :length] = remap[all_vid[row, :length]]
+                src = all_vid[row, :length]
+                # mask negatives on application exactly as on collection:
+                # remap[-1] would silently alias the last live id
+                new_vid[row, :length] = np.where(
+                    src >= 0, remap[np.clip(src, 0, None)], -1
+                )
         self._state = self._state._replace(
             vid=shard_plane(self._mesh, new_vid)
             if self._mesh is not None
