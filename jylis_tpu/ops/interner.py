@@ -50,10 +50,21 @@ class Interner:
     def __len__(self) -> int:
         return len(self._strings)
 
+    # ids must stay below 2**31 - 1: the TLOG sort planes carry the biased
+    # id (vid + 1) in one u32 lane (ops/tlog._planes). Unreachable in
+    # practice — two billion live strings would exhaust host memory first,
+    # and compaction keeps ids dense — but fail loudly, never corrupt.
+    MAX_ID = (1 << 31) - 2
+
     def intern(self, s: bytes) -> int:
         sid = self._to_id.get(s)
         if sid is None:
             sid = len(self._strings)
+            if sid > self.MAX_ID:
+                raise RuntimeError(
+                    "interner id space exhausted (2**31 ids); compaction "
+                    "should have reclaimed dead ids long before this"
+                )
             self._to_id[s] = sid
             self._strings.append(s)
             if sid >= self._cap:

@@ -28,7 +28,6 @@ from .sharded import (
     shard_docbatch,
     shard_plane,
     shard_vec,
-    trim_sharded_tlog,
 )
 
 __all__ = [
@@ -45,7 +44,6 @@ __all__ = [
     "drain_sharded_treg",
     "patch_sharded_treg",
     "drain_sharded_tlog",
-    "trim_sharded_tlog",
     "route_drain64",
     "read_all_sharded",
     "join_replica_axis",

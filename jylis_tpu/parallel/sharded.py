@@ -332,29 +332,34 @@ def patch_sharded_treg(mesh, vid, local_rows, patch_vid):
 
 # ---- TLOG sharded drain ----------------------------------------------------
 #
-# TLOG's keyspace is (K, L) u64 segment tensors + (K,) length/cutoff
+# TLOG's keyspace is (K, L) ts/vid segment tensors + (K,) length/cutoff
 # vectors (ops/tlog.py). Deltas route as u64 payload columns
-# [ts(ld) | rank(ld) | vid(ld) | cutoff], unpacked per device block; the
-# vmap'd sort-dedup-mask merge runs shard-local. NOT donated: the caller
-# retries from the pre-merge state when a row overflows its slot budget.
+# [ts(ld) | vid(ld) | cutoff | count], unpacked per device block; the
+# batched sort-dedup-mask merge runs shard-local, then the fused trim
+# applies where count < TRIM_NOOP — so drains, trims, and drain+trim are
+# all ONE dispatch. NOT donated: the caller retries from the pre-merge
+# state when a row overflows its slot budget.
 
 
-def _local_drain_tlog(ts, rank, vid, length, cutoff, rows_blk, payload, ld):
+def _local_drain_tlog(nth, ntl, nv, length, cutoff, rows_blk, payload, ld):
     from ..ops import tlog as tlog_ops
 
-    state = tlog_ops.TLogState(ts, rank, vid, length, cutoff)
+    state = tlog_ops.TLogState(nth, ntl, nv, length, cutoff)
     d_ts = payload[:, :ld]
-    d_rank = payload[:, ld : 2 * ld]
-    d_vid = payload[:, 2 * ld : 3 * ld].astype(jnp.int64)
-    d_cut = payload[:, 3 * ld]
-    st, ovf = tlog_ops.converge_batch(state, rows_blk, d_ts, d_rank, d_vid, d_cut)
+    d_vid = payload[:, ld : 2 * ld].astype(jnp.int64)
+    d_cut = payload[:, 2 * ld]
+    counts = payload[:, 2 * ld + 1].astype(jnp.int64)
+    st, ovf = tlog_ops.converge_then_trim(
+        state, rows_blk, d_ts, d_vid, d_cut, rows_blk, counts
+    )
     return (*st, ovf, st.length[rows_blk], st.cutoff[rows_blk])
 
 
 @partial(jax.jit, static_argnames=("mesh", "ld"))
-def drain_sharded_tlog(mesh, ts, rank, vid, length, cutoff, local_rows, payload, ld):
-    """TLOG sharded drain; returns (5 state tensors, per-slot overflow
-    flags, per-slot lengths, per-slot cutoffs)."""
+def drain_sharded_tlog(mesh, nth, ntl, nv, length, cutoff, local_rows, payload, ld):
+    """TLOG sharded drain (+ fused optional per-row trim) over the wide
+    3-plane layout; returns (5 state tensors, per-slot overflow flags,
+    per-slot lengths, per-slot cutoffs)."""
     return jax.shard_map(
         partial(_local_drain_tlog, ld=ld),
         mesh=mesh,
@@ -377,45 +382,7 @@ def drain_sharded_tlog(mesh, ts, rank, vid, length, cutoff, local_rows, payload,
             P("keys"),
             P("keys"),
         ),
-    )(ts, rank, vid, length, cutoff, local_rows, payload)
-
-
-def _local_trim_tlog(ts, rank, vid, length, cutoff, rows_blk, payload):
-    from ..ops import tlog as tlog_ops
-
-    counts = payload[:, 0].astype(jnp.int64)
-    st = tlog_ops.trim_batch(
-        tlog_ops.TLogState(ts, rank, vid, length, cutoff), rows_blk, counts
-    )
-    return (*st, st.length[rows_blk], st.cutoff[rows_blk])
-
-
-@partial(jax.jit, static_argnames=("mesh",), donate_argnums=(1, 2, 3, 4, 5))
-def trim_sharded_tlog(mesh, ts, rank, vid, length, cutoff, local_rows, payload):
-    """TLOG sharded TRIM/TRIMAT/CLR; the count rides as one routed u64
-    payload column (pad slots' rows are out of range and drop)."""
-    return jax.shard_map(
-        _local_trim_tlog,
-        mesh=mesh,
-        in_specs=(
-            P("keys", None),
-            P("keys", None),
-            P("keys", None),
-            P("keys"),
-            P("keys"),
-            P("keys"),
-            P("keys", None),
-        ),
-        out_specs=(
-            P("keys", None),
-            P("keys", None),
-            P("keys", None),
-            P("keys"),
-            P("keys"),
-            P("keys"),
-            P("keys"),
-        ),
-    )(ts, rank, vid, length, cutoff, local_rows, payload)
+    )(nth, ntl, nv, length, cutoff, local_rows, payload)
 
 
 def _tree_join(hi_blk, lo_blk):

@@ -188,7 +188,7 @@ def test_tlog_quiescent_reads_skip_device(db, monkeypatch):
     run(db, "TLOG", "INS", "chat", "two", "200")
     first = run(db, "TLOG", "GET", "chat")  # drains + builds render cache
 
-    calls = {"get_row": 0, "drain": 0, "trim": 0}
+    calls = {"get_row": 0, "drain": 0}
     monkeypatch.setattr(
         repo_tlog,
         "_get_row",
@@ -199,17 +199,12 @@ def test_tlog_quiescent_reads_skip_device(db, monkeypatch):
         "_drain",
         lambda *a: calls.__setitem__("drain", calls["drain"] + 1),
     )
-    monkeypatch.setattr(
-        repo_tlog,
-        "_trim",
-        lambda *a: calls.__setitem__("trim", calls["trim"] + 1),
-    )
     for _ in range(3):
         assert run(db, "TLOG", "GET", "chat") == first
         assert run(db, "TLOG", "SIZE", "chat") == b":2\r\n"
         assert run(db, "TLOG", "CUTOFF", "chat") == b":0\r\n"
         assert run(db, "TLOG", "GET", "missing") == b"*0\r\n"
-    assert calls == {"get_row": 0, "drain": 0, "trim": 0}
+    assert calls == {"get_row": 0, "drain": 0}
 
 
 def test_tlog_render_cache_invalidated_by_merge(db):

@@ -10,16 +10,17 @@ import pytest
 
 import jylis_tpu  # noqa: F401
 from jylis_tpu.ops import tlog, hostref
-from jylis_tpu.ops.interner import Interner, prefix_rank
+from jylis_tpu.ops.interner import Interner
 
 K, L = 8, 64
 
 
 def row_entries(state, k, interner):
     """Decode one key's row into the oracle's [(value, ts)] desc order."""
-    ts = np.asarray(state.ts[k])
-    vid = np.asarray(state.vid[k])
-    n = int(np.asarray(state.length[k]))
+    ts_r, vid_r, n_r = tlog.read_row(state, np.int32(k))
+    ts = np.asarray(ts_r)
+    vid = np.asarray(vid_r)
+    n = int(np.asarray(n_r))
     ents = [(interner.lookup(int(vid[i])), int(ts[i])) for i in range(n)]
     # client-visible order: host re-sort by (ts desc, value desc)
     return sorted(ents, key=lambda e: (e[1], e[0]), reverse=True)
@@ -31,7 +32,6 @@ def ins(state, interner, key, value, ts):
         state,
         np.array([key], np.int32),
         np.array([ts], np.uint64),
-        np.array([prefix_rank(value)], np.uint64),
         np.array([vid], np.int64),
     )
     assert not bool(np.asarray(ovf)[0])
@@ -178,27 +178,25 @@ def test_tlog_merge_order_independent():
 
     def delta_rows(rep):
         ts = np.zeros((K, L), np.uint64)
-        rank = np.zeros((K, L), np.uint64)
         vid = np.full((K, L), -1, np.int64)
         cut = np.zeros((K,), np.uint64)
         for k in range(K):
             for i, (v, t) in enumerate(rep_logs[rep][k].latest()):
                 ts[k, i] = t
-                rank[k, i] = prefix_rank(v)
                 vid[k, i] = interner.intern(v)
             cut[k] = rep_logs[rep][k].cutoff
-        return ts, rank, vid, cut
+        return ts, vid, cut
 
     all_keys = np.arange(K, dtype=np.int32)
     for order_seed in range(4):
         order = np.random.default_rng(order_seed).permutation(n_rep)
         state = tlog.init(K, L)
         for rep in order:
-            ts, rank, vid, cut = delta_rows(rep)
-            state, ovf = tlog.converge_batch(state, all_keys, ts, rank, vid, cut)
+            ts, vid, cut = delta_rows(rep)
+            state, ovf = tlog.converge_batch(state, all_keys, ts, vid, cut)
             assert not np.asarray(ovf).any()
             # duplicate delivery is harmless
-            state, _ = tlog.converge_batch(state, all_keys, ts, rank, vid, cut)
+            state, _ = tlog.converge_batch(state, all_keys, ts, vid, cut)
         for k in range(K):
             assert row_entries(state, k, interner) == oracle[k].latest(), (
                 order,
@@ -216,7 +214,6 @@ def test_tlog_overflow_flagged():
         state,
         np.array([0], np.int32),
         np.array([9], np.uint64),
-        np.array([prefix_rank(b"x")], np.uint64),
         np.array([vid], np.int64),
     )
     assert bool(np.asarray(ovf)[0])
@@ -233,3 +230,121 @@ def test_tlog_trim_then_reinsert_old_is_ignored():
     # an entry older than the cutoff is outdated and ignored (tlog.md:34)
     state = ins(state, interner, 0, b"old", 5)
     assert int(np.asarray(state.length[0])) == 2
+
+
+def test_tlog_narrow_wide_equivalence():
+    """The same workload must produce identical client-visible logs in the
+    narrow (2-plane) and wide (3-plane) layouts, and `widen` must be
+    lossless mid-stream."""
+    rng = np.random.default_rng(7)
+    interner = Interner()
+    narrow = tlog.init(K, L)
+    wide = tlog.init(K, L, wide=True)
+    assert not narrow.wide and wide.wide
+    for step in range(80):
+        k = int(rng.integers(0, K))
+        v = bytes([97 + int(rng.integers(0, 3))])
+        t = int(rng.integers(0, 50))
+        narrow = ins(narrow, interner, k, v, t)
+        wide = ins(wide, interner, k, v, t)
+        if step == 40:
+            narrow = tlog.widen(narrow)  # mid-stream upgrade is lossless
+            assert narrow.wide
+    for k in range(K):
+        assert row_entries(narrow, k, interner) == row_entries(wide, k, interner)
+        assert int(np.asarray(narrow.cutoff[k])) == int(np.asarray(wide.cutoff[k]))
+
+
+def test_tlog_wide_64bit_timestamps():
+    """Timestamps above 2**32 round-trip exactly through the wide layout,
+    including trims at the 64-bit boundary."""
+    interner = Interner()
+    state = tlog.init(1, 8, wide=True)
+    big = (1 << 40) + 12345
+    for i, t in enumerate([big, big + 1, (1 << 35), 7]):
+        state = ins(state, interner, 0, b"v%d" % i, t)
+    ents = row_entries(state, 0, interner)
+    assert [e[1] for e in ents] == [big + 1, big, 1 << 35, 7]
+    state = tlog.trim_batch(state, np.array([0], np.int32), np.array([2], np.int64))
+    assert int(np.asarray(state.cutoff[0])) == big
+    assert row_entries(state, 0, interner) == [(b"v1", big + 1), (b"v0", big)]
+
+
+def test_tlog_dense_matches_sparse():
+    """converge_batch(key_idx=None) (the dense full-keyspace path) must
+    leave bitwise-identical state to the gather/scatter path."""
+    rng = np.random.default_rng(11)
+    interner = Interner()
+    sparse = tlog.init(K, L)
+    dense = tlog.init(K, L)
+    all_keys = np.arange(K, dtype=np.int32)
+    for _ in range(4):
+        ld = 6
+        d_ts = np.zeros((K, ld), np.uint64)
+        d_vid = np.full((K, ld), -1, np.int64)
+        d_cut = np.zeros((K,), np.uint64)
+        for k in range(K):
+            for j in range(int(rng.integers(1, ld))):
+                d_ts[k, j] = int(rng.integers(0, 25))
+                d_vid[k, j] = interner.intern(bytes([97 + int(rng.integers(3))]))
+        sparse, ovf_s = tlog.converge_batch(sparse, all_keys, d_ts, d_vid, d_cut)
+        dense, ovf_d = tlog.converge_batch(dense, None, d_ts, d_vid, d_cut)
+        assert np.array_equal(np.asarray(ovf_s), np.asarray(ovf_d))
+    assert sparse.nth is None and dense.nth is None
+    for a, b in zip(sparse, dense):
+        if a is not None:
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_tlog_dense_tail_overflow_and_cutoff():
+    """The dense in-place path's risky mechanics: a row whose live entries
+    reach into the tail write window must be flagged as overflow (and the
+    grow-retry then merges losslessly); dense cutoff raises and the dense
+    fused trim must match the sparse path."""
+    interner = Interner()
+    L, ld = 8, 4
+    state = tlog.init(2, L)
+    # row 0: 6 live entries — 6 > L - ld = 4, so a dense drain with this
+    # ld must flag it even though its delta is EMPTY (PAD tail write
+    # would clobber entries 4 and 5)
+    for t in [10, 20, 30, 40, 50, 60]:
+        state = ins(state, interner, 0, b"e%d" % t, t)
+    d_ts = np.zeros((2, ld), np.uint64)
+    d_vid = np.full((2, ld), -1, np.int64)
+    d_cut = np.zeros((2,), np.uint64)
+    d_ts[1, 0] = 25
+    d_vid[1, 0] = interner.intern(b"x")
+    _st_bad, ovf = tlog.converge_batch(state, None, d_ts, d_vid, d_cut)
+    assert bool(np.asarray(ovf)[0]), "tail-overlap row must be flagged"
+    # host contract: discard, grow the PRE-merge state, re-merge densely
+    grown = tlog.grow(state, 2, 16)
+    st, ovf2 = tlog.converge_batch(grown, None, d_ts, d_vid, d_cut)
+    assert not np.asarray(ovf2).any()
+    assert [e[1] for e in row_entries(st, 0, interner)] == [60, 50, 40, 30, 20, 10]
+    assert [e[1] for e in row_entries(st, 1, interner)] == [25]
+
+    # dense cutoff raise + fused trim must equal the sparse equivalent
+    d_cut2 = np.array([35, 0], np.uint64)
+    counts = np.array([tlog.TRIM_NOOP, tlog.TRIM_NOOP], np.int64)
+    trim_ki = np.arange(2, dtype=np.int32)
+    dense_st, _ = tlog.converge_then_trim(
+        st, None, d_ts * 0, np.full((2, ld), -1, np.int64), d_cut2,
+        trim_ki, counts,
+    )
+    sparse_st, _ = tlog.converge_then_trim(
+        st, trim_ki, d_ts * 0, np.full((2, ld), -1, np.int64), d_cut2,
+        trim_ki, counts,
+    )
+    for a, b in zip(dense_st, sparse_st):
+        if a is not None:
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+    assert [e[1] for e in row_entries(dense_st, 0, interner)] == [60, 50, 40]
+    # fused dense trim (count column live this time)
+    dense_tr, _ = tlog.converge_then_trim(
+        dense_st, None, d_ts * 0, np.full((2, ld), -1, np.int64),
+        np.zeros(2, np.uint64), trim_ki, np.array([1, 0], np.int64),
+    )
+    assert [e[1] for e in row_entries(dense_tr, 0, interner)] == [60]
+    assert int(np.asarray(dense_tr.cutoff[0])) == 60
+    assert row_entries(dense_tr, 1, interner) == []  # CLR via count 0
+    assert int(np.asarray(dense_tr.cutoff[1])) == 26

@@ -248,7 +248,8 @@ def test_sharded_tlog_convergence_trim_and_overflow():
 
     a, b = RepoTLOG(identity=1, len_cap=4), RepoTLOG(identity=2, len_cap=4)
     assert a._mesh is not None
-    assert len(a._state.ts.addressable_shards) == 8
+    assert a._state.wide  # mesh states use the fixed 3-plane layout
+    assert len(a._state.ntl.addressable_shards) == 8
     keys = [b"log%d" % i for i in range(40)]
     for repo, base in ((a, 0), (b, 1000)):
         for k in keys:
