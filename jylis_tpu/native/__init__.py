@@ -18,18 +18,30 @@ import subprocess
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _SRC_DIR = os.path.join(_REPO_ROOT, "native")
-_SO_PATH = os.path.join(_SRC_DIR, "libjylis_native.so")
+# deployed images/wheels carry the prebuilt .so without the C++ sources:
+# JYLIS_NATIVE_SO points straight at it (see Dockerfile), or `make
+# release` bundles it next to this file inside the wheel
+_PKG_SO = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "libjylis_native.so")
+_SO_PATH = (
+    os.environ.get("JYLIS_NATIVE_SO")
+    or (_PKG_SO if os.path.exists(_PKG_SO) else None)
+    or os.path.join(_SRC_DIR, "libjylis_native.so")
+)
 
 _lib: ctypes.CDLL | None = None
 _tried = False
 
 
 def _build() -> bool:
-    sources = [
-        os.path.join(_SRC_DIR, f)
-        for f in sorted(os.listdir(_SRC_DIR))
-        if f.endswith(".cpp")
-    ]
+    try:
+        sources = [
+            os.path.join(_SRC_DIR, f)
+            for f in sorted(os.listdir(_SRC_DIR))
+            if f.endswith(".cpp")
+        ]
+    except OSError:  # no source checkout (installed wheel / image)
+        return False
     if not sources:
         return False
     try:
@@ -46,6 +58,8 @@ def _build() -> bool:
 
 
 def _stale() -> bool:
+    if not os.path.isdir(_SRC_DIR):
+        return False  # prebuilt .so without sources is never stale
     so_mtime = os.path.getmtime(_SO_PATH)
     return any(
         os.path.getmtime(os.path.join(_SRC_DIR, f)) > so_mtime

@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""Three-node cluster smoke test (reference analog: the upstream's
+TestCluster three-node convergence assertion, test_cluster.pony:67-130,
+run against REAL server processes).
+
+Two modes:
+
+  --ports 6379,6380,6381   drive three already-running nodes (e.g. the
+                           docker-compose cluster) over RESP
+  --spawn                  spawn three local node processes first (no
+                           container runtime needed — what CI uses here)
+
+Each node INCs the same GCOUNT key with a different amount (2, 3, 4 — the
+reference test's exact workload), every node must converge to 9; then one
+write per remaining type (PNCOUNT/TREG/TLOG/UJSON) lands on a different
+node and must read back converged everywhere.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SPAWN = (
+    "from jylis_tpu.utils.vcpu import force_virtual_cpu; force_virtual_cpu(8); "
+    "import sys; from jylis_tpu.main import main; main(sys.argv[1:])"
+)
+
+
+def resp(*args) -> bytes:
+    out = b"*%d\r\n" % len(args)
+    for a in args:
+        if isinstance(a, str):
+            a = a.encode()
+        out += b"$%d\r\n%s\r\n" % (len(a), a)
+    return out
+
+
+class _Conn:
+    """Buffered RESP connection: parses exactly one complete reply per
+    command so a reply split across TCP segments can never desync the
+    stream (endswith-style heuristics truncate multi-frame arrays)."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.buf = b""
+
+    def _fill(self) -> None:
+        chunk = self.sock.recv(65536)
+        if not chunk:
+            raise RuntimeError("connection closed")
+        self.buf += chunk
+
+    def _line(self) -> bytes:
+        while b"\r\n" not in self.buf:
+            self._fill()
+        line, self.buf = self.buf.split(b"\r\n", 1)
+        return line
+
+    def _reply(self) -> bytes:
+        """Consume one reply from the stream, returning its exact bytes."""
+        line = self._line()
+        out = line + b"\r\n"
+        kind = line[:1]
+        if kind in (b"+", b"-", b":"):
+            return out
+        if kind == b"$":
+            n = int(line[1:])
+            if n < 0:
+                return out  # null bulk string
+            while len(self.buf) < n + 2:
+                self._fill()
+            out += self.buf[: n + 2]
+            self.buf = self.buf[n + 2 :]
+            return out
+        if kind == b"*":
+            for _ in range(max(int(line[1:]), 0)):
+                out += self._reply()
+            return out
+        raise RuntimeError(f"unparseable reply line: {line!r}")
+
+
+def cmd(conn: _Conn, *args) -> bytes:
+    conn.sock.sendall(resp(*args))
+    conn.sock.settimeout(30)
+    return conn._reply()
+
+
+def until(deadline: float, fn, what: str) -> None:
+    while time.time() < deadline:
+        try:
+            if fn():
+                return
+        except Exception:
+            pass
+        time.sleep(0.25)
+    raise SystemExit(f"SMOKE FAILED: timed out waiting for {what}")
+
+
+def connect_all(ports, deadline) -> list[_Conn]:
+    conns = []
+    for p in ports:
+        while True:
+            try:
+                conns.append(
+                    _Conn(socket.create_connection(("127.0.0.1", p), timeout=2))
+                )
+                break
+            except OSError:
+                if time.time() > deadline:
+                    raise SystemExit(f"SMOKE FAILED: node on :{p} never came up")
+                time.sleep(0.5)
+    return conns
+
+
+def run_smoke(ports) -> None:
+    deadline = time.time() + 120
+    conns = connect_all(ports, deadline)
+
+    # the reference test's exact convergence assertion: 2 + 3 + 4 == 9
+    for c, amount in zip(conns, ("2", "3", "4")):
+        assert cmd(c, "GCOUNT", "INC", "smoke", amount) == b"+OK\r\n"
+    for i, c in enumerate(conns):
+        until(
+            deadline,
+            lambda c=c: cmd(c, "GCOUNT", "GET", "smoke") == b":9\r\n",
+            f"GCOUNT convergence at node {i}",
+        )
+
+    # one write per remaining type, each landing on a different node
+    assert cmd(conns[0], "PNCOUNT", "INC", "pn", "10") == b"+OK\r\n"
+    assert cmd(conns[1], "PNCOUNT", "DEC", "pn", "3") == b"+OK\r\n"
+    assert cmd(conns[1], "TREG", "SET", "reg", "hello", "42") == b"+OK\r\n"
+    assert cmd(conns[2], "TLOG", "INS", "log", "entry", "7") == b"+OK\r\n"
+    assert cmd(conns[0], "UJSON", "SET", "doc", "k", '"v"') == b"+OK\r\n"
+    for i, c in enumerate(conns):
+        until(deadline, lambda c=c: cmd(c, "PNCOUNT", "GET", "pn") == b":7\r\n",
+              f"PNCOUNT at node {i}")
+        until(deadline, lambda c=c: cmd(c, "TREG", "GET", "reg")
+              == b"*2\r\n$5\r\nhello\r\n:42\r\n", f"TREG at node {i}")
+        until(deadline, lambda c=c: cmd(c, "TLOG", "GET", "log")
+              == b"*1\r\n*2\r\n$5\r\nentry\r\n:7\r\n", f"TLOG at node {i}")
+        until(deadline, lambda c=c: cmd(c, "UJSON", "GET", "doc")
+              == b'$9\r\n{"k":"v"}\r\n', f"UJSON at node {i}")
+    print("SMOKE3-OK")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ports", default=None,
+                    help="comma-separated RESP ports of running nodes")
+    ap.add_argument("--spawn", action="store_true",
+                    help="spawn three local node processes (no containers)")
+    args = ap.parse_args()
+
+    if args.spawn:
+        ports = [7411, 7412, 7413]
+        cports = [17411, 17412, 17413]
+        names = ["smoke-a", "smoke-b", "smoke-c"]
+        seed = f"127.0.0.1:{cports[0]}:{names[0]}"
+        procs = []
+        try:
+            for i, (p, cp, name) in enumerate(zip(ports, cports, names)):
+                argv = [sys.executable, "-c", SPAWN, "--port", str(p),
+                        "--addr", f"127.0.0.1:{cp}:{name}",
+                        "--heartbeat-time", "0.2", "--log-level", "warn"]
+                if i > 0:
+                    argv += ["--seed-addrs", seed]
+                procs.append(subprocess.Popen(argv, cwd=REPO))
+            run_smoke(ports)
+        finally:
+            for pr in procs:
+                pr.terminate()
+            for pr in procs:
+                pr.wait(timeout=30)
+    elif args.ports:
+        run_smoke([int(p) for p in args.ports.split(",")])
+    else:
+        ap.error("need --ports or --spawn")
+
+
+if __name__ == "__main__":
+    main()
