@@ -47,7 +47,7 @@ check-graft:
 # would shadow fresh native/ builds (the loader prefers the package-local
 # .so).
 release: native
-	rm -rf build_pkg dist && mkdir -p dist
+	rm -rf build dist && mkdir -p dist
 	cp native/libjylis_native.so jylis_tpu/native/
 	$(PY) -m pip wheel --no-deps --no-build-isolation -w dist .; \
 	  rc=$$?; rm -f jylis_tpu/native/libjylis_native.so; exit $$rc
@@ -69,5 +69,5 @@ smoke3:
 
 clean:
 	rm -f native/libjylis_native.so jylis_tpu/native/libjylis_native.so
-	rm -rf build_pkg dist
+	rm -rf build dist
 	find . -name __pycache__ -type d -exec rm -rf {} +

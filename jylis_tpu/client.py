@@ -1,0 +1,120 @@
+"""Minimal synchronous Python client for jylis-tpu.
+
+The server speaks RESP2, so any Redis client works against it
+(docs/_docs/start/connect.md:10-14 is the reference's compatibility
+contract, exercised by tests/test_client_conformance.py) — this module
+is the zero-dependency in-repo client used by the smoke tooling
+(scripts/smoke3.py), the conformance tests, and anyone who wants to
+talk to a node without installing redis-py.
+
+Wire behavior matches redis-py where it matters: commands are packed as
+RESP arrays of bulk strings; replies parse to bytes (+simple, $bulk),
+int (:n), None ($-1 / *-1), list (*n, recursive), and error replies
+raise (or, in pipelines, return) ResponseError.
+"""
+
+from __future__ import annotations
+
+import socket
+
+
+class ResponseError(Exception):
+    """An -error reply from the server (the connection stays usable)."""
+
+
+def pack_command(*args) -> bytes:
+    """One command as a RESP array of bulk strings (str/bytes/int args)."""
+    out = b"*%d\r\n" % len(args)
+    for a in args:
+        if isinstance(a, str):
+            a = a.encode()
+        elif isinstance(a, int):
+            a = b"%d" % a
+        out += b"$%d\r\n%s\r\n" % (len(a), a)
+    return out
+
+
+class Client:
+    """A buffered connection to one node.
+
+    Replies are parsed frame-exactly (a reply split across TCP segments
+    can never desync the stream). Not thread-safe; one Client per
+    connection."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 6379,
+                 timeout: float = 30.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.sock.settimeout(timeout)
+        self.buf = b""
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "Client":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- reply parsing ----------------------------------------------------
+    def _fill(self) -> None:
+        chunk = self.sock.recv(1 << 16)
+        if not chunk:
+            raise RuntimeError("connection closed by server")
+        self.buf += chunk
+
+    def _line(self) -> bytes:
+        while b"\r\n" not in self.buf:
+            self._fill()
+        line, self.buf = self.buf.split(b"\r\n", 1)
+        return line
+
+    def read_reply(self):
+        """Consume and decode exactly one reply from the stream."""
+        line = self._line()
+        kind, rest = line[:1], line[1:]
+        if kind == b"+":
+            return rest
+        if kind == b"-":
+            raise ResponseError(rest.decode())
+        if kind == b":":
+            return int(rest)
+        if kind == b"$":
+            n = int(rest)
+            if n < 0:
+                return None
+            while len(self.buf) < n + 2:
+                self._fill()
+            out, self.buf = self.buf[:n], self.buf[n + 2 :]
+            return out
+        if kind == b"*":
+            n = int(rest)
+            if n < 0:
+                return None
+            return [self.read_reply() for _ in range(n)]
+        raise RuntimeError(f"unparseable reply line: {line!r}")
+
+    # -- commands ---------------------------------------------------------
+    def execute_command(self, *args):
+        self.sock.sendall(pack_command(*args))
+        return self.read_reply()
+
+    def pipeline_execute(self, commands):
+        """redis-py Pipeline.execute(raise_on_error=False) semantics: one
+        write carrying every command, then the replies in order, with
+        error replies as ResponseError OBJECTS in the result list."""
+        self.sock.sendall(b"".join(pack_command(*c) for c in commands))
+        out = []
+        for _ in commands:
+            try:
+                out.append(self.read_reply())
+            except ResponseError as e:
+                out.append(e)
+        return out
+
+    def send_raw(self, data: bytes) -> None:
+        """Raw bytes on the wire (inline commands, tests)."""
+        self.sock.sendall(data)

@@ -14,18 +14,25 @@ Each node INCs the same GCOUNT key with a different amount (2, 3, 4 — the
 reference test's exact workload), every node must converge to 9; then one
 write per remaining type (PNCOUNT/TREG/TLOG/UJSON) lands on a different
 node and must read back converged everywhere.
+
+Every poll opens a fresh connection through jylis_tpu.client (the in-repo
+RESP client): a reply stalled past its timeout can therefore never desync
+a long-lived stream into spurious failures, and a crashed node surfaces
+as its connect error, not a silent stall.
 """
 
 from __future__ import annotations
 
 import argparse
 import os
-import socket
 import subprocess
 import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from jylis_tpu.client import Client  # noqa: E402
 
 SPAWN = (
     "from jylis_tpu.utils.vcpu import force_virtual_cpu; force_virtual_cpu(8); "
@@ -33,121 +40,57 @@ SPAWN = (
 )
 
 
-def resp(*args) -> bytes:
-    out = b"*%d\r\n" % len(args)
-    for a in args:
-        if isinstance(a, str):
-            a = a.encode()
-        out += b"$%d\r\n%s\r\n" % (len(a), a)
-    return out
-
-
-class _Conn:
-    """Buffered RESP connection: parses exactly one complete reply per
-    command so a reply split across TCP segments can never desync the
-    stream (endswith-style heuristics truncate multi-frame arrays)."""
-
-    def __init__(self, sock: socket.socket):
-        self.sock = sock
-        self.buf = b""
-
-    def _fill(self) -> None:
-        chunk = self.sock.recv(65536)
-        if not chunk:
-            raise RuntimeError("connection closed")
-        self.buf += chunk
-
-    def _line(self) -> bytes:
-        while b"\r\n" not in self.buf:
-            self._fill()
-        line, self.buf = self.buf.split(b"\r\n", 1)
-        return line
-
-    def _reply(self) -> bytes:
-        """Consume one reply from the stream, returning its exact bytes."""
-        line = self._line()
-        out = line + b"\r\n"
-        kind = line[:1]
-        if kind in (b"+", b"-", b":"):
-            return out
-        if kind == b"$":
-            n = int(line[1:])
-            if n < 0:
-                return out  # null bulk string
-            while len(self.buf) < n + 2:
-                self._fill()
-            out += self.buf[: n + 2]
-            self.buf = self.buf[n + 2 :]
-            return out
-        if kind == b"*":
-            for _ in range(max(int(line[1:]), 0)):
-                out += self._reply()
-            return out
-        raise RuntimeError(f"unparseable reply line: {line!r}")
-
-
-def cmd(conn: _Conn, *args) -> bytes:
-    conn.sock.sendall(resp(*args))
-    conn.sock.settimeout(30)
-    return conn._reply()
+def once(port: int, *args):
+    """One command on a fresh connection; returns the decoded reply."""
+    with Client("127.0.0.1", port, timeout=30) as c:
+        return c.execute_command(*args)
 
 
 def until(deadline: float, fn, what: str) -> None:
+    last_err = None
     while time.time() < deadline:
         try:
             if fn():
                 return
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001 — retried until the deadline
+            last_err = e
         time.sleep(0.25)
-    raise SystemExit(f"SMOKE FAILED: timed out waiting for {what}")
+    detail = f" (last error: {last_err!r})" if last_err else ""
+    raise SystemExit(f"SMOKE FAILED: timed out waiting for {what}{detail}")
 
 
-def connect_all(ports, deadline) -> list[_Conn]:
-    conns = []
+def wait_up(ports, deadline) -> None:
     for p in ports:
-        while True:
-            try:
-                conns.append(
-                    _Conn(socket.create_connection(("127.0.0.1", p), timeout=2))
-                )
-                break
-            except OSError:
-                if time.time() > deadline:
-                    raise SystemExit(f"SMOKE FAILED: node on :{p} never came up")
-                time.sleep(0.5)
-    return conns
+        until(deadline, lambda p=p: once(p, "GCOUNT", "GET", "up-probe") == 0,
+              f"node on :{p} to come up")
 
 
 def run_smoke(ports) -> None:
-    deadline = time.time() + 120
-    conns = connect_all(ports, deadline)
+    deadline = time.time() + 180
+    wait_up(ports, deadline)
 
     # the reference test's exact convergence assertion: 2 + 3 + 4 == 9
-    for c, amount in zip(conns, ("2", "3", "4")):
-        assert cmd(c, "GCOUNT", "INC", "smoke", amount) == b"+OK\r\n"
-    for i, c in enumerate(conns):
-        until(
-            deadline,
-            lambda c=c: cmd(c, "GCOUNT", "GET", "smoke") == b":9\r\n",
-            f"GCOUNT convergence at node {i}",
-        )
+    for p, amount in zip(ports, (2, 3, 4)):
+        assert once(p, "GCOUNT", "INC", "smoke", amount) == b"OK"
+    for p in ports:
+        until(deadline, lambda p=p: once(p, "GCOUNT", "GET", "smoke") == 9,
+              f"GCOUNT convergence on :{p}")
 
     # one write per remaining type, each landing on a different node
-    assert cmd(conns[0], "PNCOUNT", "INC", "pn", "10") == b"+OK\r\n"
-    assert cmd(conns[1], "PNCOUNT", "DEC", "pn", "3") == b"+OK\r\n"
-    assert cmd(conns[1], "TREG", "SET", "reg", "hello", "42") == b"+OK\r\n"
-    assert cmd(conns[2], "TLOG", "INS", "log", "entry", "7") == b"+OK\r\n"
-    assert cmd(conns[0], "UJSON", "SET", "doc", "k", '"v"') == b"+OK\r\n"
-    for i, c in enumerate(conns):
-        until(deadline, lambda c=c: cmd(c, "PNCOUNT", "GET", "pn") == b":7\r\n",
-              f"PNCOUNT at node {i}")
-        until(deadline, lambda c=c: cmd(c, "TREG", "GET", "reg")
-              == b"*2\r\n$5\r\nhello\r\n:42\r\n", f"TREG at node {i}")
-        until(deadline, lambda c=c: cmd(c, "TLOG", "GET", "log")
-              == b"*1\r\n*2\r\n$5\r\nentry\r\n:7\r\n", f"TLOG at node {i}")
-        until(deadline, lambda c=c: cmd(c, "UJSON", "GET", "doc")
-              == b'$9\r\n{"k":"v"}\r\n', f"UJSON at node {i}")
+    assert once(ports[0], "PNCOUNT", "INC", "pn", 10) == b"OK"
+    assert once(ports[1], "PNCOUNT", "DEC", "pn", 3) == b"OK"
+    assert once(ports[1], "TREG", "SET", "reg", "hello", 42) == b"OK"
+    assert once(ports[2], "TLOG", "INS", "log", "entry", 7) == b"OK"
+    assert once(ports[0], "UJSON", "SET", "doc", "k", '"v"') == b"OK"
+    for p in ports:
+        until(deadline, lambda p=p: once(p, "PNCOUNT", "GET", "pn") == 7,
+              f"PNCOUNT on :{p}")
+        until(deadline, lambda p=p: once(p, "TREG", "GET", "reg")
+              == [b"hello", 42], f"TREG on :{p}")
+        until(deadline, lambda p=p: once(p, "TLOG", "GET", "log")
+              == [[b"entry", 7]], f"TLOG on :{p}")
+        until(deadline, lambda p=p: once(p, "UJSON", "GET", "doc")
+              == b'{"k":"v"}', f"UJSON on :{p}")
     print("SMOKE3-OK")
 
 
@@ -175,10 +118,17 @@ def main() -> None:
                 procs.append(subprocess.Popen(argv, cwd=REPO))
             run_smoke(ports)
         finally:
+            # terminate EVERY node even if one outlives its grace period:
+            # a wedged first node must not leak the others (they hold the
+            # fixed smoke ports)
             for pr in procs:
                 pr.terminate()
             for pr in procs:
-                pr.wait(timeout=30)
+                try:
+                    pr.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    pr.kill()
+                    pr.wait(timeout=10)
     elif args.ports:
         run_smoke([int(p) for p in args.ports.split(",")])
     else:
