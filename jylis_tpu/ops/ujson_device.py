@@ -313,77 +313,97 @@ def plan_shift(docs, n_rep: int) -> int:
     return seq_bits if max_seq < (1 << seq_bits) - 1 else 32
 
 
+def _slot_cols(lens: np.ndarray) -> np.ndarray:
+    """Per-row slot columns for variable-length rows, vectorised:
+    [0..lens[0]) ++ [0..lens[1]) ++ ... with no Python per-row loop."""
+    total = int(lens.sum())
+    starts = np.cumsum(lens) - lens
+    return np.arange(total, dtype=np.int64) - np.repeat(starts, lens)
+
+
 def _encode_docs_np(
     docs, rid_cols: dict[int, int], pay_ids, n_rep: int, shift: int = 32
 ) -> DocBatch:
     """`encode_docs` core, returning host numpy planes (callers that
     reshape or concatenate do it host-side, then transfer ONCE — a jnp
-    reshape is a device dispatch, ruinous over a tunneled chip)."""
+    reshape is a device dispatch, ruinous over a tunneled chip).
+
+    This is the serving path's host bottleneck (the device fold is ~free
+    next to it), so the loop accumulates flat lists only — no per-doc
+    allocations, no sorting of singleton rows — and every plane fills
+    with one fancy-index scatter built from vectorised row/column
+    indices."""
     seq_cap = 1 << shift
-    rows = []
-    for doc in docs:
-        dots = []
-        for (rid, seq), (path, token) in doc.entries.items():
-            col = rid_cols.setdefault(rid, len(rid_cols))
+    setd = rid_cols.setdefault
+    b = len(docs)
+    d_lens = np.zeros(b, np.int64)
+    c_lens = np.zeros(b, np.int64)
+    dv: list[int] = []
+    pv: list[int] = []
+    cv: list[int] = []
+    vv_ri: list[int] = []
+    vv_ci: list[int] = []
+    vv_sv: list[int] = []
+    for i, doc in enumerate(docs):
+        n0 = len(dv)
+        for (rid, seq), pt in doc.entries.items():
+            col = setd(rid, len(rid_cols))
             if seq >= seq_cap:
                 raise OverflowError(f"seq {seq} needs a wider layout than {shift}")
-            dots.append(((col << shift) | seq, pay_ids(path, token)))
-        vv = np.zeros(n_rep, np.uint32)
+            dv.append((col << shift) | seq)
+            pv.append(pay_ids(*pt))
+        k = len(dv) - n0
+        if k > 1:  # rows must be dot-sorted; singletons already are
+            seg = sorted(zip(dv[n0:], pv[n0:]))
+            dv[n0:] = [d for d, _ in seg]
+            pv[n0:] = [p for _, p in seg]
+        d_lens[i] = k
         for rid, s in doc.ctx.vv.items():
-            col = rid_cols.setdefault(rid, len(rid_cols))
+            col = setd(rid, len(rid_cols))
             if s >= seq_cap or s > 0xFFFFFFFF:
                 # clamping would SHRINK coverage and resurrect removed
                 # entries — refuse; callers fall back to the host lattice
                 raise OverflowError(f"vv seq {s} needs a wider layout")
-            vv[col] = s
-        cloud = []
+            vv_ri.append(i)
+            vv_ci.append(col)
+            vv_sv.append(s)
+        n0c = len(cv)
         for rid, seq in doc.ctx.cloud:
-            col = rid_cols.setdefault(rid, len(rid_cols))
+            col = setd(rid, len(rid_cols))
             if seq >= seq_cap:
                 raise OverflowError(f"seq {seq} needs a wider layout than {shift}")
-            cloud.append((col << shift) | seq)
-        rows.append((sorted(dots), vv, sorted(cloud)))
+            cv.append((col << shift) | seq)
+        kc = len(cv) - n0c
+        if kc > 1:
+            cv[n0c:] = sorted(cv[n0c:])
+        c_lens[i] = kc
     dtype = np.int32 if shift < 32 else np.uint64
     pad = _pad_of(dtype)
-    for drow, _vrow, crow in rows:
-        if (drow and drow[-1][0] == int(pad)) or (crow and crow[-1] == int(pad)):
-            raise OverflowError("dot collides with the pad sentinel")
     if len(rid_cols) > n_rep:
         raise ValueError(f"n_rep {n_rep} too small for {len(rid_cols)} replicas")
-    wl = bucket(max((len(r[0]) for r in rows), default=1), 4)
-    wc = bucket(max((len(r[2]) for r in rows), default=1), 4)
-    b = len(rows)
+    wl = bucket(max(int(d_lens.max()) if b else 0, 1), 4)
+    wc = bucket(max(int(c_lens.max()) if b else 0, 1), 4)
     dots = np.full((b, wl), pad, dtype)
     pay = np.full((b, wl), -1, np.int32)
     vv = np.zeros((b, n_rep), np.uint32)
     cloud = np.full((b, wc), pad, dtype)
-    # flatten to index/value lists and fill with ONE fancy-index scatter
-    # per plane — per-element np scalar assignment dominated encode time
-    ri: list[int] = []
-    ci: list[int] = []
-    dv: list[int] = []
-    pv: list[int] = []
-    cri: list[int] = []
-    cci: list[int] = []
-    cv: list[int] = []
-    for i, (drow, vrow, crow) in enumerate(rows):
-        ri.extend([i] * len(drow))
-        ci.extend(range(len(drow)))
-        dv.extend(d for d, _ in drow)
-        pv.extend(p for _, p in drow)
-        vv[i] = vrow
-        cri.extend([i] * len(crow))
-        cci.extend(range(len(crow)))
-        cv.extend(crow)
-    if ri:
-        rows_i = np.asarray(ri, np.int64)
-        cols_i = np.asarray(ci, np.int64)
-        dots[rows_i, cols_i] = np.asarray(dv, dtype)
+    if dv:
+        dvals = np.asarray(dv, dtype)
+        if bool((dvals == pad).any()):
+            raise OverflowError("dot collides with the pad sentinel")
+        rows_i = np.repeat(np.arange(b), d_lens)
+        cols_i = _slot_cols(d_lens)
+        dots[rows_i, cols_i] = dvals
         pay[rows_i, cols_i] = np.asarray(pv, np.int32)
-    if cri:
-        cloud[np.asarray(cri, np.int64), np.asarray(cci, np.int64)] = np.asarray(
-            cv, dtype
+    if vv_ri:
+        vv[np.asarray(vv_ri, np.int64), np.asarray(vv_ci, np.int64)] = np.asarray(
+            vv_sv, np.uint32
         )
+    if cv:
+        cvals = np.asarray(cv, dtype)
+        if bool((cvals == pad).any()):
+            raise OverflowError("dot collides with the pad sentinel")
+        cloud[np.repeat(np.arange(b), c_lens), _slot_cols(c_lens)] = cvals
     return DocBatch(dots, pay, vv, cloud)
 
 
