@@ -21,7 +21,9 @@ from .base import ParseError, need, parse_opt_count
 from .help import LeafHelp
 
 SYSTEM_HELP = LeafHelp(
-    "The following are valid SYSTEM commands:\n  SYSTEM GETLOG [count]"
+    "The following are valid SYSTEM commands:\n"
+    "  SYSTEM GETLOG [count]\n"
+    "  SYSTEM METRICS"
 )
 
 
@@ -48,6 +50,18 @@ class RepoSYSTEM:
                 resp.array_start(2)
                 resp.string(value)
                 resp.u64(ts)
+            return False
+        if op == b"METRICS":
+            # live merge-path metrics (extension — the reference has no
+            # metrics surface at all; until round 3 these were visible
+            # only in the shutdown report): one "name key value" line per
+            # counter, flat and greppable from any Redis client
+            from ..utils.metrics import metric_lines
+
+            lines = metric_lines()
+            resp.array_start(len(lines))
+            for line in lines:
+                resp.string(line)
             return False
         raise ParseError()
 

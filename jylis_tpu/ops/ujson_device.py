@@ -302,15 +302,19 @@ def plan_shift(docs, n_rep: int) -> int:
     """
     rid_bits = max(int(n_rep - 1).bit_length(), 1)
     seq_bits = 31 - rid_bits
-    max_seq = 0
+    wide = (1 << seq_bits) - 1
+    # per-container max() builtins instead of per-item Python compares:
+    # this scan runs on every drain, right next to the encode hot loop
     for doc in docs:
-        for _, s in doc.entries:
-            max_seq = max(max_seq, s)
-        for s in doc.ctx.vv.values():
-            max_seq = max(max_seq, s)
-        for _, s in doc.ctx.cloud:
-            max_seq = max(max_seq, s)
-    return seq_bits if max_seq < (1 << seq_bits) - 1 else 32
+        if doc.entries and max(s for _, s in doc.entries) >= wide:
+            return 32
+        vv = doc.ctx.vv
+        if vv and max(vv.values()) >= wide:
+            return 32
+        cl = doc.ctx.cloud
+        if cl and max(s for _, s in cl) >= wide:
+            return 32
+    return seq_bits
 
 
 def _slot_cols(lens: np.ndarray) -> np.ndarray:

@@ -88,6 +88,19 @@ def stop_profiling() -> None:
         _profiling = False
 
 
+def metric_lines() -> list[str]:
+    """Flat `type counter value` lines — the SYSTEM METRICS reply body.
+    Owning the iteration here keeps the RESP surface and the shutdown
+    report in lockstep when counters grow fields."""
+    lines = []
+    for name in sorted(counters):
+        c = counters[name]
+        lines.append(f"{name} drains {int(c['batches'])}")
+        lines.append(f"{name} keys {int(c['keys'])}")
+        lines.append(f"{name} device_ms {c['seconds'] * 1e3:.1f}")
+    return lines
+
+
 def report() -> str:
     parts = []
     for name in sorted(counters):

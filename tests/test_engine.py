@@ -425,3 +425,26 @@ def test_counter_gets_skip_device_when_local_only(db):
     assert run(db, "PNCOUNT", "GET", "pk2") == want  # eager host path
     db.manager("PNCOUNT").repo.converge(b"pk2", ({}, {}))  # force a drain
     assert run(db, "PNCOUNT", "GET", "pk2") == want  # device path agrees
+
+
+def test_system_metrics_command(db):
+    """SYSTEM METRICS (extension): live per-type drain counters over
+    RESP — drains become visible without waiting for the shutdown
+    report."""
+    from jylis_tpu.utils import metrics
+
+    before = int(metrics.counters["TLOG"]["batches"])
+    run(db, "TLOG", "INS", "m:met", "x", "5")
+    db.manager("TLOG").repo.drain()
+    out = run(db, "SYSTEM", "METRICS")
+    assert out.startswith(b"*")
+    assert b"TLOG drains" in out
+    # the counter moved past its pre-test value
+    lines = [l for l in out.split(b"\r\n") if l.startswith(b"TLOG drains")]
+    assert lines, out
+    # parse "TLOG drains N" from the bulk payload
+    n = int(lines[0].rsplit(b" ", 1)[1])
+    assert n >= before + 1
+    # unknown op still errors with the (extended) help table
+    err = run(db, "SYSTEM", "NOPE")
+    assert err.startswith(b"-BADCOMMAND") and b"METRICS" in err
