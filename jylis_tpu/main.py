@@ -44,6 +44,10 @@ class Dispose:
         self._log = log
         self._disposing = False
         self._shutdown_task: asyncio.Task | None = None
+        self.snapshot_task: asyncio.Task | None = None  # online snapshot loop
+        # the loop's in-flight write future: cancelling the task does NOT
+        # stop a to_thread worker, so shutdown must await this too
+        self.snapshot_inflight: dict = {"write": None}
         self.done = asyncio.Event()
 
     def on_signal(self) -> None:
@@ -70,6 +74,20 @@ class Dispose:
         # and `done` must still be set, or a second SIGINT would no-op
         # (_disposing already True) and the process would only die to SIGKILL
         try:
+            # the online snapshot loop must be fully stopped before the
+            # shutdown snapshot runs: both write path.tmp, and a
+            # concurrent writer would corrupt the rename source. Two
+            # steps: cancel the loop task, then await any write worker
+            # it had in flight (task cancellation cannot stop a thread)
+            if self.snapshot_task is not None:
+                self.snapshot_task.cancel()
+                try:
+                    await self.snapshot_task
+                except asyncio.CancelledError:
+                    pass
+                inflight = self.snapshot_inflight.get("write")
+                if inflight is not None:
+                    await asyncio.wait([inflight])
             # final flush rides broadcast_deltas; per-repo locks wait out
             # threaded drains and fence off late-queued commands
             await self._database.clean_shutdown_async()
@@ -132,11 +150,55 @@ async def run(argv: list[str] | None = None) -> None:
     dispose = Dispose(database, server, cluster, snapshot_path, log)
     dispose.on_signal()
 
+    if snapshot_path and config.snapshot_interval > 0:
+        dispose.snapshot_task = asyncio.create_task(
+            _snapshot_loop(
+                database, snapshot_path, config.snapshot_interval, log,
+                dispose.snapshot_inflight,
+            )
+        )
+
     print(LOGO)
     log = config.log
     log.info() and log.i(f"cluster address: {config.addr}")
     log.info() and log.i(f"serving clients on port: {server.port}")
     await dispose.done.wait()
+
+
+async def _snapshot_loop(
+    database, path: str, interval: float, log, inflight: dict
+) -> None:
+    """Online snapshots while serving (extension over shutdown-only
+    persistence — a crash otherwise loses everything since boot). Each
+    type dumps under its own repo lock with device touches in a worker
+    thread (Database.dump_state_async, the bootstrap-sync dump), so
+    serving never pauses globally; cross-type skew is CRDT-safe because
+    restore is lattice convergence. The write is atomic, so a crash
+    mid-snapshot keeps the previous file.
+
+    The write future is published through ``inflight["write"]`` until it
+    completes: if this task is cancelled mid-write, the worker thread
+    runs on, and Dispose awaits the future before the shutdown snapshot
+    touches the same tmp file."""
+    while True:
+        await asyncio.sleep(interval)
+        try:
+            batches = await database.dump_state_async()
+            fut = asyncio.ensure_future(
+                asyncio.to_thread(persist.write_snapshot, batches, path)
+            )
+            inflight["write"] = fut
+            fut.add_done_callback(
+                lambda f: inflight.__setitem__("write", None)
+                if inflight.get("write") is f
+                else None
+            )
+            await asyncio.shield(fut)
+            log.debug() and log.d(f"online snapshot written: {path}")
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            log.err() and log.e(f"online snapshot failed: {e}")
 
 
 def main(argv: list[str] | None = None) -> None:

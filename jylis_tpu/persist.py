@@ -28,13 +28,23 @@ MAGIC = b"JYLSNAP1"
 
 def save_snapshot(database, path: str) -> None:
     """Atomic (write-then-rename) full-state snapshot of every repo."""
+    write_snapshot(
+        ((mgr.name, mgr.repo.dump_state()) for mgr in database.managers()),
+        path,
+    )
+
+
+def write_snapshot(batches, path: str) -> None:
+    """Atomic snapshot from pre-dumped (name, batch) pairs — the online
+    snapshot path dumps each type under its own repo lock
+    (Database.dump_state_async) and hands the batches here; a crash
+    mid-write leaves the previous file intact (write-then-rename)."""
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         f.write(MAGIC)
         f.write(codec.signature())
-        for mgr in database.managers():
-            batch = mgr.repo.dump_state()
-            f.write(frame(codec.encode(MsgPushDeltas(mgr.name, tuple(batch)))))
+        for name, batch in batches:
+            f.write(frame(codec.encode(MsgPushDeltas(name, tuple(batch)))))
     os.replace(tmp, path)
 
 

@@ -31,6 +31,7 @@ class Config:
     heartbeat_time: float = 10.0
     system_log_trim: int = 200
     data_dir: str = ""  # extension: snapshot/restore (persist.py)
+    snapshot_interval: float = 0.0  # extension: online snapshot cadence
     log: Log = field(default_factory=Log.create_none)
 
     def normalize(self) -> None:
@@ -71,10 +72,20 @@ def config_from_cli(argv: list[str] | None = None, log_out=None) -> Config:
         "reference.",
     )
     parser.add_argument(
+        "--snapshot-interval", type=float, default=0.0,
+        help="Seconds between ONLINE snapshots while serving (requires "
+        "--data-dir). 0 (default) snapshots only at clean shutdown; a "
+        "crash then loses everything since boot, so long-lived nodes "
+        "should set an interval (writes are atomic; each type dumps "
+        "under its own lock, so serving never pauses globally).",
+    )
+    parser.add_argument(
         "-L", "--log-level", default="info",
         help="Maximum level of detail for logging (error, warn, info, or debug).",
     )
     args = parser.parse_args(argv)
+    if args.snapshot_interval > 0 and not args.data_dir:
+        parser.error("--snapshot-interval requires --data-dir")
 
     config = Config()
     config.port = args.port
@@ -85,6 +96,7 @@ def config_from_cli(argv: list[str] | None = None, log_out=None) -> Config:
     config.heartbeat_time = args.heartbeat_time
     config.system_log_trim = args.system_log_trim
     config.data_dir = args.data_dir
+    config.snapshot_interval = args.snapshot_interval
 
     level = {"error": "err", "warn": "warn", "info": "info", "debug": "debug"}.get(
         args.log_level

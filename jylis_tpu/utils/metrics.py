@@ -88,25 +88,30 @@ def stop_profiling() -> None:
         _profiling = False
 
 
+def _type_stats():
+    """(name, drains, keys, device_ms) per type — the ONE iteration both
+    reporting surfaces share, so they can't drift apart. list(counters)
+    snapshots the key set atomically under the GIL: note_drain runs in
+    worker threads and may insert a type's key mid-request."""
+    for name in sorted(list(counters)):
+        c = counters.get(name)
+        if c is not None:
+            yield name, int(c["batches"]), int(c["keys"]), c["seconds"] * 1e3
+
+
 def metric_lines() -> list[str]:
-    """Flat `type counter value` lines — the SYSTEM METRICS reply body.
-    Owning the iteration here keeps the RESP surface and the shutdown
-    report in lockstep when counters grow fields."""
+    """Flat `type counter value` lines — the SYSTEM METRICS reply body."""
     lines = []
-    for name in sorted(counters):
-        c = counters[name]
-        lines.append(f"{name} drains {int(c['batches'])}")
-        lines.append(f"{name} keys {int(c['keys'])}")
-        lines.append(f"{name} device_ms {c['seconds'] * 1e3:.1f}")
+    for name, drains, keys, ms in _type_stats():
+        lines.append(f"{name} drains {drains}")
+        lines.append(f"{name} keys {keys}")
+        lines.append(f"{name} device_ms {ms:.1f}")
     return lines
 
 
 def report() -> str:
-    parts = []
-    for name in sorted(counters):
-        c = counters[name]
-        parts.append(
-            f"{name}: {int(c['batches'])} drains, {int(c['keys'])} keys, "
-            f"{c['seconds'] * 1e3:.1f}ms device"
-        )
+    parts = [
+        f"{name}: {drains} drains, {keys} keys, {ms:.1f}ms device"
+        for name, drains, keys, ms in _type_stats()
+    ]
     return "; ".join(parts) if parts else "no drains"

@@ -146,3 +146,110 @@ def test_truncation_at_frame_boundary_detected(tmp_path):
     cut.write_bytes(blob[: sig_end + HEADER_SIZE + first_len])
     with pytest.raises(persist.SnapshotError, match="type batches"):
         persist.load_snapshot(Database(identity=1), str(cut))
+
+
+def test_write_snapshot_from_async_dump(tmp_path):
+    """The online-snapshot path: per-type async dumps written atomically
+    load back into a fresh database identically to save_snapshot."""
+    import asyncio
+
+    db = Database(identity=7)
+    call(db, "GCOUNT", "INC", "g", "5")
+    call(db, "TLOG", "INS", "l", "e", "9")
+    call(db, "TREG", "SET", "r", "v", "3")
+    call(db, "UJSON", "SET", "d", "k", '"x"')
+    path = str(tmp_path / "online.jylis")
+    batches = asyncio.run(db.dump_state_async())
+    persist.write_snapshot(batches, path)
+    fresh = Database(identity=8)
+    assert persist.load_snapshot(fresh, path) == len(list(fresh.managers()))
+    assert call(fresh, "GCOUNT", "GET", "g") == b":5\r\n"
+    assert call(fresh, "TLOG", "GET", "l") == b"*1\r\n*2\r\n$1\r\ne\r\n:9\r\n"
+    assert call(fresh, "TREG", "GET", "r") == b"*2\r\n$1\r\nv\r\n:3\r\n"
+    assert call(fresh, "UJSON", "GET", "d", "k") == b'$3\r\n"x"\r\n'
+
+
+def test_online_snapshot_survives_sigkill(tmp_path):
+    """The point of --snapshot-interval: a node that is KILLED (no clean
+    shutdown) restarts with every write that made it into the last
+    online snapshot."""
+    import os
+    import signal
+    import socket
+    import subprocess
+    import sys
+    import time
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spawn = (
+        "import jax; jax.config.update('jax_platforms','cpu'); "
+        "import sys; from jylis_tpu.main import main; main(sys.argv[1:])"
+    )
+    data = str(tmp_path / "data")
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    port, cport = free_port(), free_port()
+    argv = [sys.executable, "-c", spawn, "--port", str(port), "--addr",
+            f"127.0.0.1:{cport}:snapnode", "--data-dir", data,
+            "--snapshot-interval", "0.3", "--log-level", "warn"]
+
+    def cmd(sock, *args):
+        out = b"*%d\r\n" % len(args)
+        for a in args:
+            a = a.encode() if isinstance(a, str) else a
+            out += b"$%d\r\n%s\r\n" % (len(a), a)
+        sock.sendall(out)
+        sock.settimeout(30)
+        buf = b""
+        while not buf.endswith(b"\r\n"):
+            buf += sock.recv(1 << 16)
+        return buf
+
+    def connect(deadline):
+        while time.time() < deadline:
+            try:
+                return socket.create_connection(("127.0.0.1", port), timeout=2)
+            except OSError:
+                time.sleep(0.3)
+        raise RuntimeError("node never came up")
+
+    proc = subprocess.Popen(argv, cwd=repo_root)
+    try:
+        s = connect(time.time() + 120)
+        assert cmd(s, "GCOUNT", "INC", "crash", "41") == b"+OK\r\n"
+        assert cmd(s, "TLOG", "INS", "log", "survivor", "7") == b"+OK\r\n"
+        # wait for an online snapshot to exist, then for one MORE cycle
+        # (mtime advances) so the writes above are certainly included
+        snap = os.path.join(data, "snapshot.jylis")
+        deadline = time.time() + 60
+        while not os.path.exists(snap) and time.time() < deadline:
+            time.sleep(0.1)
+        assert os.path.exists(snap), "online snapshot never appeared"
+        first = os.path.getmtime(snap)
+        while os.path.getmtime(snap) == first and time.time() < deadline:
+            time.sleep(0.1)
+    finally:
+        proc.send_signal(signal.SIGKILL)  # no clean shutdown, no final dump
+        proc.wait(timeout=30)
+
+    proc = subprocess.Popen(argv, cwd=repo_root)
+    try:
+        s = connect(time.time() + 120)
+        deadline = time.time() + 30
+        got = b""
+        while time.time() < deadline:
+            got = cmd(s, "GCOUNT", "GET", "crash")
+            if got == b":41\r\n":
+                break
+            time.sleep(0.2)
+        assert got == b":41\r\n", got
+        assert cmd(s, "TLOG", "SIZE", "log") == b":1\r\n"
+    finally:
+        proc.terminate()
+        proc.wait(timeout=60)
