@@ -30,7 +30,6 @@ import pytest
 from jylis_tpu.client import Client, ResponseError
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PORT = 7441
 
 SPAWN = (
     "import jax; jax.config.update('jax_platforms','cpu'); "
@@ -38,17 +37,26 @@ SPAWN = (
 )
 
 
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
 @pytest.fixture(scope="module")
 def server():
+    port, cport = _free_port(), _free_port()
     proc = subprocess.Popen(
-        [sys.executable, "-c", SPAWN, "--port", str(PORT), "--addr",
-         "127.0.0.1:17441:conformance", "--log-level", "warn"],
+        [sys.executable, "-c", SPAWN, "--port", str(port), "--addr",
+         f"127.0.0.1:{cport}:conformance", "--log-level", "warn"],
         cwd=REPO,
     )
     deadline = time.time() + 120
     while time.time() < deadline:
         try:
-            socket.create_connection(("127.0.0.1", PORT), timeout=1).close()
+            socket.create_connection(("127.0.0.1", port), timeout=1).close()
             break
         except OSError:
             if proc.poll() is not None:
@@ -57,7 +65,7 @@ def server():
     else:
         proc.terminate()
         raise RuntimeError("server never came up")
-    yield PORT
+    yield port
     proc.terminate()
     proc.wait(timeout=60)
 
