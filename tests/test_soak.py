@@ -1,0 +1,122 @@
+"""Bounded soak: a REAL node under sustained mixed churn.
+
+Excluded from the per-commit suite (`-m soak` runs it; CI's nightly job
+does). One spawned server takes ~30 seconds of continuous writes across
+all five types — TREG overwrite churn (interner epoch compactions),
+TLOG inserts with periodic TRIMs, counter increments, UJSON edits,
+online snapshots every second — with spot reads checked against host
+models throughout, and the process RSS must plateau: the memory at the
+end may not grow more than 50% over the reading taken after the first
+third (the interner-leak class of bug shows up here as monotonic
+growth).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from jylis_tpu.client import Client
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SPAWN = (
+    "import jax; jax.config.update('jax_platforms','cpu'); "
+    "import sys; from jylis_tpu.main import main; main(sys.argv[1:])"
+)
+SOAK_SECONDS = 30
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _rss_kb(pid: int) -> int:
+    with open(f"/proc/{pid}/statm") as f:
+        pages = int(f.read().split()[1])
+    return pages * (os.sysconf("SC_PAGE_SIZE") // 1024)
+
+
+@pytest.mark.soak
+def test_thirty_second_mixed_churn_soak(tmp_path):
+    port, cport = _free_port(), _free_port()
+    data = str(tmp_path / "data")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", SPAWN, "--port", str(port), "--addr",
+         f"127.0.0.1:{cport}:soaknode", "--data-dir", data,
+         "--snapshot-interval", "1", "--log-level", "warn"],
+        cwd=REPO,
+    )
+    try:
+        c = None
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            try:
+                c = Client("127.0.0.1", port, timeout=60)
+                break
+            except OSError:
+                time.sleep(0.3)
+        assert c, "node never came up"
+
+        gcount = 0
+        pn = 0
+        treg: dict[int, tuple[bytes, int]] = {}
+        tlog_n = 0
+        rss_at_third = None
+        start = time.time()
+        i = 0
+        while time.time() - start < SOAK_SECONDS:
+            i += 1
+            k = i % 500
+            # TREG overwrite churn: every round replaces values, so the
+            # interner must compact or RSS grows forever
+            val = b"v%d-%d" % (k, i)
+            assert c.execute_command("TREG", "SET", "r%d" % k, val, i) == b"OK"
+            treg[k] = (val, i)
+            assert c.execute_command("GCOUNT", "INC", "g", 1) == b"OK"
+            gcount += 1
+            assert c.execute_command("PNCOUNT", "DEC" if i % 3 else "INC", "p", 2) == b"OK"
+            pn += 2 if i % 3 == 0 else -2
+            assert c.execute_command("TLOG", "INS", "l", b"e%d" % i, i) == b"OK"
+            tlog_n += 1
+            if i % 400 == 0:
+                assert c.execute_command("TLOG", "TRIM", "l", 50) == b"OK"
+                tlog_n = 50
+            if i % 7 == 0:
+                assert c.execute_command(
+                    "UJSON", "SET", "d", "f%d" % (i % 16), "%d" % i
+                ) == b"OK"
+            if i % 250 == 0:
+                # spot reads against the host models
+                assert c.execute_command("GCOUNT", "GET", "g") == gcount
+                assert c.execute_command("PNCOUNT", "GET", "p") == pn
+                want_val, want_ts = treg[k]
+                assert c.execute_command("TREG", "GET", "r%d" % k) == [want_val, want_ts]
+                size = c.execute_command("TLOG", "SIZE", "l")
+                assert size == tlog_n, (size, tlog_n)
+            if rss_at_third is None and time.time() - start > SOAK_SECONDS / 3:
+                rss_at_third = _rss_kb(proc.pid)
+        assert rss_at_third is not None, "soak too short to sample RSS"
+        rss_end = _rss_kb(proc.pid)
+        assert rss_end < rss_at_third * 1.5, (
+            f"RSS grew {rss_at_third}kB -> {rss_end}kB during steady churn"
+        )
+        # final coherence + a live metrics read
+        assert c.execute_command("GCOUNT", "GET", "g") == gcount
+        metrics = c.execute_command("SYSTEM", "METRICS")
+        assert any(line.startswith(b"TREG drains") for line in metrics)
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
