@@ -419,6 +419,18 @@ class RepoTLOG:
             else jax.numpy.asarray(new_nv)
         )
 
+    def _finish_drain(self, updates) -> None:
+        """Common drain epilogue: refresh the per-row host caches from the
+        kernel's (row, length, cutoff) read-backs, then clear pending."""
+        for row, ln, ct in updates:
+            self._render.pop(row, None)
+            self._merged.pop(row, None)
+            self._len_cache[row] = int(ln)
+            self._cut_cache[row] = int(ct)
+        self._pend_entries.clear()
+        self._pend_cutoff.clear()
+        self._row_overdue = False
+
     @timed_drain(
         "TLOG",
         lambda self: len(set(self._pend_entries) | set(self._pend_cutoff)),
@@ -501,14 +513,7 @@ class RepoTLOG:
                 self._state = new_state
                 lens = np.asarray(lens)
                 cuts = np.asarray(cuts)
-                for row in rows:
-                    self._render.pop(row, None)
-                    self._merged.pop(row, None)
-                    self._len_cache[row] = int(lens[row])
-                    self._cut_cache[row] = int(cuts[row])
-                self._pend_entries.clear()
-                self._pend_cutoff.clear()
-                self._row_overdue = False
+                self._finish_drain((r, lens[r], cuts[r]) for r in rows)
                 return
             b = bucket(len(rows))
             ki = np.full(b, PAD_ROW, np.int32)
@@ -535,14 +540,7 @@ class RepoTLOG:
             self._state = new_state
             lens = np.asarray(lens)
             cuts = np.asarray(cuts)
-            for i, row in enumerate(rows):
-                self._render.pop(row, None)
-                self._merged.pop(row, None)
-                self._len_cache[row] = int(lens[i])
-                self._cut_cache[row] = int(cuts[i])
-            self._pend_entries.clear()
-            self._pend_cutoff.clear()
-            self._row_overdue = False
+            self._finish_drain(zip(rows, lens, cuts))
             return
 
     def _drain_sharded(self, rows, trim=None) -> None:
@@ -588,15 +586,9 @@ class RepoTLOG:
                 continue
             self._state = tlog.TLogState(*out[:5])
             lens, cuts = np.asarray(out[6]), np.asarray(out[7])
-            for j, g in enumerate(slots):
-                if g < 0:
-                    continue
-                row = int(g)
-                self._render.pop(row, None)
-                self._merged.pop(row, None)
-                self._len_cache[row] = int(lens[j])
-                self._cut_cache[row] = int(cuts[j])
-            self._pend_entries.clear()
-            self._pend_cutoff.clear()
-            self._row_overdue = False
+            self._finish_drain(
+                (int(g), lens[j], cuts[j])
+                for j, g in enumerate(slots)
+                if g >= 0
+            )
             return

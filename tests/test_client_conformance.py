@@ -19,55 +19,24 @@ native scanner.
 
 from __future__ import annotations
 
-import os
-import socket
-import subprocess
-import sys
-import time
-
 import pytest
 
 from jylis_tpu.client import Client, ResponseError
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-SPAWN = (
-    "import jax; jax.config.update('jax_platforms','cpu'); "
-    "import sys; from jylis_tpu.main import main; main(sys.argv[1:])"
-)
-
-
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    p = s.getsockname()[1]
-    s.close()
-    return p
+from procutil import connect_client, free_port, spawn_node, stop_node
 
 
 @pytest.fixture(scope="module")
 def server():
-    port, cport = _free_port(), _free_port()
-    proc = subprocess.Popen(
-        [sys.executable, "-c", SPAWN, "--port", str(port), "--addr",
-         f"127.0.0.1:{cport}:conformance", "--log-level", "warn"],
-        cwd=REPO,
-    )
-    deadline = time.time() + 120
-    while time.time() < deadline:
-        try:
-            socket.create_connection(("127.0.0.1", port), timeout=1).close()
-            break
-        except OSError:
-            if proc.poll() is not None:
-                raise RuntimeError("server process died during startup")
-            time.sleep(0.3)
-    else:
-        proc.terminate()
-        raise RuntimeError("server never came up")
+    port, cport = free_port(), free_port()
+    proc = spawn_node(port, cport, "conformance")
+    try:
+        connect_client(port, proc=proc).close()
+    except Exception:
+        stop_node(proc)
+        raise
     yield port
-    proc.terminate()
-    proc.wait(timeout=60)
+    stop_node(proc)
 
 
 @pytest.fixture()

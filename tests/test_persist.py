@@ -175,55 +175,19 @@ def test_online_snapshot_survives_sigkill(tmp_path):
     online snapshot."""
     import os
     import signal
-    import socket
-    import subprocess
-    import sys
     import time
 
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    spawn = (
-        "import jax; jax.config.update('jax_platforms','cpu'); "
-        "import sys; from jylis_tpu.main import main; main(sys.argv[1:])"
-    )
+    from procutil import connect_client, free_port, spawn_node, stop_node
+
     data = str(tmp_path / "data")
-
-    def free_port():
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        p = s.getsockname()[1]
-        s.close()
-        return p
-
     port, cport = free_port(), free_port()
-    argv = [sys.executable, "-c", spawn, "--port", str(port), "--addr",
-            f"127.0.0.1:{cport}:snapnode", "--data-dir", data,
-            "--snapshot-interval", "0.3", "--log-level", "warn"]
+    extra = ("--data-dir", data, "--snapshot-interval", "0.3")
 
-    def cmd(sock, *args):
-        out = b"*%d\r\n" % len(args)
-        for a in args:
-            a = a.encode() if isinstance(a, str) else a
-            out += b"$%d\r\n%s\r\n" % (len(a), a)
-        sock.sendall(out)
-        sock.settimeout(30)
-        buf = b""
-        while not buf.endswith(b"\r\n"):
-            buf += sock.recv(1 << 16)
-        return buf
-
-    def connect(deadline):
-        while time.time() < deadline:
-            try:
-                return socket.create_connection(("127.0.0.1", port), timeout=2)
-            except OSError:
-                time.sleep(0.3)
-        raise RuntimeError("node never came up")
-
-    proc = subprocess.Popen(argv, cwd=repo_root)
+    proc = spawn_node(port, cport, "snapnode", *extra)
     try:
-        s = connect(time.time() + 120)
-        assert cmd(s, "GCOUNT", "INC", "crash", "41") == b"+OK\r\n"
-        assert cmd(s, "TLOG", "INS", "log", "survivor", "7") == b"+OK\r\n"
+        c = connect_client(port, proc=proc)
+        assert c.execute_command("GCOUNT", "INC", "crash", 41) == b"OK"
+        assert c.execute_command("TLOG", "INS", "log", "survivor", 7) == b"OK"
         # wait for an online snapshot to exist, then for one MORE cycle
         # (mtime advances) so the writes above are certainly included
         snap = os.path.join(data, "snapshot.jylis")
@@ -238,18 +202,17 @@ def test_online_snapshot_survives_sigkill(tmp_path):
         proc.send_signal(signal.SIGKILL)  # no clean shutdown, no final dump
         proc.wait(timeout=30)
 
-    proc = subprocess.Popen(argv, cwd=repo_root)
+    proc = spawn_node(port, cport, "snapnode", *extra)
     try:
-        s = connect(time.time() + 120)
+        c = connect_client(port, proc=proc)
         deadline = time.time() + 30
-        got = b""
+        got = None
         while time.time() < deadline:
-            got = cmd(s, "GCOUNT", "GET", "crash")
-            if got == b":41\r\n":
+            got = c.execute_command("GCOUNT", "GET", "crash")
+            if got == 41:
                 break
             time.sleep(0.2)
-        assert got == b":41\r\n", got
-        assert cmd(s, "TLOG", "SIZE", "log") == b":1\r\n"
+        assert got == 41, got
+        assert c.execute_command("TLOG", "SIZE", "log") == 1
     finally:
-        proc.terminate()
-        proc.wait(timeout=60)
+        stop_node(proc)

@@ -14,29 +14,13 @@ growth).
 from __future__ import annotations
 
 import os
-import socket
-import subprocess
-import sys
 import time
 
 import pytest
 
-from jylis_tpu.client import Client
+from procutil import free_port, connect_client, spawn_node, stop_node
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-SPAWN = (
-    "import jax; jax.config.update('jax_platforms','cpu'); "
-    "import sys; from jylis_tpu.main import main; main(sys.argv[1:])"
-)
 SOAK_SECONDS = 30
-
-
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    p = s.getsockname()[1]
-    s.close()
-    return p
 
 
 def _rss_kb(pid: int) -> int:
@@ -47,24 +31,14 @@ def _rss_kb(pid: int) -> int:
 
 @pytest.mark.soak
 def test_thirty_second_mixed_churn_soak(tmp_path):
-    port, cport = _free_port(), _free_port()
+    port, cport = free_port(), free_port()
     data = str(tmp_path / "data")
-    proc = subprocess.Popen(
-        [sys.executable, "-c", SPAWN, "--port", str(port), "--addr",
-         f"127.0.0.1:{cport}:soaknode", "--data-dir", data,
-         "--snapshot-interval", "1", "--log-level", "warn"],
-        cwd=REPO,
+    proc = spawn_node(
+        port, cport, "soaknode",
+        "--data-dir", data, "--snapshot-interval", "1",
     )
     try:
-        c = None
-        deadline = time.time() + 120
-        while time.time() < deadline:
-            try:
-                c = Client("127.0.0.1", port, timeout=60)
-                break
-            except OSError:
-                time.sleep(0.3)
-        assert c, "node never came up"
+        c = connect_client(port, proc=proc)
 
         gcount = 0
         pn = 0
@@ -114,9 +88,4 @@ def test_thirty_second_mixed_churn_soak(tmp_path):
         metrics = c.execute_command("SYSTEM", "METRICS")
         assert any(line.startswith(b"TREG drains") for line in metrics)
     finally:
-        proc.terminate()
-        try:
-            proc.wait(timeout=60)
-        except subprocess.TimeoutExpired:
-            proc.kill()
-            proc.wait(timeout=10)
+        stop_node(proc)
