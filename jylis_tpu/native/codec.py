@@ -35,16 +35,38 @@ def _ptr(arr: np.ndarray):
 def _u64_array(values) -> np.ndarray | None:
     """Values as u64, or None if any falls outside [0, 2^64).
 
-    The range check is explicit (numpy 1.x silently wraps out-of-range
-    Python ints; relying on OverflowError is a numpy>=2 behavior) — an
-    out-of-range value must fall back to the oracle, which raises, rather
-    than broadcast a wrapped number to peers."""
+    Validation rides numpy's own dtype inference instead of a per-item
+    Python isinstance/range scan (which dominated the whole encode): a
+    list of in-range ints infers an integer dtype; anything else — a
+    float, a bool, a negative mixed with >=2^63, an int past 2^64
+    (object dtype) — infers a non-integer dtype and falls back to the
+    oracle, which raises on genuinely invalid values rather than
+    broadcasting a silently wrapped number to peers."""
+    if not len(values):
+        return np.empty(0, np.uint64)  # empty infers float64 below
     try:
-        if not all(isinstance(v, int) and 0 <= v <= _U64_MAX for v in values):
-            return None
-        return np.array(values, dtype=np.uint64)
+        arr = np.asarray(values)
     except (OverflowError, TypeError, ValueError):
         return None
+    if arr.dtype.kind == "u":
+        return arr.astype(np.uint64, copy=False)
+    if arr.dtype.kind == "i":
+        if arr.size and int(arr.min()) < 0:
+            return None
+        return arr.astype(np.uint64)
+    # mixed magnitudes (e.g. [1, 2**63]) infer float64 and ints past 2**64
+    # infer object — exactly like genuine floats do, so only here pay the
+    # per-item type scan, then let numpy's strict u64 conversion validate
+    # the range (bools and floats fall back to the oracle)
+    if all(type(v) is int and 0 <= v <= _U64_MAX for v in values):
+        # explicit range check: numpy 1.x silently wraps out-of-range ints
+        # on this conversion (pyproject now floors numpy>=2, but a wrapped
+        # value broadcast to peers is bad enough to guard twice)
+        try:
+            return np.array(values, dtype=np.uint64)
+        except (OverflowError, TypeError, ValueError):
+            return None
+    return None
 
 
 def _key_blob(batch) -> tuple[bytes, np.ndarray, np.ndarray]:
