@@ -20,6 +20,8 @@ import pytest
 
 from procutil import free_port, connect_client, spawn_node, stop_node
 
+from jylis_tpu.client import Client
+
 SOAK_SECONDS = 30
 
 
@@ -89,3 +91,82 @@ def test_thirty_second_mixed_churn_soak(tmp_path):
         assert any(line.startswith(b"TREG drains") for line in metrics)
     finally:
         stop_node(proc)
+
+
+@pytest.mark.soak
+def test_three_node_crash_drill(tmp_path):
+    """The resilience story end to end, with REAL processes: a 3-node
+    cluster takes writes; the seed node is SIGKILLed (no clean shutdown);
+    the survivors keep serving and converging; the seed restarts from its
+    ONLINE snapshot and bootstrap-syncs the writes it missed while dead;
+    every node converges on everything."""
+    import signal
+
+    ports = [free_port() for _ in range(3)]
+    cports = [free_port() for _ in range(3)]
+    names = ["drill-a", "drill-b", "drill-c"]
+    datas = [str(tmp_path / f"data{i}") for i in range(3)]
+    seed_addr = f"127.0.0.1:{cports[0]}:{names[0]}"
+
+    def boot(i):
+        extra = ["--data-dir", datas[i], "--snapshot-interval", "0.3",
+                 "--heartbeat-time", "0.2"]
+        if i > 0:
+            extra += ["--seed-addrs", seed_addr]
+        return spawn_node(ports[i], cports[i], names[i], *extra)
+
+    def until(fn, what, deadline_s=90):
+        deadline = time.time() + deadline_s
+        while time.time() < deadline:
+            try:
+                if fn():
+                    return
+            except Exception:
+                pass
+            time.sleep(0.25)
+        raise AssertionError(f"timed out waiting for {what}")
+
+    def read(port, *args):
+        with Client("127.0.0.1", port, timeout=30) as c:
+            return c.execute_command(*args)
+
+    procs = [boot(i) for i in range(3)]
+    try:
+        clients = [connect_client(p, proc=pr) for p, pr in zip(ports, procs)]
+        # phase 1: writes everywhere, cluster-wide convergence
+        for i, c in enumerate(clients):
+            assert c.execute_command("GCOUNT", "INC", "drill", i + 1) == b"OK"
+        for p in ports:
+            until(lambda p=p: read(p, "GCOUNT", "GET", "drill") == 6,
+                  f"phase-1 convergence on :{p}")
+        # wait until node 0's online snapshot cycles past these writes
+        snap0 = os.path.join(datas[0], "snapshot.jylis")
+        until(lambda: os.path.exists(snap0), "seed's online snapshot")
+        first = os.path.getmtime(snap0)
+        until(lambda: os.path.getmtime(snap0) != first, "snapshot cycle")
+
+        # phase 2: SIGKILL the seed mid-cluster; survivors keep serving
+        procs[0].send_signal(signal.SIGKILL)
+        procs[0].wait(timeout=30)
+        assert clients[1].execute_command("TLOG", "INS", "missed", "while-dead", 9) == b"OK"
+        assert clients[2].execute_command("GCOUNT", "INC", "drill", 10) == b"OK"
+        for p in ports[1:]:
+            until(lambda p=p: read(p, "GCOUNT", "GET", "drill") == 16,
+                  f"survivor convergence on :{p}")
+
+        # phase 3: the seed restarts — online snapshot restores its own
+        # pre-crash state, bootstrap sync fills in what it missed
+        procs[0] = boot(0)
+        c0 = connect_client(ports[0], proc=procs[0])
+        until(lambda: c0.execute_command("GCOUNT", "GET", "drill") == 16,
+              "restarted seed catches up the counter")
+        until(lambda: c0.execute_command("TLOG", "GET", "missed")
+              == [[b"while-dead", 9]], "restarted seed syncs the missed log")
+        # and the whole cluster still agrees
+        assert c0.execute_command("GCOUNT", "INC", "drill", 100) == b"OK"
+        for p in ports:
+            until(lambda p=p: read(p, "GCOUNT", "GET", "drill") == 116,
+                  f"final convergence on :{p}")
+    finally:
+        for pr in procs:
+            stop_node(pr)
