@@ -453,9 +453,11 @@ def config_ujson_32() -> dict:
         t0 = time.perf_counter()
         pay = _Pay()
         rid_cols: dict[int, int] = {}
-        shift = dev.plan_shift(deltas + replicas, n_rep=n_rep)
-        dbatch = dev.encode_docs(deltas, rid_cols, pay, n_rep=n_rep, shift=shift)
-        rbatch = dev.encode_docs(replicas, rid_cols, pay, n_rep=n_rep, shift=shift)
+        # the two batches must share one layout: the shared narrow-first
+        # policy encodes both, falling back to wide together
+        (dbatch, rbatch), shift = dev.encode_doc_lists_auto(
+            (deltas, replicas), rid_cols, pay, n_rep=n_rep
+        )
         joined = dev.fold_and_broadcast(rbatch, dbatch, shift=shift)
         import jax
 
@@ -551,9 +553,9 @@ def config_ujson_multikey() -> dict:
         t0 = time.perf_counter()
         pay = _Pay()
         rid_cols: dict[int, int] = {}
-        flat = [d for g in groups for d in g]
-        shift = dev.plan_shift(flat, n_rep=n_rep)
-        batch = dev.encode_doc_groups(groups, rid_cols, pay, n_rep=n_rep, shift=shift)
+        batch, shift = dev.encode_doc_groups_auto(
+            groups, rid_cols, pay, n_rep=n_rep
+        )
         folded = dev.fold_segments(batch, shift=shift)
         jax.block_until_ready(folded.dots)
         dt = time.perf_counter() - t0
@@ -566,11 +568,13 @@ def config_ujson_multikey() -> dict:
         t0 = time.perf_counter()
         pay = _Pay()
         rid_cols: dict[int, int] = {}
-        flat = [d for g in groups for d in g]
-        shift = dev.plan_shift(flat, n_rep=n_rep)
+        # same one-shift-for-the-whole-grid policy as the segmented path,
+        # so the comparison isolates dispatch batching alone
+        batches, shift = dev.encode_doc_lists_auto(
+            groups, rid_cols, pay, n_rep=n_rep
+        )
         last = None
-        for g in groups:
-            b = dev.encode_docs(g, rid_cols, pay, n_rep=n_rep, shift=shift)
+        for b in batches:
             last = dev.fold_deltas(b, shift=shift)
         jax.block_until_ready(last.dots)
         dt = time.perf_counter() - t0

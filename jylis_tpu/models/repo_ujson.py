@@ -72,6 +72,7 @@ class RepoUJSON:
         self._deltas: dict[bytes, UJSON] = {}
         self._pend: dict[bytes, list[UJSON]] = {}  # buffered remote deltas
         self._pend_total = 0  # deltas across keys, O(1) overdue check
+        self._shift_hint: int | None = None  # 32 once a drain went wide
         self._overdue = False  # some key's fan-in reached DEVICE_FANIN_MIN
 
     def _data_for(self, key: bytes) -> UJSON:
@@ -214,7 +215,6 @@ class RepoUJSON:
             rids.update(d.ctx.vv)
             rids.update(r for r, _ in d.ctx.cloud)
         n_rep = bucket(max(len(rids), 1), 4)
-        shift = dev.plan_shift(flat, n_rep)
         pays: dict[tuple, int] = {}
         rev: list[tuple] = []
 
@@ -226,7 +226,12 @@ class RepoUJSON:
             return pays[k]
 
         rid_cols: dict[int, int] = {}
-        batch = dev.encode_doc_groups(groups, rid_cols, pay_ids, n_rep, shift=shift)
+        batch, shift = dev.encode_doc_groups_auto(
+            groups, rid_cols, pay_ids, n_rep, prefer=self._shift_hint
+        )
+        # hysteresis: once a drain needed the wide layout, skip the doomed
+        # narrow attempt on subsequent drains (seqs only grow)
+        self._shift_hint = 32 if shift == 32 else None
         if self._mesh is not None:
             batch = shard_docbatch(self._mesh, batch)
         folded = dev.fold_segments(batch, shift=shift)

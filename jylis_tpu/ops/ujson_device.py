@@ -300,8 +300,7 @@ def plan_shift(docs, n_rep: int) -> int:
     column with an all-ones seq would pack to exactly PAD32 and vanish
     as padding.
     """
-    rid_bits = max(int(n_rep - 1).bit_length(), 1)
-    seq_bits = 31 - rid_bits
+    seq_bits = narrow_shift(n_rep)
     wide = (1 << seq_bits) - 1
     # per-container max() builtins instead of per-item Python compares:
     # this scan runs on every drain, right next to the encode hot loop
@@ -323,6 +322,62 @@ def _slot_cols(lens: np.ndarray) -> np.ndarray:
     total = int(lens.sum())
     starts = np.cumsum(lens) - lens
     return np.arange(total, dtype=np.int64) - np.repeat(starts, lens)
+
+
+def narrow_shift(n_rep: int) -> int:
+    """The int32 layout's shift for this replica-column budget."""
+    return 31 - max(int(n_rep - 1).bit_length(), 1)
+
+
+def _auto_shift_encode(encode_one, n_rep: int, prefer: int | None):
+    """Shared narrow-first/wide-fallback layout policy: encode at the
+    narrow int32 layout, falling back to u64/32 when any seq (or pad
+    collision) overflows mid-pass. The encode's own validity checks
+    subsume a separate `plan_shift` scan, which measured as ~30% of the
+    whole fan-in path; retrying is safe because rid_cols/pay_ids updates
+    are idempotent setdefaults. ``prefer=32`` skips the narrow attempt —
+    callers memoise it (e.g. the serving repo) so a steady-state wide
+    workload doesn't pay a doomed narrow pass on every drain."""
+    shift = 32 if prefer == 32 else narrow_shift(n_rep)
+    try:
+        return encode_one(shift), shift
+    except OverflowError:
+        if shift == 32:
+            raise  # genuinely un-encodable (caller falls back to host)
+        return encode_one(32), 32
+
+
+def encode_docs_auto(docs, rid_cols, pay_ids, n_rep, prefer=None):
+    """`encode_docs` under the narrow-first layout policy; returns
+    (batch, shift)."""
+    return _auto_shift_encode(
+        lambda sh: encode_docs(docs, rid_cols, pay_ids, n_rep, shift=sh),
+        n_rep,
+        prefer,
+    )
+
+
+def encode_doc_lists_auto(lists, rid_cols, pay_ids, n_rep, prefer=None):
+    """Several doc lists encoded under ONE shared layout (joins require
+    identical shifts); returns (batches, shift)."""
+    return _auto_shift_encode(
+        lambda sh: [
+            encode_docs(docs, rid_cols, pay_ids, n_rep, shift=sh)
+            for docs in lists
+        ],
+        n_rep,
+        prefer,
+    )
+
+
+def encode_doc_groups_auto(groups, rid_cols, pay_ids, n_rep, prefer=None):
+    """`encode_doc_groups` under the narrow-first layout policy; returns
+    (batch, shift)."""
+    return _auto_shift_encode(
+        lambda sh: encode_doc_groups(groups, rid_cols, pay_ids, n_rep, shift=sh),
+        n_rep,
+        prefer,
+    )
 
 
 def _encode_docs_np(
