@@ -22,7 +22,10 @@ ARG JAX_EXTRA="jax[cpu]"
 RUN pip install --no-cache-dir ${JAX_EXTRA} numpy
 WORKDIR /app
 COPY jylis_tpu/ jylis_tpu/
+COPY LICENSE .
 COPY --from=build /src/native/libjylis_native.so jylis_tpu/native/
+LABEL org.opencontainers.image.title="jylis-tpu" \
+      org.opencontainers.image.licenses="MPL-2.0"
 ENV JYLIS_NATIVE_SO=/app/jylis_tpu/native/libjylis_native.so
 # RESP port (same default as Redis and the reference) + cluster port
 EXPOSE 6379 9999

@@ -160,6 +160,9 @@ async def run(argv: list[str] | None = None) -> None:
 
     print(LOGO)
     log = config.log
+    from . import __version__
+
+    log.info() and log.i(f"jylis-tpu version: {__version__}")
     log.info() and log.i(f"cluster address: {config.addr}")
     log.info() and log.i(f"serving clients on port: {server.port}")
     await dispose.done.wait()

@@ -23,7 +23,8 @@ from .help import LeafHelp
 SYSTEM_HELP = LeafHelp(
     "The following are valid SYSTEM commands:\n"
     "  SYSTEM GETLOG [count]\n"
-    "  SYSTEM METRICS"
+    "  SYSTEM METRICS\n"
+    "  SYSTEM VERSION"
 )
 
 
@@ -62,6 +63,11 @@ class RepoSYSTEM:
             resp.array_start(len(lines))
             for line in lines:
                 resp.string(line)
+            return False
+        if op == b"VERSION":
+            from .. import __version__
+
+            resp.string(f"jylis-tpu {__version__}".encode())
             return False
         raise ParseError()
 

@@ -83,6 +83,11 @@ def config_from_cli(argv: list[str] | None = None, log_out=None) -> Config:
         "-L", "--log-level", default="info",
         help="Maximum level of detail for logging (error, warn, info, or debug).",
     )
+    from .. import __version__
+
+    parser.add_argument(
+        "--version", action="version", version=f"jylis-tpu {__version__}",
+    )
     args = parser.parse_args(argv)
     if args.snapshot_interval > 0 and not args.data_dir:
         parser.error("--snapshot-interval requires --data-dir")

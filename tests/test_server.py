@@ -123,3 +123,19 @@ def test_concurrent_clients():
         await server.dispose()
 
     asyncio.run(main())
+
+
+def test_system_version_over_wire():
+    async def main():
+        server, _ = make_server()
+        await server.start()
+        got = await send_recv(
+            server.port, b"*2\r\n$6\r\nSYSTEM\r\n$7\r\nVERSION\r\n"
+        )
+        import jylis_tpu as pkg
+
+        expect = f"jylis-tpu {pkg.__version__}".encode()
+        assert got == b"$%d\r\n%s\r\n" % (len(expect), expect)
+        await server.dispose()
+
+    asyncio.run(main())

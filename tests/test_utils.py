@@ -95,3 +95,20 @@ def test_log_levels_and_dual_sink():
     text = "".join(lines)
     assert "(W) careful" in text and "(E) bad" in text and "never" not in text
     assert sys_lines == ["(W) careful", "(E) bad"]
+
+
+def test_config_version_flag_exits():
+    import jylis_tpu as pkg
+    import io
+    import contextlib
+
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        try:
+            config_from_cli(["--version"])
+            raised = False
+        except SystemExit as e:
+            raised = True
+            assert e.code == 0
+    assert raised
+    assert pkg.__version__ in out.getvalue()
