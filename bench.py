@@ -411,76 +411,61 @@ def config_tlog_trim() -> dict:
 def config_ujson_32() -> dict:
     """Config 5: UJSON concurrent field edits across 32 replicas
     (repo_ujson.pony) — field-edit merges/sec with full convergence
-    checking. Device path (ops/ujson_device): the join is associative, so
-    the N deltas fold pairwise in log2(N) batched device calls and the
-    folded delta joins all replicas in ONE batched call — vs the host
-    oracle (the baseline) converging every delta into every replica
-    sequentially, which is the reference's loop shape
-    (repo_ujson.pony:96-110). Timed region includes the host->device
-    encode; convergence of the result is asserted outside it."""
-    from jylis_tpu.ops import ujson_device as dev
+    checking, over a multi-ROUND anti-entropy stream. Device path
+    (ops/ujson_resident): the 32 replica documents are admitted to the
+    device-resident store ONCE (inside the timed region — it amortises
+    across rounds, which is the point of residency), then every round
+    encodes ONLY that round's deltas and folds+joins them into every
+    resident row in one dispatch. The host baseline is the reference's
+    loop shape (repo_ujson.pony:96-110): every replica converges every
+    delta, every round. Round 3 re-encoded all 32 replica documents
+    host->device EVERY round (the admitted bottleneck, VERDICT round 3);
+    the resident store never touches them again after admission."""
     from jylis_tpu.ops.ujson_host import UJSON
+    from jylis_tpu.ops.ujson_resident import ResidentStore
 
-    n_rep, edits = 32, 40
+    n_rep, edits, rounds = 32, 40, 8
 
     def make_workload():
         replicas = [UJSON() for _ in range(n_rep)]
-        deltas = []
-        for r, doc in enumerate(replicas):
-            for e in range(edits):
-                d = UJSON()
-                doc.set_doc(r, (f"field{e % 8}",), str(r * 1000 + e), delta=d)
-                deltas.append(d)
-        return replicas, deltas
-
-    class _Pay:
-        def __init__(self):
-            self.ids = {}
-            self.rev = []
-
-        def __call__(self, path, token):
-            key = (path, token)
-            if key not in self.ids:
-                self.ids[key] = len(self.rev)
-                self.rev.append(key)
-            return self.ids[key]
-
-        def lookup(self, pid):
-            return self.rev[pid]
+        streams = []
+        for rnd in range(rounds):
+            deltas = []
+            for r, doc in enumerate(replicas):
+                for e in range(edits):
+                    d = UJSON()
+                    doc.set_doc(
+                        r, (f"field{e % 8}",), str(rnd * 100000 + r * 1000 + e),
+                        delta=d,
+                    )
+                    deltas.append(d)
+            streams.append(deltas)
+        return [UJSON() for _ in range(n_rep)], streams
 
     def device_once():
-        replicas, deltas = make_workload()
+        replicas, streams = make_workload()
         t0 = time.perf_counter()
-        pay = _Pay()
-        rid_cols: dict[int, int] = {}
-        # the two batches must share one layout: the shared narrow-first
-        # policy encodes both, falling back to wide together
-        (dbatch, rbatch), shift = dev.encode_doc_lists_auto(
-            (deltas, replicas), rid_cols, pay, n_rep=n_rep
-        )
-        joined = dev.fold_and_broadcast(rbatch, dbatch, shift=shift)
-        import jax
-
-        jax.block_until_ready(joined.dots)
+        store = ResidentStore(n_rep=n_rep)
+        store.admit([(b"rep%02d" % i, r) for i, r in enumerate(replicas)])
+        for deltas in streams:
+            store.fold_in_broadcast(deltas)
+        store.block()
         dt = time.perf_counter() - t0
-        cols_rid = {c: r for r, c in rid_cols.items()}
-        renders = {
-            doc.render()
-            for doc in dev.decode_batch(joined, cols_rid, pay.lookup, shift=shift)
-        }
+        renders = {doc.render() for _, doc in store.dump()}
         assert len(renders) == 1, "replicas diverged"
-        return n_rep * len(deltas), dt
+        return n_rep * sum(len(s) for s in streams), dt
 
     def host_once():
-        replicas, deltas = make_workload()
+        replicas, streams = make_workload()
         t0 = time.perf_counter()
-        for doc in replicas:
-            for d in deltas:
-                doc.converge(d)
+        for deltas in streams:
+            for doc in replicas:
+                for d in deltas:
+                    doc.converge(d)
         dt = time.perf_counter() - t0
         renders = {doc.render() for doc in replicas}
         assert len(renders) == 1, "replicas diverged"
-        return n_rep * len(deltas), dt
+        return n_rep * sum(len(s) for s in streams), dt
 
     device_once()  # compile warmup
     rate = _median_rate(device_once)
@@ -494,37 +479,68 @@ def config_ujson_32() -> dict:
 
 
 def config_ujson_multikey() -> dict:
-    """Config 5b: segmented multi-key UJSON fan-in (ops/ujson_device.
-    fold_segments) — K keys' delta fan-ins folded in ONE dispatch vs the
-    round-2 shape (one fold dispatch per key) and vs the host loop (the
-    reference's converge shape, repo_ujson.pony:96-110). Over a tunneled
-    chip dispatch latency dominates, so sharing the launch across keys is
-    where the win lives. Timed region includes the host->device encode;
-    results are verified against the host oracle outside it."""
-    import jax
-
+    """Config 5b: multi-key UJSON anti-entropy with device-RESIDENT
+    documents (ops/ujson_resident) — K keys receive a deep fan-in as a
+    stream of ROUNDS drains. Every drain encodes only that round's
+    deltas (O(new deltas)) and folds them into the resident rows in ONE
+    dispatch; the accumulated documents are never re-encoded or
+    host-walked. Baselines: the host loop (the reference's converge
+    shape, repo_ujson.pony:96-110 — O(doc) per delta, so O(D^2) per key
+    over the stream) and the round-3 non-resident shape (re-encode +
+    fold_segments + decode + host-converge per round,
+    `vs_reencode`). Results are verified against the host oracle
+    outside the timed region."""
     from jylis_tpu.ops import ujson_device as dev
     from jylis_tpu.ops.ujson_host import UJSON
+    from jylis_tpu.ops.ujson_resident import ResidentStore
 
-    n_keys, fanin, n_rep = 64, 512, 8
+    n_keys, fanin, n_rep, rounds = 64, 64, 8, 8
 
     def make_workload():
         # distinct INS values: the doc grows with the fan-in, so the host
         # loop's per-delta full-doc scan (ujson_host.converge) is O(D^2)
-        # per key while the device encode stays O(D) — the shape deep
-        # anti-entropy fan-ins actually have
-        groups = []
-        for k in range(n_keys):
-            doc = UJSON()
-            g = []
-            for e in range(fanin):
-                d = UJSON()
-                doc.ins(
-                    100 + (e % n_rep), ("tags",), str(k * 10000 + e), delta=d
-                )
-                g.append(d)
-            groups.append(g)
-        return groups
+        # per key while the device delta encode stays O(D) — the shape
+        # deep anti-entropy fan-ins actually have
+        streams = []
+        docs = [UJSON() for _ in range(n_keys)]
+        for rnd in range(rounds):
+            groups = []
+            for k, doc in enumerate(docs):
+                g = []
+                for e in range(fanin):
+                    d = UJSON()
+                    doc.ins(
+                        100 + (e % n_rep), ("tags",),
+                        str(k * 100000 + rnd * 1000 + e), delta=d,
+                    )
+                    g.append(d)
+                groups.append(g)
+            streams.append(groups)
+        return streams
+
+    keys = [b"doc%03d" % k for k in range(n_keys)]
+    total = n_keys * fanin * rounds
+
+    def verify_store(store, streams):
+        docs = store.read_many(keys)  # one batched pull, not one per key
+        for k, got in enumerate(docs):
+            want = UJSON()
+            for groups in streams:
+                for d in groups[k]:
+                    want.converge(d)
+            assert got.render() == want.render(), "fold diverged from oracle"
+
+    def resident_once():
+        streams = make_workload()
+        t0 = time.perf_counter()
+        store = ResidentStore(n_rep=n_rep)
+        store.admit([(key, UJSON()) for key in keys])
+        for groups in streams:
+            store.fold_in(dict(zip(keys, groups)))
+        store.block()
+        dt = time.perf_counter() - t0
+        verify_store(store, streams)
+        return total, dt
 
     class _Pay:
         def __init__(self):
@@ -541,66 +557,50 @@ def config_ujson_multikey() -> dict:
         def lookup(self, pid):
             return self.rev[pid]
 
-    def verify(folded_docs, groups):
-        for got, g in zip(folded_docs, groups):
-            want = UJSON()
-            for d in g:
-                want.converge(d)
-            assert got.render() == want.render(), "fold diverged from oracle"
-
-    def seg_once():
-        groups = make_workload()
+    def reencode_once():
+        # the round-3 drain shape: per round, encode the round's deltas,
+        # fold them on device, pull the folded deltas back and
+        # host-converge them into the accumulated host docs
+        streams = make_workload()
         t0 = time.perf_counter()
+        docs = [UJSON() for _ in range(n_keys)]
         pay = _Pay()
         rid_cols: dict[int, int] = {}
-        batch, shift = dev.encode_doc_groups_auto(
-            groups, rid_cols, pay, n_rep=n_rep
-        )
-        folded = dev.fold_segments(batch, shift=shift)
-        jax.block_until_ready(folded.dots)
+        for groups in streams:
+            batch, shift = dev.encode_doc_groups_auto(
+                groups, rid_cols, pay, n_rep=n_rep
+            )
+            folded = dev.fold_segments(batch, shift=shift)
+            cols_rid = {c: r for r, c in rid_cols.items()}
+            for doc, delta in zip(
+                docs, dev.decode_batch(folded, cols_rid, pay.lookup, shift=shift)
+            ):
+                doc.converge(delta)
         dt = time.perf_counter() - t0
-        cols_rid = {c: r for r, c in rid_cols.items()}
-        verify(dev.decode_batch(folded, cols_rid, pay.lookup, shift=shift), groups)
-        return n_keys * fanin, dt
-
-    def perkey_once():
-        groups = make_workload()
-        t0 = time.perf_counter()
-        pay = _Pay()
-        rid_cols: dict[int, int] = {}
-        # same one-shift-for-the-whole-grid policy as the segmented path,
-        # so the comparison isolates dispatch batching alone
-        batches, shift = dev.encode_doc_lists_auto(
-            groups, rid_cols, pay, n_rep=n_rep
-        )
-        last = None
-        for b in batches:
-            last = dev.fold_deltas(b, shift=shift)
-        jax.block_until_ready(last.dots)
-        dt = time.perf_counter() - t0
-        return n_keys * fanin, dt
+        return total, dt
 
     def host_once():
-        groups = make_workload()
+        streams = make_workload()
         t0 = time.perf_counter()
-        for g in groups:
-            doc = UJSON()
-            for d in g:
-                doc.converge(d)
+        docs = [UJSON() for _ in range(n_keys)]
+        for groups in streams:
+            for doc, g in zip(docs, groups):
+                for d in g:
+                    doc.converge(d)
         dt = time.perf_counter() - t0
-        return n_keys * fanin, dt
+        return total, dt
 
-    seg_once()  # compile warmup
-    perkey_once()
-    seg = _median_rate(seg_once)
-    perkey = _median_rate(perkey_once)
+    resident_once()  # compile warmup
+    reencode_once()
+    rate = _median_rate(resident_once)
+    reenc = _median_rate(reencode_once)
     host = _median_rate(host_once, CPU_RUNS)
     return {
-        "metric": "UJSON 64-key x 512-delta segmented fan-in (config 5b)",
-        "value": round(seg, 1),
+        "metric": "UJSON 64-key x 8x64-delta resident fan-in (config 5b)",
+        "value": round(rate, 1),
         "unit": "delta merges/sec",
-        "vs_baseline": round(seg / host, 2),
-        "vs_perkey_dispatches": round(seg / perkey, 2),
+        "vs_baseline": round(rate / host, 2),
+        "vs_reencode": round(rate / reenc, 2),
     }
 
 

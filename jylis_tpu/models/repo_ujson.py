@@ -1,19 +1,31 @@
-"""UJSON repo: causal-document keyspace, host-served with a device fan-in.
+"""UJSON repo: causal-document keyspace with device-RESIDENT hot keys.
 
 Reference analog: repo_ujson.pony:14-110. Variadic argument shape: the
 first arg is the database key, the LAST arg is the value/document (for
 SET/INS/RM), and everything between is a path of nested-map keys
 (repo_ujson.pony:45-49). GET/CLR take key + optional path only.
 
-Authoritative state lives on host (ops/ujson_host.py explains why);
-incoming anti-entropy deltas buffer per key — bounded by drain_overdue
-thresholds like every device-backed repo — and converge at drain time.
-A full drain folds EVERY key whose fan-in earns device work in ONE
-segmented dispatch (ops/ujson_device.fold_segments, the (K, D, W)
-log-depth associative fold; keys-sharded over the serving mesh when one
-is active), then host-converges one folded delta per key. Small fan-ins
-stay on the host loop, which beats a device round-trip at small sizes
-(measured crossover: bench.py --config ujson-multikey).
+Every key is in exactly ONE of two modes:
+
+* host mode (``_data``): the authoritative doc is a host ``UJSON``
+  (ops/ujson_host.py). Keys are born here; local writes always happen
+  here. This is the reference's shape.
+* device mode (``_res``): the doc lives as a packed row in the
+  device-resident store (ops/ujson_resident.ResidentStore). A key is
+  promoted the first time its anti-entropy fan-in earns device work, and
+  from then on drains encode ONLY the new deltas and fold them into the
+  resident row on device — the full document is never re-encoded or
+  host-walked again (the round-3 bottleneck, and the reference's
+  per-delta full-doc converge loop, repo_ujson.pony:96-110).
+
+Reads on device-mode keys decode lazily and cache; the cache invalidates
+per key when a fold touches the key. Local writes demote the key back to
+host mode first (observed-remove mutators need the current doc anyway),
+so write-hot keys simply stay in the reference's host shape while
+anti-entropy-hot keys stay resident.
+
+Seqs past u32 exceed every device layout; those keys fall back to host
+mode permanently (same contract as round 3).
 
 Delta wire shape: the UJSON object itself (entries + causal context).
 """
@@ -24,21 +36,27 @@ from ..ops.ujson_host import UJSON
 from .base import ParseError, need
 from .help import RepoHelp
 
-# pending deltas per key at which a SINGLE key's fold moves to the
-# device: below this the host loop wins against an unshared dispatch
-# round-trip
+# pending deltas per key at which a SINGLE non-resident key's drain moves
+# to the device (and the key becomes resident): below this the host loop
+# wins against an unshared dispatch round-trip
 DEVICE_FANIN_MIN = 256
 # per-key fan-in worth joining a SEGMENTED drain: when many keys drain
 # together the dispatch is shared, so smaller fan-ins than
-# DEVICE_FANIN_MIN pay for their slice of the launch (one (K, D, W)
-# fold_segments call for all of them). Measured crossover vs the host
-# loop on single-entry deltas: ~64-128 per key (bench.py --config
-# ujson-multikey; the host fold is O(D^2) per key, encode is O(D))
+# DEVICE_FANIN_MIN pay for their slice of the launch. Measured crossover
+# vs the host loop on single-entry deltas: ~64-128 per key (bench.py
+# --config ujson-multikey; the host fold is O(D^2) per key, the delta
+# encode is O(D))
 SEG_FANIN_MIN = 64
 # buffered remote deltas across all keys before the converge path forces
 # a drain: bounds host memory for write-hot, never-read keys the same way
 # TLOG's PENDING_DRAIN_THRESHOLD does (repo_tlog.py:41)
 PENDING_TOTAL_MAX = 4096
+# a GET-path drain on a RESIDENT key with fewer pending deltas than this
+# serves them host-side into the read cache instead of dispatching a
+# device fold: the lattice join is idempotent, so the deltas stay pending
+# and fold for real at the next full drain — a read-heavy key with a
+# delta trickle never pays a device round trip per GET
+TRICKLE_MAX = 16
 
 UJSON_HELP = RepoHelp(
     "UJSON",
@@ -64,16 +82,67 @@ class RepoUJSON:
         from ..parallel import serving_mesh
 
         self._identity = identity
-        # mesh mode: the segmented drain's key axis shards over the
-        # serving mesh (parallel.shard_docbatch) — the fold runs SPMD
-        # with zero collectives, like every plane-backed type
+        # mesh mode: the resident store's row axis shards over the
+        # serving mesh and drains use the row-aligned fold — SPMD with
+        # zero collectives, like every plane-backed type
         self._mesh = serving_mesh() if mesh == "auto" else mesh
         self._data: dict[bytes, UJSON] = {}
         self._deltas: dict[bytes, UJSON] = {}
         self._pend: dict[bytes, list[UJSON]] = {}  # buffered remote deltas
         self._pend_total = 0  # deltas across keys, O(1) overdue check
-        self._shift_hint: int | None = None  # 32 once a drain went wide
         self._overdue = False  # some key's fan-in reached DEVICE_FANIN_MIN
+        self._res = None  # ResidentStore, created on first promotion
+        self._res_cache: dict[bytes, UJSON] = {}  # decoded device-mode docs
+        # pending deltas already host-converged into the cached view
+        # (the GET-path trickle), so repeat reads don't re-walk the doc
+        self._res_applied: dict[bytes, int] = {}
+        self._host_only: set[bytes] = set()  # seqs past u32: never promote
+
+    # -- mode plumbing -------------------------------------------------------
+
+    def _store(self):
+        if self._res is None:
+            from ..ops.ujson_resident import ResidentStore
+
+            shard_fn = None
+            if self._mesh is not None:
+                from ..parallel import shard_docbatch
+
+                mesh = self._mesh
+                shard_fn = lambda b: shard_docbatch(mesh, b)  # noqa: E731
+            self._res = ResidentStore(mesh=self._mesh, shard_fn=shard_fn)
+        return self._res
+
+    def _is_resident(self, key: bytes) -> bool:
+        return self._res is not None and key in self._res
+
+    def _view(self, key: bytes) -> UJSON | None:
+        """The current doc for reading: host doc, or the resident row
+        decoded through the per-key cache."""
+        doc = self._data.get(key)
+        if doc is not None:
+            return doc
+        if self._is_resident(key):
+            doc = self._res_cache.get(key)
+            if doc is None:
+                doc = self._res.read(key)
+                self._res_cache[key] = doc
+            return doc
+        return None
+
+    def _demote(self, key: bytes) -> None:
+        """Move a device-mode key back to host mode (before any local
+        write: observed-remove mutators walk the doc, and host mode is
+        where local delta accumulation lives)."""
+        if not self._is_resident(key):
+            return
+        doc = self._res_cache.pop(key, None)
+        self._res_applied.pop(key, None)
+        if doc is not None:
+            self._res.discard(key)
+        else:
+            doc = self._res.evict(key)
+        self._data[key] = doc
 
     def _data_for(self, key: bytes) -> UJSON:
         d = self._data.get(key)
@@ -99,12 +168,13 @@ class RepoUJSON:
             key = need(args, 1)
             self._drain_key(key)
             path = _decode_path(args[2:])
-            doc = self._data.get(key)
+            doc = self._view(key)
             resp.string(doc.render(path) if doc is not None else "")
             return False
         if op == b"SET":
             key, path, value = self._path_and_value(args)
             self._drain_key(key)  # SET clears OBSERVED dots: observe first
+            self._demote(key)
             try:
                 self._data_for(key).set_doc(
                     self._identity, path, value, self._delta_for(key)
@@ -116,6 +186,7 @@ class RepoUJSON:
         if op == b"CLR":
             key = need(args, 1)
             self._drain_key(key)  # observed-remove: observe first
+            self._demote(key)
             path = _decode_path(args[2:])
             doc = self._data.get(key)
             if doc is not None:
@@ -124,6 +195,7 @@ class RepoUJSON:
             return True
         if op == b"INS":
             key, path, value = self._path_and_value(args)
+            self._demote(key)
             try:
                 self._data_for(key).ins(
                     self._identity, path, value, self._delta_for(key)
@@ -135,6 +207,7 @@ class RepoUJSON:
         if op == b"RM":
             key, path, value = self._path_and_value(args)
             self._drain_key(key)  # observed-remove: observe first
+            self._demote(key)
             doc = self._data.get(key)
             try:
                 if doc is not None:
@@ -164,90 +237,137 @@ class RepoUJSON:
         stays bounded like every other type."""
         return self._overdue or self._pend_total >= PENDING_TOTAL_MAX
 
-    may_drain_OPS = (b"GET", b"SET", b"CLR", b"RM")
+    # INS included: it never drains, but on a resident key it demotes —
+    # which can decode (a blocking device pull) and must not run on the
+    # event loop
+    may_drain_OPS = (b"GET", b"SET", b"CLR", b"RM", b"INS")
 
     def may_drain(self, args: list[bytes]) -> bool:
-        """A command that observes a key with a device-sized pending
-        fan-in dispatches; the server offloads it to a thread
-        (manager.apply_async)."""
-        return (
-            len(args) >= 2
-            and args[0] in self.may_drain_OPS
-            and len(self._pend.get(args[1], ())) >= DEVICE_FANIN_MIN
-        )
+        """Commands that will touch the device get offloaded to a thread
+        (manager.apply_async): a device-sized pending fan-in, a resident
+        key whose pending exceeds the trickle budget (the drain folds on
+        device), or a resident read/demotion that must decode (cache
+        miss). A trickle on a warm cache stays on the loop — the drain
+        serves it host-side in microseconds."""
+        if len(args) < 2 or args[0] not in self.may_drain_OPS:
+            return False
+        key = args[1]
+        if len(self._pend.get(key, ())) >= DEVICE_FANIN_MIN:
+            return True
+        if self._is_resident(key):
+            return (
+                len(self._pend.get(key, ())) > TRICKLE_MAX
+                or key not in self._res_cache
+            )
+        return False
 
     def _drain_key(self, key: bytes) -> None:
-        deltas = self._pend.pop(key, None)
+        deltas = self._pend.get(key)
         if not deltas:
             return
-        self._pend_total -= len(deltas)
-        doc = self._data_for(key)
-        if len(deltas) >= DEVICE_FANIN_MIN:
-            try:
-                doc.converge(self._device_fold_keys([deltas])[0])
+        if self._is_resident(key):
+            if len(deltas) <= TRICKLE_MAX:
+                # read-path trickle: converge into the cached view on the
+                # host (idempotent join — the deltas stay pending for the
+                # next full drain's device fold); _res_applied tracks how
+                # many this cache already absorbed, so repeat reads don't
+                # re-walk the doc per pending delta
+                doc = self._res_cache.get(key)
+                if doc is None:
+                    doc = self._res.read(key)
+                    self._res_cache[key] = doc
+                    self._res_applied.pop(key, None)
+                for d in deltas[self._res_applied.get(key, 0):]:
+                    doc.converge(d)
+                self._res_applied[key] = len(deltas)
                 return
-            except OverflowError:
-                # seqs beyond the device layouts (u32 planes): the host
-                # lattice handles unbounded ints — fall through
-                pass
+            self._pend.pop(key)
+            self._pend_total -= len(deltas)
+            rest = self._resident_fold({key: deltas})
+            if not rest:
+                return
+            deltas = rest[key]
+        elif len(deltas) >= DEVICE_FANIN_MIN and key not in self._host_only:
+            self._pend.pop(key)
+            self._pend_total -= len(deltas)
+            rest = self._resident_fold({key: deltas})
+            if not rest:
+                return
+            deltas = rest[key]
+        else:
+            self._pend.pop(key)
+            self._pend_total -= len(deltas)
+        doc = self._data_for(key)
         for d in deltas:
             doc.converge(d)
 
-    def _device_fold_keys(self, groups: list[list[UJSON]]) -> list[UJSON]:
-        """Fold K keys' fan-ins on the TPU in ONE dispatch (segmented
-        fold, one layout spanning every group); in mesh mode the key
-        axis is sharded across the serving mesh."""
-        from ..ops import ujson_device as dev
-        from ..parallel import shard_docbatch
-        from ..utils.batching import bucket
+    def _resident_fold(self, groups: dict[bytes, list[UJSON]]):
+        """Promote keys as needed and fold their pending deltas into the
+        resident rows — ONE device dispatch for every key in the drain.
+        Returns the groups that must fall back to the host loop (seqs
+        beyond the u64/32 device layouts)."""
+        store = self._store()
+        fallback: dict[bytes, list[UJSON]] = {}
 
-        n_keys = len(groups)
-        # bucket the key axis (and round to the mesh's keys axis): every
-        # distinct K would otherwise be a fresh XLA compile of the fold
-        target = bucket(max(n_keys, 1), 1)
-        if self._mesh is not None:
-            target += -target % self._mesh.devices.size
-        groups = groups + [[] for _ in range(target - n_keys)]
-        flat = [d for g in groups for d in g]
-        rids: set[int] = set()
-        for d in flat:
-            rids.update(r for r, _ in d.entries)
-            rids.update(d.ctx.vv)
-            rids.update(r for r, _ in d.ctx.cloud)
-        n_rep = bucket(max(len(rids), 1), 4)
-        pays: dict[tuple, int] = {}
-        rev: list[tuple] = []
+        to_admit = [k for k in groups if k not in store]
+        if to_admit and store.full():
+            # HBM admission gate (ResidentStore.BYTE_BUDGET): further
+            # keys serve from the host lattice; resident keys keep their
+            # rows
+            for k in to_admit:
+                fallback[k] = groups[k]
+            to_admit = []
+        if to_admit:
+            items = [(k, self._data.get(k) or UJSON()) for k in to_admit]
+            try:
+                store.admit(items)
+            except OverflowError:
+                # isolate the un-encodable docs; the rest still promote
+                items, bulk = [], items
+                for k, d in bulk:
+                    try:
+                        store.admit([(k, d)])
+                    except OverflowError:
+                        self._host_only.add(k)
+                        fallback[k] = groups[k]
+                        continue
+                    items.append((k, d))
+            for k, d in items:
+                self._data.pop(k, None)
+                self._res_cache[k] = d  # row state == this doc, cache it
 
-        def pay_ids(path, token):
-            k = (path, token)
-            if k not in pays:
-                pays[k] = len(rev)
-                rev.append(k)
-            return pays[k]
-
-        rid_cols: dict[int, int] = {}
-        batch, shift = dev.encode_doc_groups_auto(
-            groups, rid_cols, pay_ids, n_rep, prefer=self._shift_hint
-        )
-        # hysteresis: once a drain needed the wide layout, skip the doomed
-        # narrow attempt on subsequent drains (seqs only grow)
-        self._shift_hint = 32 if shift == 32 else None
-        if self._mesh is not None:
-            batch = shard_docbatch(self._mesh, batch)
-        folded = dev.fold_segments(batch, shift=shift)
-        cols_rid = {c: r for r, c in rid_cols.items()}
-        docs = dev.decode_batch(folded, cols_rid, rev.__getitem__, shift=shift)
-        return docs[:n_keys]
+        fold = {k: v for k, v in groups.items() if k not in fallback}
+        try:
+            store.fold_in(fold)
+        except OverflowError:
+            for k, v in fold.items():
+                try:
+                    store.fold_in({k: v})
+                except OverflowError:
+                    self._demote(k)
+                    self._host_only.add(k)
+                    fallback[k] = v
+                else:
+                    self._res_cache.pop(k, None)
+                    self._res_applied.pop(k, None)
+        else:
+            for k in fold:
+                self._res_cache.pop(k, None)
+                self._res_applied.pop(k, None)
+        return fallback
 
     # -- snapshot (persist.py): full state in the wire-delta shape ----------
 
     def dump_state(self):
         self.drain()
+        docs = dict(self._data)
+        if self._res is not None:
+            docs.update(self._res.dump())
         # keep docs whose causal context is non-trivial even when empty of
         # entries: the tombstone knowledge is what makes removals stick
         return [
             (key, doc)
-            for key, doc in sorted(self._data.items())
+            for key, doc in sorted(docs.items())
             if doc.entries or doc.ctx.vv or doc.ctx.cloud
         ]
 
@@ -264,26 +384,32 @@ class RepoUJSON:
         return out
 
     def drain(self) -> None:
-        # segmented device pass first: every key whose fan-in earns a
-        # slice of a shared launch folds in ONE dispatch; what remains
-        # (small fan-ins, or everything on layout overflow) host-loops
-        big = [
-            k for k, lst in self._pend.items() if len(lst) >= SEG_FANIN_MIN
-        ]
-        # SEG_FANIN_MIN only pays when the dispatch is SHARED: a lone key
-        # below the single-dispatch crossover stays on the host loop
-        if len(big) == 1 and len(self._pend[big[0]]) < DEVICE_FANIN_MIN:
-            big = []
-        if big:
-            try:
-                folded = self._device_fold_keys([self._pend[k] for k in big])
-            except OverflowError:
-                pass  # host lattice handles unbounded ints below
-            else:
-                for key, delta in zip(big, folded):
-                    deltas = self._pend.pop(key)
-                    self._pend_total -= len(deltas)
-                    self._data_for(key).converge(delta)
+        # device pass first: every resident key with pending, plus every
+        # key whose fan-in earns a slice of a shared launch, folds in ONE
+        # dispatch; what remains (small fan-ins on host-mode keys, or
+        # everything on layout overflow) host-loops
+        groups = {
+            k: lst
+            for k, lst in self._pend.items()
+            if k not in self._host_only
+            and (self._is_resident(k) or len(lst) >= SEG_FANIN_MIN)
+        }
+        # SEG_FANIN_MIN only pays when the dispatch is SHARED: a lone
+        # non-resident key below the single-dispatch crossover stays on
+        # the host loop
+        if len(groups) == 1:
+            k = next(iter(groups))
+            if not self._is_resident(k) and len(groups[k]) < DEVICE_FANIN_MIN:
+                groups = {}
+        if groups:
+            for k in groups:
+                self._pend.pop(k)
+            self._pend_total -= sum(len(v) for v in groups.values())
+            fallback = self._resident_fold(groups)
+            for k, lst in fallback.items():
+                doc = self._data_for(k)
+                for d in lst:
+                    doc.converge(d)
         for key in list(self._pend):
             self._drain_key(key)
         self._overdue = False

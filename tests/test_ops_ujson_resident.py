@@ -1,0 +1,312 @@
+"""Differential tests for the device-resident UJSON store: resident rows
+folded across many epochs must match the host oracle converging the same
+deltas, through promotions, demotions, layout migrations (narrow repack
+and u64 widening), capacity growth, and width re-bucketing."""
+
+import numpy as np
+import pytest
+
+import jylis_tpu  # noqa: F401
+from jylis_tpu.ops import ujson_resident as res
+from jylis_tpu.ops.ujson_host import UJSON
+
+from test_ops_ujson_device import assert_same_doc, copy_doc, random_mutations
+
+
+def make_deltas(rng, doc, replica, n):
+    out = []
+    for _ in range(n):
+        d = UJSON()
+        random_mutations(rng, doc, replica=replica, n_ops=1, delta=d)
+        out.append(d)
+    return out
+
+
+def test_resident_epochs_match_host_oracle():
+    """Many fold epochs into resident rows == sequential host convergence,
+    with reads interleaved (cache-free store-level reads)."""
+    rng = np.random.default_rng(3)
+    store = res.ResidentStore()
+    keys = [b"a", b"b", b"c"]
+    oracle = {k: UJSON() for k in keys}
+    writers = {k: UJSON() for k in keys}
+
+    store.admit([(k, copy_doc(oracle[k])) for k in keys])
+    for epoch in range(6):
+        pending = {}
+        for i, k in enumerate(keys):
+            deltas = make_deltas(rng, writers[k], replica=10 + i, n=4)
+            pending[k] = deltas
+            for d in deltas:
+                oracle[k].converge(d)
+        store.fold_in(pending)
+        if epoch % 2:
+            k = keys[epoch % len(keys)]
+            assert_same_doc(store.read(k), oracle[k])
+    for k in keys:
+        assert_same_doc(store.read(k), oracle[k])
+
+
+def test_resident_subset_fold_with_scratch_padding():
+    """A drain touching a strict subset of many resident keys uses the
+    subset fold (scratch-row padded); untouched rows must be unchanged."""
+    rng = np.random.default_rng(5)
+    store = res.ResidentStore()
+    keys = [b"k%d" % i for i in range(9)]
+    oracle = {}
+    items = []
+    for i, k in enumerate(keys):
+        doc = UJSON()
+        random_mutations(rng, doc, replica=i + 1, n_ops=3)
+        oracle[k] = doc
+        items.append((k, copy_doc(doc)))
+    store.admit(items)
+    # touch only two of nine keys -> subset path (2 <= 9//2)
+    w = {k: copy_doc(oracle[k]) for k in (b"k1", b"k7")}
+    pending = {}
+    for k, doc in w.items():
+        pending[k] = make_deltas(rng, doc, replica=50, n=3)
+        for d in pending[k]:
+            oracle[k].converge(d)
+    store.fold_in(pending)
+    for k in keys:
+        assert_same_doc(store.read(k), oracle[k])
+
+
+def test_resident_narrow_repack_on_replica_growth():
+    """Adding replicas past the narrow column budget repacks resident
+    rows at a smaller shift on device (seqs still fit); state survives."""
+    rng = np.random.default_rng(7)
+    store = res.ResidentStore(n_rep=4)
+    doc = UJSON()
+    writer = UJSON()
+    store.admit([(b"k", copy_doc(doc))])
+    # 12 distinct replicas > the 4-rep narrow budget
+    for r in range(12):
+        deltas = make_deltas(rng, writer, replica=100 + r, n=2)
+        for d in deltas:
+            doc.converge(d)
+        store.fold_in({b"k": deltas})
+    assert store._shift not in (32, None) and store._shift < 29
+    assert_same_doc(store.read(b"k"), doc)
+
+
+def test_resident_widen_to_u64_on_big_seq():
+    """A delta with a seq past the narrow budget (but under u32) widens
+    resident rows to the u64/32 layout in place."""
+    store = res.ResidentStore(n_rep=4)
+    a = UJSON()
+    store.admit([(b"k", copy_doc(a))])
+    small = UJSON()
+    d1 = UJSON()
+    small.ins(1, ("x",), "1", delta=d1)
+    store.fold_in({b"k": [d1]})
+    a.converge(d1)
+    assert store._shift != 32
+
+    big = UJSON()
+    big.ctx.vv[2] = 1 << 30  # needs the wide layout
+    d2 = UJSON()
+    big.ins(2, ("y",), "2", delta=d2)
+    d2.ctx.vv[2] = 1 << 30
+    store.fold_in({b"k": [d2]})
+    a.converge(d2)
+    assert store._shift == 32
+    assert_same_doc(store.read(b"k"), a)
+
+
+def test_resident_overflow_raises_and_preserves_rows():
+    """Seqs past u32 cannot be represented; fold_in raises and the
+    resident rows keep their pre-fold state."""
+    store = res.ResidentStore()
+    a = UJSON()
+    a.ins(1, ("x",), "1")
+    store.admit([(b"k", copy_doc(a))])
+    d = UJSON()
+    d.ctx.vv[9] = 1 << 40
+    with pytest.raises(OverflowError):
+        store.fold_in({b"k": [d]})
+    assert_same_doc(store.read(b"k"), a)
+
+
+def test_resident_evict_and_capacity_growth():
+    """Eviction frees rows for reuse; admitting past capacity grows the
+    row axis; dump returns every live key."""
+    rng = np.random.default_rng(11)
+    store = res.ResidentStore()
+    oracle = {}
+    for i in range(20):  # past the initial 8-row capacity
+        k = b"key%02d" % i
+        doc = UJSON()
+        random_mutations(rng, doc, replica=i + 1, n_ops=2)
+        oracle[k] = doc
+        store.admit([(k, copy_doc(doc))])
+    got_evicted = store.evict(b"key03")
+    assert_same_doc(got_evicted, oracle.pop(b"key03"))
+    assert b"key03" not in store
+    # the freed row is reused by the next admission
+    doc = UJSON()
+    doc.ins(77, ("z",), "9")
+    oracle[b"fresh"] = doc
+    store.admit([(b"fresh", copy_doc(doc))])
+    dump = dict(store.dump())
+    assert set(dump) == set(oracle)
+    for k, d in oracle.items():
+        assert_same_doc(dump[k], d)
+
+
+def test_repo_resident_lifecycle_matches_host(monkeypatch):
+    """RepoUJSON end to end: promotion on fan-in, resident folds across
+    epochs, local write demotion, re-promotion — always equal to a pure
+    host-loop repo fed the same commands and deltas."""
+    from jylis_tpu.models import repo_ujson as mod
+
+    class _R:
+        def __init__(self):
+            self.vals = []
+
+        def string(self, s):
+            self.vals.append(s)
+
+        def ok(self):
+            pass
+
+    def run(repo):
+        rng = np.random.default_rng(13)
+        writer = UJSON()
+        for epoch in range(4):
+            for d in make_deltas(rng, writer, replica=7, n=5):
+                repo.converge(b"doc", d)
+            repo.drain()
+            if epoch == 2:  # local write mid-stream (demotes if resident)
+                repo.apply(_R(), [b"INS", b"doc", b"tags", b'"local"'])
+        r = _R()
+        repo.apply(r, [b"GET", b"doc"])
+        return r.vals
+
+    monkeypatch.setattr(mod, "SEG_FANIN_MIN", 2)
+    monkeypatch.setattr(mod, "DEVICE_FANIN_MIN", 3)
+    dev_repo = mod.RepoUJSON(identity=1)
+    got = run(dev_repo)
+
+    monkeypatch.setattr(mod, "SEG_FANIN_MIN", 10_000)
+    monkeypatch.setattr(mod, "DEVICE_FANIN_MIN", 10_000)
+    host_repo = mod.RepoUJSON(identity=1)
+    want = run(host_repo)
+    assert got == want and got[0] != ""
+
+
+def test_repo_dump_state_covers_resident_keys(monkeypatch):
+    """Snapshots must include device-mode keys (decoded), and restoring
+    them into a fresh repo converges to the same docs."""
+    from jylis_tpu.models import repo_ujson as mod
+
+    class _R:
+        def __init__(self):
+            self.vals = []
+
+        def string(self, s):
+            self.vals.append(s)
+
+        def ok(self):
+            pass
+
+    monkeypatch.setattr(mod, "SEG_FANIN_MIN", 2)
+    rng = np.random.default_rng(17)
+    repo = mod.RepoUJSON(identity=1)
+    writers = {k: UJSON() for k in (b"p", b"q", b"r")}
+    for k, w in writers.items():
+        for d in make_deltas(rng, w, replica=3, n=4):
+            repo.converge(k, d)
+    repo.drain()
+    assert repo._res is not None and len(repo._res) == 3
+
+    fresh = mod.RepoUJSON(identity=2)
+    fresh.load_state(repo.dump_state())
+    for k in writers:
+        r1, r2 = _R(), _R()
+        repo.apply(r1, [b"GET", k])
+        fresh.apply(r2, [b"GET", k])
+        assert r1.vals == r2.vals and r1.vals[0] != ""
+
+
+def test_resident_broadcast_fold_matches_oracle():
+    """fold_in_broadcast: one delta stream joined into every resident
+    replica row across rounds == every host replica converging every
+    delta."""
+    rng = np.random.default_rng(19)
+    n_rep = 6
+    replicas = [UJSON() for _ in range(n_rep)]
+    writers = [UJSON() for _ in range(n_rep)]
+    store = res.ResidentStore()
+    store.admit([(b"rep%d" % i, copy_doc(r)) for i, r in enumerate(replicas)])
+    for _ in range(4):
+        deltas = []
+        for r, w in enumerate(writers):
+            deltas.extend(make_deltas(rng, w, replica=r, n=3))
+        store.fold_in_broadcast(deltas)
+        for doc in replicas:
+            for d in deltas:
+                doc.converge(d)
+    renders = set()
+    for i, want in enumerate(replicas):
+        got = store.read(b"rep%d" % i)
+        assert_same_doc(got, want)
+        renders.add(got.render())
+    assert len(renders) == 1  # all replicas converged
+
+
+def test_repo_trickle_reads_stay_host_side(monkeypatch):
+    """A resident key with a small pending trickle serves GETs from the
+    host-converged cache (no device fold per read); the deltas stay
+    pending and the next full drain folds them for real."""
+    from jylis_tpu.models import repo_ujson as mod
+
+    class _R:
+        def __init__(self):
+            self.vals = []
+
+        def string(self, s):
+            self.vals.append(s)
+
+        def ok(self):
+            pass
+
+    monkeypatch.setattr(mod, "SEG_FANIN_MIN", 2)
+    monkeypatch.setattr(mod, "DEVICE_FANIN_MIN", 4)
+    rng = np.random.default_rng(23)
+    repo = mod.RepoUJSON(identity=1)
+    w = UJSON()
+    for d in make_deltas(rng, w, replica=5, n=4):
+        repo.converge(b"doc", d)
+    repo.drain()
+    assert repo._is_resident(b"doc")
+
+    folds_before = repo._res._rid_cols.copy()
+    trickle = make_deltas(rng, w, replica=5, n=2)
+    for d in trickle:
+        repo.converge(b"doc", d)
+    r = _R()
+    repo.apply(r, [b"GET", b"doc"])
+    got_trickle = r.vals[0]
+    # still pending: the GET served host-side without a device fold
+    assert repo._pend.get(b"doc") and len(repo._pend[b"doc"]) == 2
+    repo.drain()  # now the device fold happens
+    assert not repo._pend.get(b"doc")
+    r2 = _R()
+    repo.apply(r2, [b"GET", b"doc"])
+    assert r2.vals[0] == got_trickle  # fold result == trickle view
+
+    host = mod.RepoUJSON(identity=1)
+    monkeypatch.setattr(mod, "SEG_FANIN_MIN", 10_000)
+    monkeypatch.setattr(mod, "DEVICE_FANIN_MIN", 10_000)
+    rng = np.random.default_rng(23)
+    w2 = UJSON()
+    for d in make_deltas(rng, w2, replica=5, n=4):
+        host.converge(b"doc", d)
+    host.drain()
+    for d in make_deltas(rng, w2, replica=5, n=2):
+        host.converge(b"doc", d)
+    r3 = _R()
+    host.apply(r3, [b"GET", b"doc"])
+    assert r3.vals[0] == got_trickle
