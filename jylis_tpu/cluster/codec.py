@@ -21,10 +21,11 @@ are encoded per data type (the wire shapes documented in each repo module):
 A native C++ fast path for the MsgPushDeltas hot loop (the per-key delta
 packing on every anti-entropy broadcast/converge) lives in
 native/cluster_codec.cpp behind jylis_tpu/native/codec.py; encode()/
-decode() below try it first and fall back here. This module is the
-always-available implementation and the byte-level correctness oracle
-(fuzz-differential tests: tests/test_native_codec.py); membership
-messages and UJSON payloads always take this path.
+decode() below try it first and fall back here — for every data type,
+UJSON included. This module is the always-available implementation and
+the byte-level correctness oracle (fuzz-differential tests:
+tests/test_native_codec.py); only membership messages always take this
+path.
 """
 
 from __future__ import annotations
@@ -33,7 +34,10 @@ import hashlib
 
 from ..ops.p2set import P2Set
 from ..ops.ujson_host import UJSON
+from ..ops.ujson_wire import read_ujson
 from ..utils.address import Address
+from ..utils.wire import Reader as _Reader
+from ..utils.wire import WireError
 from .msg import (
     Msg,
     MsgAnnounceAddrs,
@@ -71,8 +75,9 @@ def signature() -> bytes:
     return hashlib.sha256(_SCHEMA_TEXT.encode()).digest()
 
 
-class CodecError(Exception):
-    pass
+# the reader primitives live in utils/wire.py (shared with the lazy wire
+# objects in ops/ujson_wire.py); a WireError IS this module's CodecError
+CodecError = WireError
 
 
 # ---- primitive writers ----------------------------------------------------
@@ -98,52 +103,6 @@ def _w_bytes(out: bytearray, b: bytes) -> None:
 
 def _w_str(out: bytearray, s: str) -> None:
     _w_bytes(out, s.encode())
-
-
-# ---- primitive readers ----------------------------------------------------
-
-
-class _Reader:
-    __slots__ = ("buf", "pos")
-
-    def __init__(self, buf: bytes):
-        self.buf = buf
-        self.pos = 0
-
-    def varint(self) -> int:
-        shift = 0
-        v = 0
-        while True:
-            if self.pos >= len(self.buf):
-                raise CodecError("truncated varint")
-            b = self.buf[self.pos]
-            self.pos += 1
-            v |= (b & 0x7F) << shift
-            if not b & 0x80:
-                return v
-            shift += 7
-            if shift > 70:
-                raise CodecError("varint too long")
-
-    def bytes_(self) -> bytes:
-        n = self.varint()
-        if self.pos + n > len(self.buf):
-            raise CodecError("truncated bytes")
-        b = self.buf[self.pos : self.pos + n]
-        self.pos += n
-        return b
-
-    def str_(self) -> str:
-        b = self.bytes_()
-        try:
-            return b.decode()
-        except UnicodeDecodeError as e:
-            # malformed peer bytes must surface as CodecError (the cluster
-            # drops the connection on it), never a raw UnicodeDecodeError
-            raise CodecError(f"invalid utf-8 string: {e}") from e
-
-    def done(self) -> bool:
-        return self.pos == len(self.buf)
 
 
 # ---- address / membership set ---------------------------------------------
@@ -225,14 +184,7 @@ def _w_ujson(out: bytearray, u: UJSON) -> None:
 
 
 def _r_ujson(r: _Reader) -> UJSON:
-    u = UJSON()
-    for _ in range(r.varint()):
-        rid, seq = r.varint(), r.varint()
-        path = tuple(r.str_() for _ in range(r.varint()))
-        u.entries[(rid, seq)] = (path, r.str_())
-    u.ctx.vv = {r.varint(): r.varint() for _ in range(r.varint())}
-    u.ctx.cloud = {(r.varint(), r.varint()) for _ in range(r.varint())}
-    return u
+    return read_ujson(r)  # single implementation: ops/ujson_wire.py
 
 
 def _w_delta(out: bytearray, name: str, delta) -> None:

@@ -113,6 +113,27 @@ def _declare_codec(cdll: ctypes.CDLL) -> None:
             [c.c_char_p, c.c_int64, c.c_void_p, c.c_void_p, c.c_void_p,
              c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p],
         ),
+        "jy_push_ujson_encode": (
+            c.c_int64,
+            [c.c_char_p, c.c_int64, c.c_int64, c.c_char_p, c.c_void_p,
+             c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p,
+             c.c_char_p, c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p,
+             c.c_void_p, c.c_void_p, c.c_void_p, c.c_int64],
+        ),
+        # UJSON wire fast paths (native/ujson_planes.cpp)
+        "jy_ujson_split_measure": (c.c_int32, [c.c_char_p, c.c_int64, p64]),
+        "jy_ujson_split": (
+            c.c_int32,
+            [c.c_char_p, c.c_int64, c.c_void_p, c.c_void_p, c.c_void_p,
+             c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p],
+        ),
+        "jy_ujson_grid_fill": (
+            c.c_int32,
+            [c.c_char_p, c.c_int64, c.c_void_p, c.c_void_p, c.c_void_p,
+             c.c_int32, c.c_int64, c.c_int64, c.c_int64, c.c_void_p,
+             c.c_int64, c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p,
+             c.c_void_p, p64, c.c_void_p, c.c_void_p, p64, p64],
+        ),
     }
     for fn_name, (restype, argtypes) in sigs.items():
         fn = getattr(cdll, fn_name)

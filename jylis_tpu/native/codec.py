@@ -96,7 +96,9 @@ def encode_push(msg: MsgPushDeltas) -> bytes | None:
         return _encode_treg(cdll, msg)
     if name in ("TLOG", "SYSTEM"):
         return _encode_tlog(cdll, msg)
-    return None  # UJSON / unknown: oracle
+    if name == "UJSON":
+        return _encode_ujson(cdll, msg)
+    return None  # unknown: oracle
 
 
 def _encode_counters(cdll, msg: MsgPushDeltas, ndicts: int) -> bytes | None:
@@ -208,6 +210,80 @@ def _encode_tlog(cdll, msg: MsgPushDeltas) -> bytes | None:
     return out[:n].tobytes() if n >= 0 else None
 
 
+def _encode_ujson(cdll, msg: MsgPushDeltas) -> bytes | None:
+    """Flatten UJSON deltas in oracle order (entries by dot, vv by rid,
+    cloud sorted; strings = path parts then token per entry) and varint-
+    pack the whole batch in one FFI call."""
+    batch = msg.batch
+    key_blob, key_off, key_len = _key_blob(batch)
+    counts = np.empty(len(batch) * 3, np.int64)
+    ent_rid: list[int] = []
+    ent_seq: list[int] = []
+    path_counts: list[int] = []
+    str_parts: list[bytes] = []
+    vv_rid: list[int] = []
+    vv_val: list[int] = []
+    cl_rid: list[int] = []
+    cl_seq: list[int] = []
+    try:
+        for i, (_key, u) in enumerate(batch):
+            entries = u.entries
+            counts[i * 3] = len(entries)
+            for dot in sorted(entries):
+                rid, seq = dot
+                path, token = entries[dot]
+                ent_rid.append(rid)
+                ent_seq.append(seq)
+                path_counts.append(len(path))
+                for part in path:
+                    str_parts.append(part.encode())
+                str_parts.append(token.encode())
+            vv = u.ctx.vv
+            counts[i * 3 + 1] = len(vv)
+            for rid in sorted(vv):
+                vv_rid.append(rid)
+                vv_val.append(vv[rid])
+            cloud = sorted(u.ctx.cloud)
+            counts[i * 3 + 2] = len(cloud)
+            for rid, seq in cloud:
+                cl_rid.append(rid)
+                cl_seq.append(seq)
+    except (AttributeError, TypeError):
+        return None  # not host-lattice-shaped: oracle decides
+    arrs = [
+        _u64_array(ent_rid), _u64_array(ent_seq), _u64_array(vv_rid),
+        _u64_array(vv_val), _u64_array(cl_rid), _u64_array(cl_seq),
+    ]
+    if any(a is None for a in arrs):
+        return None
+    er, es, vr, vvv, cr, cs = arrs
+    pc = np.asarray(path_counts, np.int64) if path_counts else np.empty(0, np.int64)
+    str_off = np.empty(len(str_parts), np.int64)
+    str_len = np.empty(len(str_parts), np.int64)
+    pos = 0
+    for i, part in enumerate(str_parts):
+        str_off[i] = pos
+        str_len[i] = len(part)
+        pos += len(part)
+    str_blob = b"".join(str_parts)
+    name_b = msg.name.encode()
+    cap = (
+        16 + len(name_b) + len(key_blob) + len(str_blob)
+        + 40 * len(batch) + 30 * len(ent_rid) + 10 * len(str_parts)
+        + 20 * (len(vv_rid) + len(cl_rid))
+    )
+    out = np.empty(cap, np.uint8)
+    n = cdll.jy_push_ujson_encode(
+        name_b, len(name_b), len(batch),
+        key_blob, _ptr(key_off), _ptr(key_len),
+        _ptr(counts), _ptr(er), _ptr(es), _ptr(pc),
+        str_blob, _ptr(str_off), _ptr(str_len),
+        _ptr(vr), _ptr(vvv), _ptr(cr), _ptr(cs),
+        _ptr(out), cap,
+    )
+    return out[:n].tobytes() if n >= 0 else None
+
+
 # ---- decode ----------------------------------------------------------------
 
 
@@ -249,6 +325,8 @@ def decode_push(body: bytes) -> Msg | None:
         return _decode_treg(cdll, name, rest)
     if name in ("TLOG", "SYSTEM"):
         return _decode_tlog(cdll, name, rest)
+    if name == "UJSON":
+        return _decode_ujson(cdll, name, rest)
     return None
 
 
@@ -315,6 +393,20 @@ def _decode_treg(cdll, name, rest) -> Msg | None:
         for k in range(nk)
     )
     return MsgPushDeltas(name, batch)
+
+
+def _decode_ujson(cdll, name, rest) -> Msg | None:
+    """Lazy receive path: one native pass splits the body into per-key
+    WireUJSON payload spans (structure + utf-8 validated up front);
+    documents materialise only if a host-lattice path touches them.
+    Device-bound deltas go wire->planes without ever becoming dicts
+    (ops/ujson_wire.grid_from_wire)."""
+    from ..ops.ujson_wire import split_push_ujson
+
+    batch = split_push_ujson(rest)
+    if batch is None:
+        return None
+    return MsgPushDeltas(name, tuple(batch))
 
 
 def _decode_tlog(cdll, name, rest) -> Msg | None:

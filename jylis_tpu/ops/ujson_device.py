@@ -83,13 +83,19 @@ def _member(sorted_row, queries):
 
 
 def _covered(vv, cloud, dots, shift):
-    """ctx.contains for each dot: seq <= vv[rid] or dot in cloud."""
+    """ctx.contains for each dot: seq <= vv[rid] or dot in cloud.
+
+    The vv lookup runs as a per-replica-column mask reduction instead of
+    a computed-index gather (pathologically slow on this TPU); R is
+    small and static."""
     dt = dots.dtype
+    r = vv.shape[-1]
     rid = (dots >> dt.type(shift)).astype(I32)
     seq = (dots & dt.type((1 << shift) - 1)).astype(U32)
-    # pad rows gather out of range; clamp and rely on callers masking pads
-    rid = jnp.minimum(rid, vv.shape[-1] - 1)
-    return (seq <= vv[rid]) | _member(cloud, dots)
+    rid = jnp.minimum(rid, r - 1)  # pads decode out of range; callers mask
+    colmask = rid[None, :] == jnp.arange(r, dtype=I32)[:, None]  # (R, W)
+    vvd = jnp.sum(jnp.where(colmask, vv[:, None], U32(0)), axis=0, dtype=U32)
+    return (seq <= vvd) | _member(cloud, dots)
 
 
 def _sortmerge(row_a, pay_a, row_b, pay_b):

@@ -50,6 +50,16 @@ class CausalContext:
         r, s = dot
         return s <= self.vv.get(r, 0) or dot in self.cloud
 
+    def __eq__(self, other) -> bool:
+        """REPRESENTATIONAL equality (vv and cloud as stored) — what the
+        wire codec round-trips; two contexts with identical coverage but
+        different compaction states compare unequal."""
+        return (
+            isinstance(other, CausalContext)
+            and self.vv == other.vv
+            and self.cloud == other.cloud
+        )
+
     def add(self, dot: Dot) -> None:
         self.cloud.add(dot)
         self.compact()
@@ -130,6 +140,15 @@ class UJSON:
     def __init__(self):
         self.entries: dict[Dot, tuple[Path, str]] = {}
         self.ctx = CausalContext()
+
+    def __eq__(self, other) -> bool:
+        """Representational equality (see CausalContext.__eq__): used by
+        message equality in the codec differential tests."""
+        return (
+            isinstance(other, UJSON)
+            and self.entries == other.entries
+            and self.ctx == other.ctx
+        )
 
     # ---- queries ----------------------------------------------------------
 

@@ -83,9 +83,65 @@ I32 = jnp.int32
 # ---- fused device kernels --------------------------------------------------
 
 
+def _fold_flat_one(g: DocBatch, shift: int) -> DocBatch:
+    """Fold ONE key's (D, W) delta stack to a single row in closed form.
+
+    The pairwise fold tree (ops/ujson_device.fold_segments) widens its
+    intermediates to D*W — mostly pads for small deltas — and pays a
+    sort per level. But for a FOLD (not a general join) there is a flat
+    rule: an entry survives iff every delta either CONTAINS it or does
+    not COVER it (containment implies coverage, so the delta that minted
+    it never votes against it; associativity of the ORSWOT join makes
+    the n-way statement exact). That is one (D, E) membership/coverage
+    probe matrix and a reduce — no tree, no intermediate widening, and
+    the single output sort. Contexts fold as an elementwise vv max plus
+    one cloud sort+dedup.
+    """
+    dt = g.dots.dtype
+    pad = _pad_of(dt)
+    d, w = g.dots.shape
+    dots = g.dots.reshape(d * w)
+    pay = g.pay.reshape(d * w)
+    valid = dots != pad
+    rid = jnp.minimum((dots >> dt.type(shift)).astype(I32), g.vv.shape[-1] - 1)
+    seq = (dots & dt.type((1 << shift) - 1)).astype(U32)
+    # (D, E): does delta j cover entry e? (vv lookup or cloud membership)
+    cover = (seq[None, :] <= g.vv[:, rid]) | jax.vmap(
+        lambda row: dev._member(row, dots)
+    )(g.cloud)
+    # (D, E): does delta j contain entry e? (rows are sorted)
+    present = jax.vmap(lambda row: dev._member(row, dots))(g.dots)
+    survive = valid & jnp.all(present | ~cover, axis=0)
+    out_dots = jnp.where(survive, dots, pad)
+    out_pay = jnp.where(survive, pay, -1)
+    order = jnp.argsort(out_dots)
+    out_dots = out_dots[order]
+    out_pay = out_pay[order]
+    # dedup equal dots (several deltas carrying the same entry): keep one
+    dup = jnp.concatenate(
+        [out_dots[:-1] == out_dots[1:], jnp.zeros((1,), bool)]
+    )
+    d2 = jnp.where(dup, pad, out_dots)
+    p2 = jnp.where(dup, -1, out_pay)
+    order2 = jnp.argsort(d2)
+    vv = jnp.max(g.vv, axis=0)
+    cl = jnp.sort(g.cloud.reshape(d * g.cloud.shape[-1]))
+    cdup = jnp.concatenate([jnp.zeros((1,), bool), cl[1:] == cl[:-1]])
+    cloud = jnp.sort(jnp.where(cdup, pad, cl))
+    return DocBatch(d2[order2], p2[order2], vv, cloud)
+
+
 def _fold_grid(grid: DocBatch, shift: int) -> DocBatch:
-    """(K, D, W) grid -> (K, W') one folded row per key (the segmented
-    fold, inlined into the callers' fused dispatches)."""
+    """(K, D, W) grid -> one folded row per key, all keys in the same
+    dispatch (inlined into the callers' fused kernels).
+
+    Two shapes: the log-depth pairwise tree (ops/ujson_device) probes
+    O(E log E) per key but widens intermediates with pads; the flat
+    closed-form rule (_fold_flat_one) never widens but probes O(E*D).
+    Probes are gather-bound on this hardware, so the tree wins for deep
+    stacks and flat wins for shallow ones; measured crossover ~64."""
+    if grid.dots.shape[1] <= 64:
+        return jax.vmap(partial(_fold_flat_one, shift=shift))(grid)
     return dev.fold_segments(grid, shift=shift)
 
 
@@ -98,25 +154,32 @@ def _compact_ctx_row(vv, cloud, shift: int):
     single pass is complete (any gap blocks everything after it)."""
     dt = cloud.dtype
     pad = _pad_of(dt)
-    c = cloud.shape[-1]
+    r = vv.shape[-1]
     valid = cloud != pad
-    col = jnp.minimum((cloud >> dt.type(shift)).astype(I32), vv.shape[-1] - 1)
+    col = jnp.minimum((cloud >> dt.type(shift)).astype(I32), r - 1)
     seq = (cloud & dt.type((1 << shift) - 1)).astype(U32)
-    vvc = vv[col]
+    # computed-index gathers/scatters are pathologically slow on this
+    # chip (BENCH r01 note); R is small and static, so per-column masks
+    # do the vv lookup and the absorb counting as dense lane ops instead
+    colmask = col[None, :] == jnp.arange(r, dtype=I32)[:, None]  # (R, C)
+    vvc = jnp.sum(jnp.where(colmask, vv[:, None], U32(0)), axis=0, dtype=U32)
     drop = valid & (seq <= vvc)
     keep = valid & ~drop
-    idx = jnp.arange(c, dtype=I32)
     prev_col = jnp.concatenate([jnp.full((1,), -1, I32), col[:-1]])
     is_new = valid & (col != prev_col)
-    seg_start = jnp.maximum(
-        jax.lax.cummax(jnp.where(is_new, idx, I32(-1))), 0
-    )
     kept_before = jnp.concatenate(
         [jnp.zeros((1,), I32), jnp.cumsum(keep.astype(I32))[:-1]]
     )
-    rank = kept_before - kept_before[seg_start]
+    # kept_before is non-decreasing, so the value at the latest segment
+    # start is a running max over the marked positions (no gather)
+    seg_base = jnp.maximum(
+        jax.lax.cummax(jnp.where(is_new, kept_before, I32(-1))), 0
+    )
+    rank = kept_before - seg_base
     absorb = keep & (seq == vvc + rank.astype(U32) + 1)
-    new_vv = vv.at[col].add(jnp.where(absorb, U32(1), U32(0)))
+    new_vv = vv + jnp.sum(
+        (colmask & absorb[None, :]).astype(U32), axis=1, dtype=U32
+    )
     new_cloud = jnp.sort(jnp.where(absorb | drop, pad, cloud))
     return new_vv, new_cloud
 
@@ -153,7 +216,7 @@ def _finish(joined: DocBatch, shift: int, out_w: int, out_c: int) -> DocBatch:
 @partial(jax.jit, static_argnames=("shift", "out_w", "out_c"))
 def fold_join_subset(
     resident: DocBatch, grid: DocBatch, idx, shift: int, out_w: int, out_c: int
-) -> DocBatch:
+) -> tuple[DocBatch, jax.Array]:
     """Fold each grid segment and join into resident rows idx, one
     dispatch. idx rows must be unique EXCEPT for padded slots pointing at
     scratch row 0 with identity segments: identity joins are no-ops, so
@@ -170,33 +233,42 @@ def fold_join_subset(
         resident.vv,
         _fit(resident.cloud, out_c, pad),
     )
-    return DocBatch(*(b.at[idx].set(j) for b, j in zip(base, joined)))
+    out = DocBatch(*(b.at[idx].set(j) for b, j in zip(base, joined)))
+    # live widths of the FULL batch (untouched rows included): the
+    # store's width bound must cover every row, not just the subset
+    return out, live_widths(out)
 
 
 @partial(jax.jit, static_argnames=("shift", "out_w", "out_c"))
 def fold_join_aligned(
     resident: DocBatch, grid: DocBatch, shift: int, out_w: int, out_c: int
-) -> DocBatch:
+) -> tuple[DocBatch, jax.Array]:
     """Row-aligned variant: grid row i folds into resident row i. No
     gathers or scatters, so with both operands row-sharded over a mesh the
     whole drain is SPMD with zero collectives."""
     folded = _fold_grid(grid, shift)
-    return _finish(_join_inside(resident, folded, shift), shift, out_w, out_c)
+    out = _finish(_join_inside(resident, folded, shift), shift, out_w, out_c)
+    return out, live_widths(out)
 
 
 @partial(jax.jit, static_argnames=("shift", "out_w", "out_c"))
 def fold_broadcast_rows(
     resident: DocBatch, deltas: DocBatch, shift: int, out_w: int, out_c: int
-) -> DocBatch:
+) -> tuple[DocBatch, jax.Array]:
     """Fold a (D, W) delta batch to ONE doc and join it into EVERY
     resident row — the N-replica anti-entropy fan-in with the replica
     documents already resident (bench config 5 drives this)."""
-    folded = dev._fold_body(deltas, shift)
+    if deltas.dots.shape[0] <= 64:
+        folded = _fold_flat_one(deltas, shift)
+        folded = DocBatch(*(p[None] for p in folded))
+    else:
+        folded = dev._fold_body(deltas, shift)
     b = resident.dots.shape[0]
     tiled = DocBatch(
         *(jnp.broadcast_to(p, (b,) + p.shape[1:]) for p in folded)
     )
-    return _finish(_join_inside(resident, tiled, shift), shift, out_w, out_c)
+    out = _finish(_join_inside(resident, tiled, shift), shift, out_w, out_c)
+    return out, live_widths(out)
 
 
 @partial(jax.jit, static_argnames=("w", "c"))
@@ -320,6 +392,14 @@ def grow_reps(batch: DocBatch, n_rep: int) -> DocBatch:
     return DocBatch(batch.dots, batch.pay, vv, batch.cloud)
 
 
+def _ready(arr) -> bool:
+    """True when a device array's host copy would not block."""
+    try:
+        return arr.is_ready()
+    except AttributeError:
+        return True  # no readiness API: reading is the only option
+
+
 # ---- the store -------------------------------------------------------------
 
 
@@ -347,14 +427,26 @@ class ResidentStore:
         self._rid_cols: dict[int, int] = {}
         self._pay_ids: dict[tuple, int] = {}
         self._pay_rev: list[tuple] = []
+        # canonical-wire-bytes -> pay id mirror (the native wire->planes
+        # encoder interns payloads by their wire spans; identical
+        # (path, token) pairs have identical canonical encodings)
+        self._pay_wire: dict[bytes, int] = {}
         self._rows: dict[bytes, int] = {}
         self._free: list[int] = []
         self._batch: DocBatch | None = None
-        # host-side width upper bounds (see module docstring): grow by
-        # admission widths and per-drain delta counts, tighten for free
-        # whenever a full read pulls the planes anyway
-        self._ub_w = 1
-        self._ub_c = 1
+        # host-side width bounds as a BOUNDED PIPELINE: every fold
+        # returns its live widths (async-copied to host at dispatch) and
+        # joins the in-flight queue with its growth counts. The bound is
+        # base (the newest CONSUMED fold's live, or admission widths) +
+        # the growth of everything still in flight. Landed copies are
+        # consumed for free; past PIPE_DEPTH the oldest is consumed
+        # BLOCKING — which is exactly the backpressure that stops an
+        # ever-wider fold backlog from snowballing device work
+        self._base_w = 1
+        self._base_c = 1
+        self._floor_w = 1  # admission widths until the next exact read
+        self._floor_c = 1
+        self._inflight: list = []  # [(live_arr, grow_w, grow_c), ...]
         # the largest seq ever encoded into the store: a causal context
         # covers its dot store, so the running max over delta vv/cloud
         # seqs bounds every seq on device — which is what makes the
@@ -372,7 +464,19 @@ class ResidentStore:
         return pid
 
     def pay_lookup(self, pid: int):
-        return self._pay_rev[pid]
+        pt = self._pay_rev[pid]
+        if type(pt) is bytes:
+            # wire-interned payload: parse its canonical span on first
+            # read (the drain never needs the parsed form — only decode
+            # paths do, and only for payloads that survive to a read)
+            from ..utils.wire import Reader
+
+            r = Reader(pt)
+            path = tuple(r.str_() for _ in range(r.varint()))
+            pt = (path, r.str_())
+            self._pay_rev[pid] = pt
+            self._pay_ids.setdefault(pt, pid)
+        return pt
 
     # -- introspection ------------------------------------------------------
 
@@ -418,35 +522,98 @@ class ResidentStore:
             return batch
         return self._shard_fn(batch)
 
-    def _out_widths(self) -> tuple[int, int]:
-        return bucket(self._ub_w, 4), bucket(self._ub_c, 4)
+    PIPE_DEPTH = 2  # folds allowed in flight before blocking on the oldest
+
+    def _consume(self, block: bool) -> bool:
+        """Consume the oldest in-flight fold's live widths into the
+        base. The consumed fold's own growth is implicitly reflected in
+        its measured live, so it leaves the in-flight sum."""
+        if not self._inflight:
+            return False
+        arr, _gw, _gc = self._inflight[0]
+        if not block and not _ready(arr):
+            return False
+        self._inflight.pop(0)
+        lw, lc = (int(x) for x in jax.device_get(arr))
+        # the floor covers rows admitted after the consumed fold
+        # dispatched (their widths are invisible to its live output)
+        self._base_w = max(lw, self._floor_w, 1)
+        self._base_c = max(lc, self._floor_c, 1)
+        return True
 
     def _budget_widths(self, grow_w: int, grow_c: int) -> tuple[int, int]:
-        """Width targets for the next fold. If the (upper-bound) growth
-        would WIDEN the planes, first re-tighten the bounds from the
-        device (one small pull): redelivered deltas inflate the bound
-        while the join dedups them, and without this check every
-        redelivery storm would grow the planes — and recompile the fold
-        (~25s) — for no live data. After tightening, genuine growth
-        still widens (and compiles) as it must."""
-        self._ub_w += grow_w
-        self._ub_c += grow_c
-        out_w, out_c = self._out_widths()
-        if self._batch is not None and (
-            out_w > self._batch.dots.shape[-1]
-            or out_c > self._batch.cloud.shape[-1]
-        ):
-            ld, lc = (int(x) for x in jax.device_get(live_widths(self._batch)))
-            self._ub_w = max(ld, 1) + grow_w
-            self._ub_c = max(lc, 1) + grow_c
-            out_w, out_c = self._out_widths()
+        """Width targets for the next fold. The bound is the newest
+        consumed fold's LIVE widths plus the growth counts of everything
+        still in flight — an over-estimate whenever joins dedup
+        (redelivery) or context compaction absorbs (contiguous dots),
+        corrected as soon as a landed live-width copy is consumed. Past
+        PIPE_DEPTH the consume BLOCKS: bounded pipelining, so a backlog
+        of ever-wider folds can never snowball the device queue."""
+        while self._consume(block=False):
+            pass
+        while len(self._inflight) >= self.PIPE_DEPTH:
+            self._consume(block=True)
+        ub_w = self._base_w + grow_w + sum(g for _, g, _c in self._inflight)
+        ub_c = self._base_c + grow_c + sum(c for _, _g, c in self._inflight)
+        if self._batch is None:
+            return bucket(ub_w, 4), bucket(ub_c, 4)
+        bw = self._batch.dots.shape[-1]
+        bc = self._batch.cloud.shape[-1]
+        out_w = bucket(ub_w, 4)
+        out_c = bucket(ub_c, 4)
+        # shape hysteresis: keep the current width unless it must grow
+        # or can shrink 4x (no recompile thrash around a boundary)
+        if out_w < bw and out_w * 4 > bw:
+            out_w = bw
+        if out_c < bc and out_c * 4 > bc:
+            out_c = bc
         return out_w, out_c
+
+    def _grid_to_device(self, grid: DocBatch) -> DocBatch:
+        """Ship grid planes to the device, materialising all-identity
+        planes on-device instead of transferring them (a sparse drain's
+        vv plane is megabytes of zeros; anti-entropy deltas rarely carry
+        vv entries at all — their dots ride in the cloud)."""
+        pad = _pad_of(np.asarray(grid.dots).dtype)
+
+        def put(p, fill):
+            if isinstance(p, np.ndarray):
+                uniform = (not p.any()) if fill == 0 else bool((p == fill).all())
+                if uniform:
+                    if fill == 0:
+                        return jnp.zeros(p.shape, p.dtype)
+                    return jnp.full(p.shape, fill, p.dtype)
+            return jnp.asarray(p)
+
+        return DocBatch(
+            put(grid.dots, pad),
+            put(grid.pay, -1),
+            put(grid.vv, 0),
+            put(grid.cloud, pad),
+        )
+
+    def _note_fold(self, batch: DocBatch, live, gw: int, gc: int) -> DocBatch:
+        """Enqueue a fold in the bounded pipeline: keep its live-width
+        scalars (host copy started in the background) and its growth
+        counts for the in-flight bound."""
+        self._inflight.append((live, gw, gc))
+        try:
+            live.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
+        return batch
 
     def _note_seqs(self, docs) -> None:
         """Track the max seq across delta contexts (context covers store,
-        so vv+cloud bound the entries too)."""
+        so vv+cloud bound the entries too). Wire deltas carry their
+        measured max_seq — reading .ctx would defeat their laziness."""
         m = self._max_seq
         for d in docs:
+            ms = getattr(d, "max_seq", None)
+            if ms is not None:
+                if ms > m:
+                    m = ms
+                continue
             for s in d.ctx.vv.values():
                 if s > m:
                     m = s
@@ -463,11 +630,13 @@ class ResidentStore:
         self._shift = 32
 
     def _ensure_reps(self) -> None:
-        """After any encode grew the rid interner: widen vv columns, and
+        self._grow_reps_to(len(self._rid_cols))
+
+    def _grow_reps_to(self, n: int) -> None:
+        """Replica-column growth to at least n: widen vv columns, and
         re-pack the dot layout if the replica-column budget no longer
         fits — to a smaller narrow shift when every seq ever encoded
         still fits it, else to u64/32."""
-        n = len(self._rid_cols)
         if self._shift != 32 and n > (1 << (31 - self._shift)):
             s2 = dev.narrow_shift(bucket(n, 4))
             if self._max_seq < (1 << s2) - 1:
@@ -507,6 +676,9 @@ class ResidentStore:
             return b
 
     def _encode_grid(self, groups) -> DocBatch:
+        wire = self._grid_from_wire(groups)
+        if wire is not None:
+            return wire
         while True:
             try:
                 g = dev.encode_doc_groups(
@@ -524,6 +696,94 @@ class ResidentStore:
             self._ensure_reps()
             return g
 
+    def _grid_from_wire(self, groups) -> DocBatch | None:
+        """The native wire->planes grid encoder: when every delta in the
+        drain is a WireUJSON (the cluster receive path), the (K, D, W)
+        grid fills straight from the raw payload bytes — per-delta host
+        cost is native parsing + interning, no Python dict walks. Returns
+        None (caller uses the object encoder) when the native library is
+        missing or any delta is a plain document."""
+        from ..native import lib
+        from .ujson_wire import (
+            GridOverflow,
+            GridRepBudget,
+            WireUJSON,
+            grid_from_wire,
+        )
+
+        if lib() is None:
+            return None
+        flat = []
+        for g in groups:
+            for d in g:
+                if type(d) is not WireUJSON:
+                    return None
+                flat.append(d)
+        if not flat:
+            return None
+        d_dim = bucket(max(len(g) for g in groups), 1)
+        w = bucket(max(max(d.n_entries for d in flat), 1), 4)
+        c = bucket(max(max(d.n_cloud for d in flat), 1), 4)
+        rows = len(groups) * d_dim
+        dest = np.fromiter(
+            (
+                k * d_dim + j
+                for k, g in enumerate(groups)
+                for j in range(len(g))
+            ),
+            np.int64,
+            count=len(flat),
+        )
+        while True:
+            known = [0] * len(self._rid_cols)
+            for rid, col in self._rid_cols.items():
+                known[col] = rid
+            try:
+                dots, pay, vv, cloud, new_rids, spans = grid_from_wire(
+                    flat, dest, rows, w, c, self._shift, self._nrep, known
+                )
+            except GridOverflow:
+                if self._shift == 32:
+                    raise OverflowError("seq beyond the u64/32 layout")
+                self._widen()
+                continue
+            except GridRepBudget as e:
+                self._grow_reps_to(e.needed)
+                continue
+            break
+        for rid in new_rids:
+            self._rid_cols[rid] = len(self._rid_cols)
+        self._ensure_reps()
+        if self._nrep > vv.shape[-1]:
+            # new columns crossed a vv bucket AFTER a successful fill:
+            # widen the grid's vv plane to match the store
+            vv = np.concatenate(
+                [vv, np.zeros((rows, self._nrep - vv.shape[-1]), np.uint32)],
+                axis=-1,
+            )
+        if spans:
+            # new payloads intern by their canonical span; parsing to
+            # (path, token) is deferred to pay_lookup (reads). A payload
+            # that later ALSO arrives via the object path gets a second
+            # id — harmless (ids just name payloads; dots dedup joins)
+            lut = np.empty(len(spans), np.int32)
+            pw = self._pay_wire
+            rev = self._pay_rev
+            for i, span in enumerate(spans):
+                gid = pw.get(span)
+                if gid is None:
+                    gid = pw[span] = len(rev)
+                    rev.append(span)
+                lut[i] = gid
+            pay = np.where(pay >= 0, lut[np.maximum(pay, 0)], -1)
+        k = len(groups)
+        return DocBatch(
+            dots.reshape(k, d_dim, w),
+            pay.reshape(k, d_dim, w),
+            vv.reshape(k, d_dim, self._nrep),
+            cloud.reshape(k, d_dim, c),
+        )
+
     # -- admission / eviction ------------------------------------------------
 
     def admit(self, items: list[tuple[bytes, object]]) -> None:
@@ -538,8 +798,12 @@ class ResidentStore:
         # covers store) holds for every doc the host lattice builds, so
         # vv alone still bounds them
         rows_np = self._encode_rows([d for _, d in items])
-        self._ub_w = max(self._ub_w, rows_np.dots.shape[-1])
-        self._ub_c = max(self._ub_c, rows_np.cloud.shape[-1])
+        self._base_w = max(self._base_w, rows_np.dots.shape[-1])
+        self._base_c = max(self._base_c, rows_np.cloud.shape[-1])
+        # admitted rows can exceed any in-flight fold's live widths; the
+        # floor survives consumes until the next exact full read
+        self._floor_w = max(self._floor_w, rows_np.dots.shape[-1])
+        self._floor_c = max(self._floor_c, rows_np.cloud.shape[-1])
         if self._batch is None:
             cap = self._capacity_for(len(items) + 1)
             pad = _pad_of(np.int32 if self._shift < 32 else np.uint64)
@@ -611,16 +875,27 @@ class ResidentStore:
         self._note_seqs([d for lst in pending.values() for d in lst])
         # width bound: each row grows by at most its group's entry/cloud
         # counts (the join can only drop), so the batch max grows by at
-        # most the largest group's counts
+        # most the largest group's counts. Wire deltas carry measured
+        # counts; touching .entries would materialise them
         grow_w = grow_c = 0
         for lst in pending.values():
-            ew = sum(len(d.entries) for d in lst)
-            ec = sum(len(d.ctx.cloud) for d in lst)
+            ew = ec = 0
+            for d in lst:
+                n = getattr(d, "n_entries", None)
+                if n is not None:
+                    ew += n
+                    ec += d.n_cloud
+                else:
+                    ew += len(d.entries)
+                    ec += len(d.ctx.cloud)
             if ew > grow_w:
                 grow_w = ew
             if ec > grow_c:
                 grow_c = ec
-        if self._mesh is None and len(pending) <= len(self._rows) // 2:
+        if self._mesh is None:
+            # single device: the subset fold's grid covers exactly the
+            # drained keys (the aligned grid spans every capacity row —
+            # only worth it when sharding forbids gathers/scatters)
             self._fold_subset(pending, grow_w, grow_c)
         else:
             self._fold_aligned(pending, grow_w, grow_c)
@@ -633,20 +908,32 @@ class ResidentStore:
         from .ujson_host import UJSON
 
         self._note_seqs(deltas)
-        d = bucket(len(deltas), 4)  # identity-pad: bound the jit cache
-        batch = self._encode_rows(list(deltas) + [UJSON()] * (d - len(deltas)))
-        out_w, out_c = self._budget_widths(
-            sum(len(x.entries) for x in deltas),
-            sum(len(x.ctx.cloud) for x in deltas),
-        )
+        # wire path: the whole list as ONE (1, D, W) grid segment
+        grid = self._grid_from_wire([list(deltas)])
+        if grid is not None:
+            batch = self._grid_to_device(DocBatch(*(p[0] for p in grid)))
+        else:
+            d = bucket(len(deltas), 4)  # identity-pad: bound the jit cache
+            rows_np = self._encode_rows(
+                list(deltas) + [UJSON()] * (d - len(deltas))
+            )
+            batch = DocBatch(*(jnp.asarray(p) for p in rows_np))
+        grow_w = grow_c = 0
+        for x in deltas:
+            n = getattr(x, "n_entries", None)
+            if n is not None:
+                grow_w += n
+                grow_c += x.n_cloud
+            else:
+                grow_w += len(x.entries)
+                grow_c += len(x.ctx.cloud)
+        out_w, out_c = self._budget_widths(grow_w, grow_c)
         # the delta batch's leading axis is deltas, not resident rows;
         # it stays replicated (only the resident planes are row-sharded)
-        batch = DocBatch(*(jnp.asarray(p) for p in batch))
-        self._batch = self._shard(
-            fold_broadcast_rows(
-                self._batch, batch, shift=self._shift, out_w=out_w, out_c=out_c
-            )
+        out, live = fold_broadcast_rows(
+            self._batch, batch, shift=self._shift, out_w=out_w, out_c=out_c
         )
+        self._batch = self._shard(self._note_fold(out, live, grow_w, grow_c))
 
     def _fold_subset(self, pending, grow_w: int, grow_c: int) -> None:
         ks = sorted(pending)
@@ -657,11 +944,12 @@ class ResidentStore:
         idx = np.zeros(n, np.int32)  # pad slots -> scratch row 0
         for j, k in enumerate(ks):
             idx[j] = self._rows[k]
-        grid = DocBatch(*(jnp.asarray(p) for p in grid))
-        self._batch = fold_join_subset(
+        grid = self._grid_to_device(grid)
+        out, live = fold_join_subset(
             self._batch, grid, jnp.asarray(idx), shift=self._shift,
             out_w=out_w, out_c=out_c,
         )
+        self._batch = self._note_fold(out, live, grow_w, grow_c)
 
     def _fold_aligned(self, pending, grow_w: int, grow_c: int) -> None:
         cap = self._row_axis()
@@ -670,12 +958,11 @@ class ResidentStore:
             groups[self._rows[k]] = lst
         grid = self._encode_grid(groups)
         out_w, out_c = self._budget_widths(grow_w, grow_c)
-        grid = self._shard(DocBatch(*(jnp.asarray(p) for p in grid)))
-        self._batch = self._shard(
-            fold_join_aligned(
-                self._batch, grid, shift=self._shift, out_w=out_w, out_c=out_c
-            )
+        grid = self._shard(self._grid_to_device(grid))
+        out, live = fold_join_aligned(
+            self._batch, grid, shift=self._shift, out_w=out_w, out_c=out_c
         )
+        self._batch = self._shard(self._note_fold(out, live, grow_w, grow_c))
 
     # -- reads ---------------------------------------------------------------
 
@@ -689,13 +976,19 @@ class ResidentStore:
         )
         sub = DocBatch(*(p[rows] for p in self._batch))
         np_sub = DocBatch(*jax.device_get(tuple(sub)))  # one transfer
-        if len(keys) == len(self._rows):
+        # full-read detection must reject duplicate keys: a duplicated
+        # subset could pass the length check and re-tighten (then slice)
+        # below an unread row's live width
+        if len(keys) == len(self._rows) and len(set(keys)) == len(keys):
             # a full read pulled every row anyway: re-tighten the width
             # bounds (and re-bucket the planes) for free
             pad = _pad_of(np_sub.dots.dtype)
-            self._ub_w = max(int((np_sub.dots != pad).sum(axis=1).max()), 1)
-            self._ub_c = max(int((np_sub.cloud != pad).sum(axis=1).max()), 1)
-            w, c = self._out_widths()
+            self._base_w = max(int((np_sub.dots != pad).sum(axis=1).max()), 1)
+            self._base_c = max(int((np_sub.cloud != pad).sum(axis=1).max()), 1)
+            self._inflight.clear()  # the pull reflects every queued fold
+            self._floor_w = self._floor_c = 1
+            w = bucket(self._base_w, 4)
+            c = bucket(self._base_c, 4)
             if (
                 w < self._batch.dots.shape[-1]
                 or c < self._batch.cloud.shape[-1]
@@ -705,7 +998,7 @@ class ResidentStore:
         docs = dev.decode_batch(
             np_sub, cols_rid, self.pay_lookup, shift=self._shift
         )
-        if len(keys) == len(self._rows):
+        if len(keys) == len(self._rows) and len(set(keys)) == len(keys):
             self._compact_pay(np_sub)
         return docs
 
@@ -726,6 +1019,11 @@ class ResidentStore:
             new_rev.append(self._pay_rev[pid])
         self._pay_rev = new_rev
         self._pay_ids = {k: i for i, k in enumerate(new_rev)}
+        self._pay_wire = {
+            span: int(table[pid])
+            for span, pid in self._pay_wire.items()
+            if table[pid] >= 0
+        }
         self._batch = self._shard(remap_pay(self._batch, jnp.asarray(table)))
 
     def dump(self) -> list[tuple[bytes, object]]:

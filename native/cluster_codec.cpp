@@ -328,4 +328,54 @@ int32_t jy_push_tlog_decode(const uint8_t* body, int64_t body_len,
   return r.rc;
 }
 
+// ---- UJSON: per key (entries:[(rid seq path:[str] token:str)]
+//                      vv:[(rid seq)] cloud:[(rid seq)]) ---------------------
+// The Python wrapper flattens each delta in oracle order (entries sorted by
+// dot, vv by rid, cloud sorted); strings are path parts then token per
+// entry, all in one blob. counts holds 3 int64 per key: entries, vv, cloud.
+
+int64_t jy_push_ujson_encode(
+    const uint8_t* name, int64_t name_len, int64_t n_keys,
+    const uint8_t* key_base, const int64_t* key_off, const int64_t* key_len,
+    const int64_t* counts, const uint64_t* ent_rid, const uint64_t* ent_seq,
+    const int64_t* path_counts, const uint8_t* str_base,
+    const int64_t* str_off, const int64_t* str_len, const uint64_t* vv_rid,
+    const uint64_t* vv_val, const uint64_t* cl_rid, const uint64_t* cl_seq,
+    uint8_t* out, int64_t out_cap) {
+  Writer w{out, out + out_cap};
+  w.u8(3);
+  w.bytes(name, name_len);
+  w.varint(static_cast<uint64_t>(n_keys));
+  int64_t e = 0, s = 0, v = 0, c = 0;
+  for (int64_t k = 0; k < n_keys; k++) {
+    w.bytes(key_base + key_off[k], key_len[k]);
+    int64_t ne = counts[k * 3], nv = counts[k * 3 + 1], nc = counts[k * 3 + 2];
+    w.varint(static_cast<uint64_t>(ne));
+    for (int64_t i = 0; i < ne; i++, e++) {
+      w.varint(ent_rid[e]);
+      w.varint(ent_seq[e]);
+      int64_t np = path_counts[e];
+      w.varint(static_cast<uint64_t>(np));
+      for (int64_t j = 0; j <= np; j++, s++) {  // path parts, then token
+        w.bytes(str_base + str_off[s], str_len[s]);
+      }
+    }
+    w.varint(static_cast<uint64_t>(nv));
+    for (int64_t i = 0; i < nv; i++, v++) {
+      w.varint(vv_rid[v]);
+      w.varint(vv_val[v]);
+    }
+    w.varint(static_cast<uint64_t>(nc));
+    for (int64_t i = 0; i < nc; i++, c++) {
+      w.varint(cl_rid[c]);
+      w.varint(cl_seq[c]);
+    }
+  }
+  return w.ok ? (w.p - out) : -1;
+}
+
+// (UJSON decode lives in native/ujson_planes.cpp: the receive path
+// splits the body into lazy per-key payload spans instead of walking
+// every entry into flat arrays here.)
+
 }  // extern "C"

@@ -39,6 +39,25 @@ def _rand_gdict(rng: random.Random) -> dict:
     return {rng.randrange(1 << 63): _rand_u64(rng) for _ in range(rng.randrange(6))}
 
 
+def _rand_str(rng: random.Random) -> str:
+    return rng.choice(["", "a", "profile", "über", "名前", "x" * 40])
+
+
+def _rand_ujson(rng: random.Random):
+    from jylis_tpu.ops.ujson_host import UJSON
+
+    u = UJSON()
+    for _ in range(rng.randrange(4)):
+        dot = (rng.randrange(1 << 63), _rand_u64(rng))
+        path = tuple(_rand_str(rng) for _ in range(rng.randrange(3)))
+        u.entries[dot] = (path, _rand_str(rng))
+    for _ in range(rng.randrange(3)):
+        u.ctx.vv[rng.randrange(1 << 63)] = _rand_u64(rng)
+    for _ in range(rng.randrange(3)):
+        u.ctx.cloud.add((rng.randrange(1 << 63), _rand_u64(rng)))
+    return u
+
+
 def _rand_msg(rng: random.Random, name: str) -> MsgPushDeltas:
     batch = []
     for _ in range(rng.randrange(5)):
@@ -49,6 +68,8 @@ def _rand_msg(rng: random.Random, name: str) -> MsgPushDeltas:
             delta = (_rand_gdict(rng), _rand_gdict(rng))
         elif name == "TREG":
             delta = (_rand_key(rng), _rand_u64(rng))
+        elif name == "UJSON":
+            delta = _rand_ujson(rng)
         else:  # TLOG / SYSTEM
             entries = [
                 (_rand_key(rng), _rand_u64(rng))
@@ -59,7 +80,7 @@ def _rand_msg(rng: random.Random, name: str) -> MsgPushDeltas:
     return MsgPushDeltas(name, tuple(batch))
 
 
-NAMES = ["GCOUNT", "PNCOUNT", "TREG", "TLOG", "SYSTEM"]
+NAMES = ["GCOUNT", "PNCOUNT", "TREG", "TLOG", "SYSTEM", "UJSON"]
 
 
 @pytest.mark.parametrize("name", NAMES)
@@ -132,10 +153,13 @@ def test_oversize_values_fall_back_to_oracle():
 
 
 def test_empty_batch_and_empty_dicts():
+    from jylis_tpu.ops.ujson_host import UJSON
+
     for msg in [
         MsgPushDeltas("GCOUNT", ()),
         MsgPushDeltas("PNCOUNT", ((b"", ({}, {})),)),
         MsgPushDeltas("TLOG", ((b"k", ([], 0)),)),
+        MsgPushDeltas("UJSON", ((b"k", UJSON()),)),
     ]:
         fast = ncodec.encode_push(msg)
         assert fast == codec._encode_oracle(msg)
