@@ -17,9 +17,13 @@ thread via ``asyncio.to_thread`` so a multi-millisecond drain never
 stalls the event loop — other repos' commands, other client connections,
 and the cluster heartbeat all proceed. The per-repo lock is what the
 one-actor-per-type boundary becomes: every repo access (apply, cluster
-converge, heartbeat flush) serialises through it in FIFO order, so repo
-state is never touched concurrently with an offloaded drain, and
-per-repo command ordering is exactly the reference's. Replies from
+converge, heartbeat flush) serialises through it, so repo state is
+never touched concurrently with an offloaded drain. FIFO holds among
+lock-taking paths only — host-only commands take a lock-free inline
+fast path when no drain is active (see apply_async), which preserves
+per-connection order (the reference's guarantee) while
+cross-connection interleaving stays unordered as it always was.
+Replies from
 offloaded commands are buffered and replayed on the loop thread
 (transports are not thread-safe). The sync ``apply`` path remains for
 single-threaded callers (warmup, persistence restore, direct-drive
@@ -88,11 +92,26 @@ class RepoManager:
 
     async def apply_async(self, resp, cmd: list[bytes]) -> None:
         """Serving path: device-bound commands offload to a thread under
-        the repo lock; host-only commands run inline (still under the
-        lock, so they never race an offloaded drain)."""
+        the repo lock; host-only commands run inline.
+
+        Fast path: when the lock is free (a threaded drain ALWAYS holds
+        it, and releases only on the loop thread) and the command needs
+        no device offload, apply synchronously with no await at all —
+        the event loop is single-threaded, so the inline apply is atomic.
+        This can barge ahead of waiters queued on the lock, so per-repo
+        FIFO holds only among lock-taking paths; cross-connection
+        interleaving is unordered anyway (lattice ops commute) and
+        per-connection order is preserved by the server's sequential
+        awaits."""
         if self._shutdown:
             resp.err(SHUTDOWN_ERR)
             return
+        if not self._lock.locked():
+            may = getattr(self.repo, "may_drain", None)
+            if may is None or not may(cmd[1:]):
+                if self._apply_core(resp, cmd):
+                    self._maybe_proactive_flush()
+                return
         async with self._lock:
             if self._shutdown:
                 # shutdown won the lock race while we queued behind a
