@@ -31,6 +31,7 @@ guarantee the actor gave).
 from __future__ import annotations
 
 import asyncio
+import hashlib
 
 from ..ops.p2set import P2Set
 from ..utils.address import Address
@@ -52,12 +53,19 @@ ANNOUNCE_EVERY = 3  # cluster.pony:123-128
 # many ticks (re-establishment after any gap may have missed deltas —
 # fire-and-forget has no retransmit; see MsgSyncRequest)
 SYNC_REQUEST_COOLDOWN = 10
+# keys per MsgPushDeltas frame in a sync dump: a million-key type streams
+# as many bounded frames under writer backpressure instead of one frame
+# that trips the 16 MB kill limit or monopolises the peer's read loop
+SYNC_CHUNK_KEYS = 2048
 
 
 class _Conn:
     """One cluster TCP connection (either role), with its read task."""
 
-    __slots__ = ("writer", "active_addr", "established", "task", "sync_served")
+    __slots__ = (
+        "writer", "active_addr", "established", "task", "sync_served",
+        "sync_digest",
+    )
 
     def __init__(self, writer, active_addr: Address | None):
         self.writer = writer
@@ -65,6 +73,7 @@ class _Conn:
         self.established = False
         self.task: asyncio.Task | None = None
         self.sync_served = False  # one full-state sync per connection
+        self.sync_digest = b""  # the requester's data digest, if any
 
     # a peer that keeps ponging but stops reading would otherwise grow the
     # transport write buffer without bound
@@ -119,6 +128,11 @@ class Cluster:
         self._sync_req_tick: dict[Address, int] = {}  # rate limit per peer
         self._sync_waiters: list[_Conn] = []  # conns awaiting a sync dump
         self._sync_dump_inflight = False  # one dump task at a time
+        # (stamp, digest, frames): dump+digest cached against the
+        # database's mutation stamp, so a flapping peer's repeated
+        # requests cost one comparison, not one dump each — and an
+        # IN-SYNC peer costs nothing at all (digest match -> Pong)
+        self._sync_cache: tuple | None = None
 
     # ---- lifecycle --------------------------------------------------------
 
@@ -330,6 +344,7 @@ class Cluster:
                 self._send(conn, MsgPong())
                 return
             conn.sync_served = True
+            conn.sync_digest = msg.digest
             self._sync_waiters.append(conn)
             if self._sync_dump_inflight:
                 return  # the running dump task will serve this waiter too
@@ -350,33 +365,96 @@ class Cluster:
         per address. Covers both bootstrap (new node joins, gets
         everything) and partition heal (deltas pushed while we were
         unreachable are not retransmitted; the reference loses them
-        permanently — cluster.pony:250-252 converges only what arrives)."""
+        permanently — cluster.pony:250-252 converges only what arrives).
+        The request carries OUR data digest, so an up-to-date peer
+        answers with a Pong instead of re-shipping everything."""
         addr = conn.active_addr
         last = self._sync_req_tick.get(addr)
         if last is not None and self._tick - last < SYNC_REQUEST_COOLDOWN:
             return
         self._sync_req_tick[addr] = self._tick
-        self._send(conn, MsgSyncRequest())
+        task = asyncio.get_running_loop().create_task(self._request_sync(conn))
+        self._flush_tasks.add(task)
+        task.add_done_callback(self._flush_task_done)
+
+    async def _request_sync(self, conn: _Conn) -> None:
+        digest, _frames = await self._sync_payload(want_frames=False)
+        self._send(conn, MsgSyncRequest(digest))
+
+    DATA_TYPES = ("TREG", "TLOG", "GCOUNT", "PNCOUNT", "UJSON")
+
+    async def _sync_payload(self, want_frames: bool):
+        """(digest, frames|None) of the current DATA state, cached
+        against the database's mutation stamp. The digest covers the
+        five data types only: SYSTEM's log advances on connection events
+        themselves, so including it would make two in-sync peers never
+        match (it streams fresh per dump instead). Frames are chunked at
+        SYNC_CHUNK_KEYS keys so a huge keyspace streams bounded pieces
+        under backpressure; with want_frames=False (the request path
+        needs only the 32-byte digest) the encoded bytes are hashed and
+        discarded, never retained."""
+        stamp = self._database.stamp
+        cached = self._sync_cache
+        if cached is not None and cached[0] == stamp:
+            if not want_frames or cached[2] is not None:
+                return cached[1], cached[2]
+        dump = await self._database.dump_state_async(names=self.DATA_TYPES)
+
+        def build():
+            frames = [] if want_frames else None
+            h = hashlib.sha256()
+            for name, batch in dump:
+                batch = tuple(batch)
+                chunks = [
+                    batch[i : i + SYNC_CHUNK_KEYS]
+                    for i in range(0, len(batch), SYNC_CHUNK_KEYS)
+                ] or [()]
+                for chunk in chunks:
+                    data = codec.encode(MsgPushDeltas(name, chunk))
+                    h.update(data)
+                    if frames is not None:
+                        frames.append(frame(data))
+            return h.digest(), frames
+
+        digest, frames = await asyncio.to_thread(build)
+        self._sync_cache = (stamp, digest, frames)
+        return digest, frames
+
+    async def _system_frames(self) -> list[bytes]:
+        """The SYSTEM log as sync frames, dumped fresh (it is tiny —
+        trimmed to ~200 entries — and deliberately outside the digest, so
+        a digest-matched peer still recovers log lines it missed)."""
+        dump = await self._database.dump_state_async(names=("SYSTEM",))
+        return [
+            frame(codec.encode(MsgPushDeltas(name, tuple(batch))))
+            for name, batch in dump
+        ]
 
     async def _serve_syncs(self) -> None:
         """Drain the sync-waiter queue: ONE full dump (encoded off the
         event loop) serves every queued requester, with writer.drain()
         between frames so a large state streams under backpressure
-        instead of tripping the 16 MB kill limit mid-sync."""
+        instead of tripping the 16 MB kill limit mid-sync. A requester
+        whose digest matches ours gets the (tiny) SYSTEM frames and a
+        Pong — zero data frames."""
         try:
             while self._sync_waiters:
                 waiters, self._sync_waiters = self._sync_waiters, []
-                dump = await self._database.dump_state_async()
-                frames = await asyncio.to_thread(
-                    lambda: [
-                        frame(codec.encode(MsgPushDeltas(name, tuple(batch))))
-                        for name, batch in dump
-                    ]
-                )
+                digest, frames = await self._sync_payload(want_frames=True)
+                sys_frames = await self._system_frames()
                 for conn in waiters:
-                    await self._stream_sync(conn, frames)
+                    if conn.sync_digest and conn.sync_digest == digest:
+                        await self._stream_sync(conn, sys_frames)
+                        continue
+                    await self._stream_sync(conn, frames + sys_frames)
         finally:
             self._sync_dump_inflight = False
+            # the encoded data frames are a full copy of the keyspace;
+            # keep only the digest between sync bursts
+            if self._sync_cache is not None:
+                self._sync_cache = (
+                    self._sync_cache[0], self._sync_cache[1], None,
+                )
 
     async def _stream_sync(self, conn: _Conn, frames: list[bytes]) -> None:
         for data in frames:

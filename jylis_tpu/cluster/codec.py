@@ -47,7 +47,7 @@ from .msg import (
     MsgSyncRequest,
 )
 
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 
 # The canonical schema text: any change to the wire format MUST change this
 # string (bump SCHEMA_VERSION), which changes the signature, which makes
@@ -60,7 +60,7 @@ msg0=Pong
 msg1=ExchangeAddrs(p2set)
 msg2=AnnounceAddrs(p2set)
 msg3=PushDeltas(name:str batch:[(key:bytes delta)])
-msg4=SyncRequest
+msg4=SyncRequest(digest:bytes)
 delta/TREG=(value:bytes ts:varint)
 delta/TLOG=delta/SYSTEM=(entries:[(value:bytes ts:varint)] cutoff:varint)
 delta/GCOUNT=[(rid:varint v:varint)]
@@ -258,6 +258,7 @@ def _encode_oracle(msg: Msg) -> bytes:
             _w_delta(out, msg.name, delta)
     elif isinstance(msg, MsgSyncRequest):
         out.append(_TAG_SYNC_REQ)
+        _w_bytes(out, msg.digest)
     else:
         raise CodecError(f"cannot encode {type(msg).__name__}")
     return bytes(out)
@@ -292,7 +293,7 @@ def _decode_oracle(body: bytes) -> Msg:
         )
         msg = MsgPushDeltas(name, batch)
     elif tag == _TAG_SYNC_REQ:
-        msg = MsgSyncRequest()
+        msg = MsgSyncRequest(r.bytes_())
     else:
         raise CodecError(f"unknown message tag: {tag}")
     if not r.done():

@@ -50,9 +50,18 @@ class MsgSyncRequest:
     """Bootstrap/rejoin full-state sync (beyond the reference, which can
     permanently miss deltas flushed while a peer was away —
     cluster.pony:250-252 converges only what is pushed). The requester
-    sends this after establishing an active connection; the peer replies
-    with its full state as ordinary MsgPushDeltas batches (the snapshot
-    wire shape, persist.py), which converge idempotently."""
+    sends this after establishing an active connection WITH its own
+    data-state digest; a peer whose digest matches replies MsgPong (the
+    requester is already in sync — a flapping connection re-ships
+    nothing), otherwise with its full state as chunked MsgPushDeltas
+    batches (the snapshot wire shape, persist.py), which converge
+    idempotently.
+
+    digest: sha256 over the canonical encoded per-type dumps of the five
+    DATA types (SYSTEM excluded — its log advances on connection events
+    themselves, which would make two in-sync peers never match)."""
+
+    digest: bytes = b""
 
 
 Msg = MsgPong | MsgExchangeAddrs | MsgAnnounceAddrs | MsgPushDeltas | MsgSyncRequest

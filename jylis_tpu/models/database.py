@@ -27,6 +27,9 @@ class Database:
         # ONE native engine shared by both counter repos AND the server's
         # batch applier (server/server.py): single source of host truth
         self.native_engine = make_engine()
+        # monotone data-mutation stamp: bumped on every state-changing
+        # apply/converge; the cluster's sync digest caches against it
+        self.stamp = 0
         self._map: dict[bytes, RepoManager] = {}
         for repo in (
             RepoTREG(identity),
@@ -36,7 +39,17 @@ class Database:
             RepoUJSON(identity),
             self.system,
         ):
-            self._map[repo.name.encode()] = RepoManager(repo.name, repo, repo.help)
+            # SYSTEM is excluded from the stamp: its keepalive delta ships
+            # every heartbeat (deltas_size()==1 quirk), which would bump
+            # the stamp continuously and defeat the sync-digest cache —
+            # and the sync path streams SYSTEM fresh each time anyway
+            bump = None if repo is self.system else self._bump
+            self._map[repo.name.encode()] = RepoManager(
+                repo.name, repo, repo.help, on_change=bump
+            )
+
+    def _bump(self) -> None:
+        self.stamp += 1
 
     def manager(self, name: str) -> RepoManager:
         return self._map[name.encode()]
@@ -84,14 +97,18 @@ class Database:
         for mgr in self._map.values():
             mgr.repo.drain()
 
-    async def dump_state_async(self):
+    async def dump_state_async(self, names=None):
         """Full state per type for the cluster sync path: [(name, batch)].
         Each repo dumps under its own lock with device touches in a
         worker thread, so serving stalls only per-type and briefly —
         unlike the shutdown snapshot, no cross-repo atomicity is needed
-        (the receiver's lattice join absorbs any in-between writes)."""
+        (the receiver's lattice join absorbs any in-between writes).
+        ``names`` restricts the dump (the sync digest covers data types
+        only; SYSTEM streams separately)."""
         out = []
         for mgr in self._map.values():
+            if names is not None and mgr.name not in names:
+                continue
             async with mgr._lock:
                 batch = await asyncio.to_thread(mgr.repo.dump_state)
             out.append((mgr.name, batch))

@@ -136,8 +136,10 @@ class Server:
                     if replies:
                         writer.write(replies)
                     if ch_g:
+                        g_mgr._on_change()
                         g_mgr._maybe_proactive_flush()
                     if ch_pn:
+                        pn_mgr._on_change()
                         pn_mgr._maybe_proactive_flush()
             del buf[:consumed]
             if rc == 1:  # one command for the Python path, in order
