@@ -372,14 +372,20 @@ class Cluster:
         last = self._sync_req_tick.get(addr)
         if last is not None and self._tick - last < SYNC_REQUEST_COOLDOWN:
             return
-        self._sync_req_tick[addr] = self._tick
         task = asyncio.get_running_loop().create_task(self._request_sync(conn))
         self._flush_tasks.add(task)
         task.add_done_callback(self._flush_task_done)
 
     async def _request_sync(self, conn: _Conn) -> None:
         digest, _frames = await self._sync_payload(want_frames=False)
+        # the digest computation above can take a while on a big
+        # keyspace; record the cooldown only once the request is really
+        # on the wire — a conn that died in between must not suppress
+        # the retry on the re-established connection
+        if conn.writer is None or conn.writer.transport.is_closing():
+            return
         self._send(conn, MsgSyncRequest(digest))
+        self._sync_req_tick[conn.active_addr] = self._tick
 
     DATA_TYPES = ("TREG", "TLOG", "GCOUNT", "PNCOUNT", "UJSON")
 
@@ -404,6 +410,17 @@ class Cluster:
             frames = [] if want_frames else None
             h = hashlib.sha256()
             for name, batch in dump:
+                if name == "TLOG":
+                    # equal-timestamp entries order by interner-local ids
+                    # on device, which differ across nodes; canonicalise
+                    # ties by value so converged peers digest-match
+                    # (converge is order-insensitive, so the frames may
+                    # ship this order too)
+                    batch = [
+                        (key, (sorted(entries, key=lambda e: (e[1], e[0])),
+                               cutoff))
+                        for key, (entries, cutoff) in batch
+                    ]
                 batch = tuple(batch)
                 chunks = [
                     batch[i : i + SYNC_CHUNK_KEYS]

@@ -75,6 +75,20 @@ def signature() -> bytes:
     return hashlib.sha256(_SCHEMA_TEXT.encode()).digest()
 
 
+def delta_signature() -> bytes:
+    """Identity of the PER-TYPE DELTA encodings only (the lines of the
+    schema snapshots actually contain). Snapshots are versioned by THIS,
+    not the full transport signature: a transport-message change (like
+    the v3 sync-request digest) must not invalidate every snapshot on
+    disk when the delta bytes it stores are unchanged."""
+    delta_lines = [
+        line
+        for line in _SCHEMA_TEXT.splitlines()
+        if line.startswith("delta/") or line.startswith("varint=")
+    ]
+    return hashlib.sha256("\n".join(delta_lines).encode()).digest()
+
+
 # the reader primitives live in utils/wire.py (shared with the lazy wire
 # objects in ops/ujson_wire.py); a WireError IS this module's CodecError
 CodecError = WireError

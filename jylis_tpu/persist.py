@@ -10,9 +10,11 @@ just converging the batches back in, so restore composes correctly with
 anything that happened meanwhile: load a stale snapshot into a live node
 and the lattice join sorts it out — no log replay, no ordering concerns.
 
-File format: magic, the codec schema signature (a snapshot from an
-incompatible build is refused the same way an incompatible peer is), then
-one framed MsgPushDeltas per data type.
+File format: magic, the codec DELTA-schema signature (a snapshot whose
+per-type delta encodings are incompatible is refused, but transport-only
+schema bumps — new message kinds, handshake changes — keep old snapshots
+loadable: they contain only delta frames), then one framed MsgPushDeltas
+per data type.
 """
 
 from __future__ import annotations
@@ -42,7 +44,7 @@ def write_snapshot(batches, path: str) -> None:
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         f.write(MAGIC)
-        f.write(codec.signature())
+        f.write(codec.delta_signature())
         for name, batch in batches:
             f.write(frame(codec.encode(MsgPushDeltas(name, tuple(batch)))))
     os.replace(tmp, path)
@@ -64,8 +66,8 @@ def load_snapshot(database, path: str) -> int:
         raise SnapshotError(f"cannot read snapshot: {e}") from None
     if blob[: len(MAGIC)] != MAGIC:
         raise SnapshotError("not a snapshot file")
-    sig_end = len(MAGIC) + len(codec.signature())
-    if blob[len(MAGIC) : sig_end] != codec.signature():
+    sig_end = len(MAGIC) + len(codec.delta_signature())
+    if blob[len(MAGIC) : sig_end] != codec.delta_signature():
         raise SnapshotError("snapshot schema signature mismatch")
     # snapshots are read whole from local disk: no adversarial peer to
     # bound against, so lift the wire-oriented frame cap
