@@ -29,6 +29,23 @@ here so the next kernel doesn't rediscover them:
 * Block shapes must divide the operand; the flat (N/128, 128) view only
   exists when N % 128 == 0 (callers guarantee power-of-two R).
 
+**Round-4 decision (verdict item 7): this module is kept as a measured
+baseline ONLY, and the TLOG sort is explicitly NOT getting a Pallas
+kernel.** The TLOG merge's ceiling is its sort network, and the one
+hypothesis under which manual scheduling could win — a fused
+merge+dedup single pass — loses to the same physics this kernel
+measured: ``lax.sort`` keeps each row resident in VMEM across all
+compare-exchange stages, while a hand-staged Pallas network at TLOG's
+ragged row widths (bucketed 16..64k, many live shapes) would stream
+HBM between stages it cannot keep resident, and round-1's layout
+measurements put HBM-staged exchange at 40-70x slower than the fused
+XLA sort. The dedup fusion saves one elementwise pass over data the
+sort already bounds — marginal against a sort-dominated profile, and
+against it every TLOG width bucket would need its own hand-tuned
+block shape. The recorded `pallas-join` bench config (BENCH_full.json,
+0.3x vs the XLA dense join) stays as the standing quantitative
+evidence for this class of decision.
+
 Reference analog: none — the reference's merge loop is per-key Pony
 (repo_pncount.pony:59-62); this is purely a TPU-side design artifact.
 """
