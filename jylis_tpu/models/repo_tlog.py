@@ -115,8 +115,11 @@ class RepoTLOG:
         # quiescent GETs never dispatch to the device (the counter repos'
         # host-shadow pattern, repo_counters.py)
         self._render: dict[int, list[tuple[int, bytes]]] = {}
-        # row -> ((pend_len, cutoff), merged list): read-time merge memo
-        self._merged: dict[int, tuple] = {}
+        # row -> [(pend_len, cutoff), merged SET, sorted list|None]: the
+        # read-time merge memo; local inserts extend the set in place
+        # (_note_local_insert), SIZE reads len(set), GET materialises the
+        # (ts, value)-desc list lazily
+        self._merged: dict[int, list] = {}
         # row -> (entries [(ts, value)], incoming-delta cutoff)
         self._pend_entries: dict[int, list[tuple[int, bytes]]] = {}
         self._pend_cutoff: dict[int, int] = {}
@@ -167,6 +170,7 @@ class RepoTLOG:
             row = self._row_for(key)
             lst = self._pend_entries.setdefault(row, [])
             lst.append((ts, value))
+            self._note_local_insert(row, ts, value)
             if ts >= self._cut_cache.get(row, 0):
                 self._delta_for(key).insert(value, ts)
             if (
@@ -183,7 +187,7 @@ class RepoTLOG:
             elif self._quiescent(row):
                 resp.u64(self._len_cache.get(row, 0))  # O(1), no gather
             else:
-                resp.u64(len(self._merged_view(row)[0]))
+                resp.u64(len(self._merged_set(row)))  # O(1) on cache hit
             return False
         if op == b"CUTOFF":
             row = self._keys.get(need(args, 1))
@@ -235,29 +239,57 @@ class RepoTLOG:
             row
         ) == self._cut_cache.get(row, 0)
 
-    def _merged_view(self, row: int) -> tuple[list[tuple[int, bytes]], int]:
-        """The exact log as a drain would leave it — drained ∪ pending,
-        deduped (equal ts AND value), cutoff-filtered, (ts, value) desc —
-        computed on the host: reads NEVER pay a device drain (at most one
-        row gather for the drained base). The lattice merge is a set
-        union, so the host and device merges agree exactly
-        (tlog.md:116-133 semantics). Merges memoise on the pending state,
-        so read-heavy bursts between writes pay one merge, not one per
-        read."""
+    def _merged_set(self, row: int) -> set:
+        """The merged log as a SET — drained ∪ pending, deduped (equal ts
+        AND value), cutoff-filtered. The cache entry is a mutable
+        ``[state, set, sorted_list|None]``: local inserts extend the set
+        incrementally (the INS hot path), SIZE reads its len in O(1), and
+        the (ts, value)-desc list materialises lazily only when a GET
+        actually needs order. The lattice merge is a set union, so the
+        host and device merges agree exactly (tlog.md:116-133)."""
         cut = self._cutoff_view(row)
-        if self._quiescent(row):
-            return self._drained_entries(row), cut
         state = (len(self._pend_entries.get(row, ())), cut)
         hit = self._merged.get(row)
         if hit is not None and hit[0] == state:
-            return hit[1], cut
+            return hit[1]
         base = self._drained_entries(row)
         pend = self._pend_entries.get(row)
         merged = {e for e in base if e[0] >= cut}
         merged.update(e for e in pend or () if e[0] >= cut)
-        out = sorted(merged, reverse=True)
-        self._merged[row] = (state, out)
-        return out, cut
+        self._merged[row] = [state, merged, None]
+        return merged
+
+    def _merged_view(self, row: int) -> tuple[list[tuple[int, bytes]], int]:
+        """The exact log as a drain would leave it, (ts, value) desc —
+        computed on the host: reads NEVER pay a device drain (at most one
+        row gather for the drained base)."""
+        cut = self._cutoff_view(row)
+        if self._quiescent(row):
+            return self._drained_entries(row), cut
+        self._merged_set(row)
+        hit = self._merged[row]
+        if hit[2] is None:
+            hit[2] = sorted(hit[1], reverse=True)
+        return hit[2], cut
+
+    def _note_local_insert(self, row: int, ts: int, value: bytes) -> None:
+        """Keep the merged cache exact across a local INS without a
+        rebuild: the entry joins the set (dedup by membership) and the
+        sorted list invalidates lazily. Anything else (stale state)
+        drops the cache."""
+        hit = self._merged.get(row)
+        if hit is None:
+            return
+        cut = self._cutoff_view(row)
+        if hit[0] != (len(self._pend_entries[row]) - 1, cut):
+            self._merged.pop(row, None)
+            return
+        if ts >= cut:
+            e = (ts, value)
+            if e not in hit[1]:
+                hit[1].add(e)
+                hit[2] = None  # order dirty; rebuilt on next GET
+        hit[0] = (len(self._pend_entries[row]), cut)
 
     def _cmd_get(self, resp, key: bytes, count: int) -> None:
         row = self._keys.get(key)
