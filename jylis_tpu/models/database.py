@@ -20,23 +20,30 @@ from .repo_ujson import RepoUJSON
 
 
 class Database:
-    def __init__(self, identity: int, system_repo: RepoSYSTEM | None = None):
-        from ..native.engine import make_engine
+    def __init__(
+        self,
+        identity: int,
+        system_repo: RepoSYSTEM | None = None,
+        engine: str = "auto",
+    ):
+        from ..native.engine import resolve_engine
 
         self.system = system_repo if system_repo is not None else RepoSYSTEM(identity)
-        # ONE native engine shared by both counter repos AND the server's
-        # batch applier (server/server.py): single source of host truth
-        self.native_engine = make_engine()
+        # ONE native engine shared by every data repo AND the server's
+        # batch applier (server/server.py): single source of host truth.
+        # engine="python" pins the pure-Python table backends everywhere
+        # (differential tests compare the two whole stacks).
+        self.native_engine = resolve_engine(engine)
         # monotone data-mutation stamp: bumped on every state-changing
         # apply/converge; the cluster's sync digest caches against it
         self.stamp = 0
         self._map: dict[bytes, RepoManager] = {}
         for repo in (
-            RepoTREG(identity),
-            RepoTLOG(identity),
+            RepoTREG(identity, engine=self.native_engine),
+            RepoTLOG(identity, engine=self.native_engine),
             RepoGCOUNT(identity, engine=self.native_engine),
             RepoPNCOUNT(identity, engine=self.native_engine),
-            RepoUJSON(identity),
+            RepoUJSON(identity, engine=self.native_engine),
             self.system,
         ):
             # SYSTEM is excluded from the stamp: its keepalive delta ships

@@ -151,6 +151,12 @@ class RepoManager:
 
     async def flush_async(self, fn) -> None:
         async with self._lock:
+            # repos with banked native-queue work drain it in a worker
+            # thread first (it can touch the device); the loop-side delta
+            # flush then sees fully-applied state
+            prep = getattr(self.repo, "prepare_flush", None)
+            if prep is not None:
+                await asyncio.to_thread(prep)
             self.flush_deltas(fn)
 
     def busy(self) -> bool:
@@ -163,6 +169,9 @@ class RepoManager:
         then stops intake and performs the final flush atomically."""
         self._shutdown = True  # reject commands queued behind the lock
         async with self._lock:
+            prep = getattr(self.repo, "prepare_flush", None)
+            if prep is not None:  # banked native-queue writes must ship
+                await asyncio.to_thread(prep)
             if self._deltas_fn is not None:
                 self.flush_deltas(self._deltas_fn)
 
@@ -195,5 +204,8 @@ class RepoManager:
 
     def clean_shutdown(self) -> None:
         self._shutdown = True
+        prep = getattr(self.repo, "prepare_flush", None)
+        if prep is not None:
+            prep()
         if self._deltas_fn is not None:
             self.flush_deltas(self._deltas_fn)

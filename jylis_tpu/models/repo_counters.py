@@ -31,7 +31,7 @@ from functools import partial
 import jax
 import numpy as np
 
-from ..native.engine import G as ENG_G, PN as ENG_PN, make_engine
+from ..native.engine import G as ENG_G, PN as ENG_PN, resolve_engine
 from ..ops import gcount, planes, pncount
 from ..parallel import (
     drain_sharded_g,
@@ -113,11 +113,7 @@ class _CounterRepo:
         self._n_shards = self._mesh.devices.size if self._mesh is not None else 1
         self._key_cap = self._round_cap(key_cap)
         self._rep_cap = rep_cap
-        if engine == "auto":
-            engine = make_engine()
-        elif engine == "python":
-            engine = None
-        self.engine = engine  # shared across both counter repos when set
+        self.engine = engine = resolve_engine(engine)  # shared when set
         self._tbl = (
             NativeTable(engine, self._which) if engine is not None else PyTable()
         )

@@ -6,12 +6,19 @@ insertion). Here the keyspace is the padded ops/tlog plane block (narrow
 incoming delta logs buffer host-side per key and drain as ONE batched
 merge dispatch at write thresholds and snapshots — TRIM/TRIMAT/CLR fuse
 into that same dispatch (the kernel's per-row count column), and their
-returned (length, cutoff) pairs maintain the host caches. Reads never drain: GET/SIZE/CUTOFF serve the exact merged
-view (_merged_view — union + dedup + cutoff filter over the drained
-render cache and the pending buffer, memoised per row); the only device
-touch a read can make is the one-row gather that rebuilds the render
-base after a drain or trim, and a quiescent read performs zero device
-calls.
+returned (length, cutoff) pairs maintain the host caches. Reads never
+drain: GET/SIZE/CUTOFF serve the exact merged view (union + dedup +
+cutoff filter over the drained base and the pending buffer, memoised per
+row); the only device touch a read can make is the one-row gather that
+rebuilds the render base after a drain whose merged view was not
+current, and a quiescent read performs zero device calls.
+
+Host bookkeeping (keys, pending windows, length/cutoff caches, the
+merged-view memo, delta accumulators) lives behind the table backends in
+tlog_table.py: pure-Python as the oracle, or the native C++ engine — the
+SAME state the server's native batch applier (native/serve_engine.cpp)
+mutates, so INS/SIZE settled natively and Python-side drains/flushes
+share one source of truth.
 
 Delta wire shape: (entries: list[(value: bytes, ts: u64)], cutoff: u64).
 """
@@ -21,7 +28,8 @@ from __future__ import annotations
 import jax
 import numpy as np
 
-from ..ops import hostref, tlog
+from ..native.engine import resolve_engine
+from ..ops import tlog
 from ..ops.interner import Interner
 from ..parallel import (
     drain_sharded_tlog,
@@ -31,14 +39,14 @@ from ..parallel import (
     shard_vec,
 )
 from .base import PAD_ROW, ParseError, bucket, need, parse_opt_count, parse_u64
+from .tlog_table import (
+    NativeTlogTable,
+    PENDING_DRAIN_THRESHOLD,
+    PyTlogTable,
+    ROW_DRAIN_THRESHOLD,
+)
 from ..utils.metrics import timed_drain
 from .help import RepoHelp
-
-# pending work flushes to the device at these sizes: reads never need a
-# drain (the merged view computes host-side), so the thresholds bound
-# host memory while keeping device batches large
-ROW_DRAIN_THRESHOLD = 1024  # entries pending on one row
-PENDING_DRAIN_THRESHOLD = 4096  # rows with pending work
 
 # interner compaction: once the table holds this many more ids than live
 # log entries, rebuild it from the live set (ops/interner.compact) so
@@ -90,10 +98,14 @@ class RepoTLOG:
     help = TLOG_HELP
 
     def __init__(
-        self, identity: int, key_cap: int = 1024, len_cap: int = 16, mesh="auto"
+        self,
+        identity: int,
+        key_cap: int = 1024,
+        len_cap: int = 16,
+        mesh="auto",
+        engine="auto",
     ):
         # identity unused: log entries carry no replica identity
-        self._keys: dict[bytes, int] = {}
         # mesh mode mirrors the counter/TREG repos: with >1 visible device
         # the segment tensors live keys-sharded and drains/trims route
         # through parallel/sharded
@@ -108,23 +120,17 @@ class RepoTLOG:
             tlog.init(self._key_cap, len_cap, wide=self._mesh is not None)
         )
         self._interner = Interner()
-        self._len_cache: dict[int, int] = {}  # row -> length
-        self._cut_cache: dict[int, int] = {}  # row -> cutoff
-        # row -> desc-sorted [(ts, value)], the rendered GET view; built on
-        # first read, dropped whenever a drain or trim touches the row — so
-        # quiescent GETs never dispatch to the device (the counter repos'
-        # host-shadow pattern, repo_counters.py)
+        self.engine = engine = resolve_engine(engine)
+        self._tbl = (
+            NativeTlogTable(engine) if engine is not None else PyTlogTable()
+        )
+        # row -> desc-sorted [(ts, value)], the rendered drained part; built
+        # on first read, dropped whenever a drain or trim touches the row —
+        # so quiescent GETs never dispatch to the device
         self._render: dict[int, list[tuple[int, bytes]]] = {}
-        # row -> [(pend_len, cutoff), merged SET, sorted list|None]: the
-        # read-time merge memo; local inserts extend the set in place
-        # (_note_local_insert), SIZE reads len(set), GET materialises the
-        # (ts, value)-desc list lazily
-        self._merged: dict[int, list] = {}
-        # row -> (entries [(ts, value)], incoming-delta cutoff)
-        self._pend_entries: dict[int, list[tuple[int, bytes]]] = {}
-        self._pend_cutoff: dict[int, int] = {}
-        self._row_overdue = False  # some row crossed ROW_DRAIN_THRESHOLD
-        self._deltas: dict[bytes, hostref.TLog] = {}
+        # row -> (table gen, desc-sorted merged list): the GET-order memo
+        # over the table's merged view
+        self._sorted: dict[int, tuple[int, list[tuple[int, bytes]]]] = {}
 
     def _round_cap(self, k: int) -> int:
         """Key capacity must split evenly over the mesh's keys axis."""
@@ -143,19 +149,6 @@ class RepoTLOG:
             shard_vec(self._mesh, state.cutoff),
         )
 
-    def _row_for(self, key: bytes) -> int:
-        row = self._keys.get(key)
-        if row is None:
-            row = len(self._keys)
-            self._keys[key] = row
-        return row
-
-    def _delta_for(self, key: bytes) -> hostref.TLog:
-        d = self._deltas.get(key)
-        if d is None:
-            d = self._deltas[key] = hostref.TLog()
-        return d
-
     # -- commands (repo_tlog.pony:29-111) ----------------------------------
 
     def apply(self, resp, args: list[bytes]) -> bool:
@@ -167,31 +160,27 @@ class RepoTLOG:
             key = need(args, 1)
             value = need(args, 2)
             ts = parse_u64(need(args, 3))
-            row = self._row_for(key)
-            lst = self._pend_entries.setdefault(row, [])
-            lst.append((ts, value))
-            self._note_local_insert(row, ts, value)
-            if ts >= self._cut_cache.get(row, 0):
-                self._delta_for(key).insert(value, ts)
+            row = self._tbl.upsert(key)
+            self._tbl.ins(row, ts, value)
             if (
-                len(lst) >= ROW_DRAIN_THRESHOLD
-                or len(self._pend_entries) >= PENDING_DRAIN_THRESHOLD
+                self._tbl.pend_len(row) >= ROW_DRAIN_THRESHOLD
+                or self._tbl.pend_rows_count() >= PENDING_DRAIN_THRESHOLD
             ):
                 self.drain()
             resp.ok()
             return True
         if op == b"SIZE":
-            row = self._keys.get(need(args, 1))
-            if row is None:
+            row = self._tbl.find(need(args, 1))
+            if row < 0:
                 resp.u64(0)
-            elif self._quiescent(row):
-                resp.u64(self._len_cache.get(row, 0))  # O(1), no gather
+            elif self._tbl.quiescent(row):
+                resp.u64(self._tbl.len_cache(row))  # O(1), no gather
             else:
-                resp.u64(len(self._merged_set(row)))  # O(1) on cache hit
+                resp.u64(self._size_nonquiescent(row))
             return False
         if op == b"CUTOFF":
-            row = self._keys.get(need(args, 1))
-            resp.u64(self._cutoff_view(row) if row is not None else 0)
+            row = self._tbl.find(need(args, 1))
+            resp.u64(self._tbl.cutoff_view(row) if row >= 0 else 0)
             return False
         if op == b"TRIMAT":
             key = need(args, 1)
@@ -212,88 +201,61 @@ class RepoTLOG:
         raise ParseError()
 
     def _drained_entries(self, row: int) -> list[tuple[int, bytes]]:
-        """The drained part of a row, (ts, value) desc — the render cache,
-        rebuilt from ONE device row gather when a drain/trim dropped it."""
+        """The drained part of a row, (ts, value) desc — the render cache.
+        A miss serves from the table's carried base when it is valid (the
+        common case: the drain kept the exact row content host-side); only
+        a base-invalid row pays the ONE device row gather."""
         ents = self._render.get(row)
         if ents is None:
-            length = self._len_cache.get(row, 0)
+            length = self._tbl.len_cache(row)
             if length == 0:
                 ents = []
             else:
-                ts_row, vid_row = _get_row(self._state, row)
-                ts_row = np.asarray(ts_row)
-                vid_row = np.asarray(vid_row)
-                ents = [
-                    (int(ts_row[i]), self._interner.lookup(int(vid_row[i])))
-                    for i in range(length)
-                ]
-                ents.sort(reverse=True)
+                base = self._tbl.base_entries(row)
+                if base is not None:
+                    ents = sorted(base, reverse=True)
+                else:
+                    ts_row, vid_row = _get_row(self._state, row)
+                    ts_row = np.asarray(ts_row)
+                    vid_row = np.asarray(vid_row)
+                    ents = [
+                        (int(ts_row[i]), self._interner.lookup(int(vid_row[i])))
+                        for i in range(length)
+                    ]
+                    ents.sort(reverse=True)
             self._render[row] = ents
         return ents
 
-    def _cutoff_view(self, row: int) -> int:
-        return max(self._cut_cache.get(row, 0), self._pend_cutoff.get(row, 0))
-
-    def _quiescent(self, row: int) -> bool:
-        return row not in self._pend_entries and self._cutoff_view(
-            row
-        ) == self._cut_cache.get(row, 0)
-
-    def _merged_set(self, row: int) -> set:
-        """The merged log as a SET — drained ∪ pending, deduped (equal ts
-        AND value), cutoff-filtered. The cache entry is a mutable
-        ``[state, set, sorted_list|None]``: local inserts extend the set
-        incrementally (the INS hot path), SIZE reads its len in O(1), and
-        the (ts, value)-desc list materialises lazily only when a GET
-        actually needs order. The lattice merge is a set union, so the
-        host and device merges agree exactly (tlog.md:116-133)."""
-        cut = self._cutoff_view(row)
-        state = (len(self._pend_entries.get(row, ())), cut)
-        hit = self._merged.get(row)
-        if hit is not None and hit[0] == state:
-            return hit[1]
-        base = self._drained_entries(row)
-        pend = self._pend_entries.get(row)
-        merged = {e for e in base if e[0] >= cut}
-        merged.update(e for e in pend or () if e[0] >= cut)
-        self._merged[row] = [state, merged, None]
-        return merged
+    def _size_nonquiescent(self, row: int) -> int:
+        """Merged-view size with the drained-base handshake: the table
+        serves it host-side unless its base is unknown (a drain landed
+        while the merged memo was stale), in which case ONE device row
+        gather rebuilds it."""
+        n = self._tbl.size(row)
+        if n < 0:
+            self._tbl.set_base(row, self._drained_entries(row))
+            n = self._tbl.size(row)
+        return n
 
     def _merged_view(self, row: int) -> tuple[list[tuple[int, bytes]], int]:
         """The exact log as a drain would leave it, (ts, value) desc —
         computed on the host: reads NEVER pay a device drain (at most one
         row gather for the drained base)."""
-        cut = self._cutoff_view(row)
-        if self._quiescent(row):
+        cut = self._tbl.cutoff_view(row)
+        if self._tbl.quiescent(row):
             return self._drained_entries(row), cut
-        self._merged_set(row)
-        hit = self._merged[row]
-        if hit[2] is None:
-            hit[2] = sorted(hit[1], reverse=True)
-        return hit[2], cut
-
-    def _note_local_insert(self, row: int, ts: int, value: bytes) -> None:
-        """Keep the merged cache exact across a local INS without a
-        rebuild: the entry joins the set (dedup by membership) and the
-        sorted list invalidates lazily. Anything else (stale state)
-        drops the cache."""
-        hit = self._merged.get(row)
-        if hit is None:
-            return
-        cut = self._cutoff_view(row)
-        if hit[0] != (len(self._pend_entries[row]) - 1, cut):
-            self._merged.pop(row, None)
-            return
-        if ts >= cut:
-            e = (ts, value)
-            if e not in hit[1]:
-                hit[1].add(e)
-                hit[2] = None  # order dirty; rebuilt on next GET
-        hit[0] = (len(self._pend_entries[row]), cut)
+        self._size_nonquiescent(row)  # ensure the merged memo is current
+        gen = self._tbl.gen(row)
+        hit = self._sorted.get(row)
+        if hit is not None and hit[0] == gen:
+            return hit[1], cut
+        ents = sorted(self._tbl.merged_entries(row), reverse=True)
+        self._sorted[row] = (gen, ents)
+        return ents, cut
 
     def _cmd_get(self, resp, key: bytes, count: int) -> None:
-        row = self._keys.get(key)
-        if row is None:
+        row = self._tbl.find(key)
+        if row < 0:
             resp.array_start(0)
             return
         ents, _cut = self._merged_view(row)
@@ -310,20 +272,20 @@ class RepoTLOG:
         cutoff in the same lattice op ((S ⊔ P) ⊔ C == S ⊔ (P ⊔ C)), so the
         old drain-set-drain double dispatch was pure overhead (VERDICT r2
         weak item 6)."""
-        row = self._row_for(key)
-        self._pend_cutoff[row] = max(self._pend_cutoff.get(row, 0), ts)
+        row = self._tbl.upsert(key)
+        self._tbl.converge_cutoff(row, ts)
         self.drain()
-        self._delta_for(key).raise_cutoff(self._cut_cache.get(row, 0))
+        self._tbl.delta_raise_cutoff(row, self._tbl.cut_cache(row))
 
     def _device_trim(self, key: bytes, count: int) -> None:
         """TRIM/CLR: the trim needs the row's pending entries merged
         first, so it rides the drain dispatch as the fused per-row count
         column — ONE launch total (was drain-then-trim, two)."""
-        row = self._row_for(key)
+        row = self._tbl.upsert(key)
         # counts above any possible length are no-ops (tlog.md:58); clamping
         # to the kernel sentinel keeps huge client counts out of int64 range
         self.drain(trim=(row, min(count, tlog.TRIM_NOOP)))
-        self._delta_for(key).raise_cutoff(self._cut_cache[row])
+        self._tbl.delta_raise_cutoff(row, self._tbl.cut_cache(row))
 
     # -- lattice plumbing ---------------------------------------------------
 
@@ -331,63 +293,61 @@ class RepoTLOG:
         # buffer only: the serving path drains via drain_overdue in a
         # worker thread; sync callers (snapshot restore) drain explicitly
         entries, cutoff = delta
-        row = self._row_for(key)
-        if entries:
-            lst = self._pend_entries.setdefault(row, [])
-            lst.extend((ts, value) for value, ts in entries)
-            if len(lst) >= ROW_DRAIN_THRESHOLD:
-                self._row_overdue = True
+        row = self._tbl.upsert(key)
+        for value, ts in entries:
+            self._tbl.converge_entry(row, ts, value)
         if cutoff:
-            self._pend_cutoff[row] = max(self._pend_cutoff.get(row, 0), cutoff)
+            self._tbl.converge_cutoff(row, cutoff)
 
     def deltas_size(self) -> int:
-        return len(self._deltas)
+        return self._tbl.deltas_size()
 
     def may_drain(self, args: list[bytes]) -> bool:
         """Device-bound commands the server offloads to a thread: trims
         always dispatch; an INS that will tip a drain threshold does.
         Reads NEVER drain — GET/SIZE/CUTOFF serve the exact merged view
-        host-side (_merged_view) — but the first read after a drain/trim
-        rebuilds the render base with one device row gather, and over a
-        tunneled chip one dispatch can cost ~100 ms: offload it too so it
-        never stalls the event loop (the counter repos' foreign-GET
-        pattern)."""
+        host-side — but a read that must rebuild the drained base pays
+        one device row gather, and over a tunneled chip one dispatch can
+        cost ~100 ms: offload it too so it never stalls the event loop
+        (the counter repos' foreign-GET pattern)."""
         if not args:
             return False
         op = args[0]
         if op in (b"TRIM", b"TRIMAT", b"CLR"):
             return True
         if op == b"INS" and len(args) >= 2:
-            row = self._keys.get(args[1])
-            in_row = len(self._pend_entries.get(row, ())) if row is not None else 0
+            row = self._tbl.find(args[1])
+            in_row = self._tbl.pend_len(row) if row >= 0 else 0
             return (
                 in_row + 1 >= ROW_DRAIN_THRESHOLD
-                or len(self._pend_entries) + 1 >= PENDING_DRAIN_THRESHOLD
+                or self._tbl.pend_rows_count() + 1 >= PENDING_DRAIN_THRESHOLD
             )
         if op in (b"GET", b"SIZE") and len(args) >= 2:
-            row = self._keys.get(args[1])
-            if row is None:
+            row = self._tbl.find(args[1])
+            if row < 0:
                 return False
-            if op == b"SIZE" and self._quiescent(row):
-                return False  # O(1) length-cache answer, no gather
-            return row not in self._render and self._len_cache.get(row, 0) > 0
+            if self._tbl.quiescent(row):
+                if op == b"SIZE":
+                    return False  # O(1) length-cache answer, no gather
+                return (
+                    row not in self._render
+                    and self._tbl.len_cache(row) > 0
+                    and not self._tbl.base_valid(row)  # a real device gather
+                )
+            return self._tbl.size(row) < 0  # gather only when base unknown
         return False
 
     def drain_overdue(self) -> bool:
         """Cluster converge path: after buffering a batch, the manager
         offloads the drain to a worker thread when any threshold trips.
-        O(1): converge flags row-threshold crossings as it appends."""
+        O(1): the table flags row-threshold crossings as it appends."""
         return (
-            self._row_overdue
-            or len(self._pend_entries) >= PENDING_DRAIN_THRESHOLD
+            self._tbl.row_overdue()
+            or self._tbl.pend_rows_count() >= PENDING_DRAIN_THRESHOLD
         )
 
     def flush_deltas(self):
-        out = [
-            (k, (d.latest(), d.cutoff)) for k, d in sorted(self._deltas.items())
-        ]
-        self._deltas.clear()
-        return out
+        return self._tbl.flush_deltas()
 
     # -- snapshot (persist.py): full state in the wire-delta shape ----------
 
@@ -401,9 +361,11 @@ class RepoTLOG:
         )
         all_vid = tlog.decode_vid_np(np.asarray(st.nv))
         out = []
-        for key, row in sorted(self._keys.items()):
-            length = self._len_cache.get(row, 0)
-            cutoff = self._cut_cache.get(row, 0)
+        for key, row in sorted(
+            (self._tbl.key_of(r), r) for r in range(self._tbl.rows())
+        ):
+            length = self._tbl.len_cache(row)
+            cutoff = self._tbl.cut_cache(row)
             entries = [
                 (self._interner.lookup(int(all_vid[row, i])), int(all_ts[row, i]))
                 for i in range(length)
@@ -424,19 +386,25 @@ class RepoTLOG:
         once, rebuild the table from the live set, and push the remapped
         plane back. Runs under the repo lock at drain time, before any
         new pending values intern."""
-        live = sum(self._len_cache.values())
+        # the native value interner compacts itself on the same cadence
+        # (cheap floor check per drain; full walk only when it has grown)
+        self._tbl.compact_values()
+        live = self._tbl.live_total()  # O(1): maintained at finish_row
         if len(self._interner) <= 2 * live + COMPACT_SLACK:
             return
+        lengths = {
+            r: self._tbl.len_cache(r) for r in range(self._tbl.rows())
+        }
         all_vid = tlog.decode_vid_np(np.asarray(self._state.nv))  # one pull
         rows = [
             all_vid[row, :length]
-            for row, length in self._len_cache.items()
+            for row, length in lengths.items()
             if length > 0
         ]
         flat = np.concatenate(rows) if rows else np.empty(0, np.int64)
         remap = self._interner.compact(flat[flat >= 0])
         new_vid = np.full(all_vid.shape, -1, np.int64)
-        for row, length in self._len_cache.items():
+        for row, length in lengths.items():
             if length > 0:
                 src = all_vid[row, :length]
                 # mask negatives on application exactly as on collection:
@@ -456,59 +424,47 @@ class RepoTLOG:
         kernel's (row, length, cutoff) read-backs, then clear pending."""
         for row, ln, ct in updates:
             self._render.pop(row, None)
-            self._merged.pop(row, None)
-            self._len_cache[row] = int(ln)
-            self._cut_cache[row] = int(ct)
-        self._pend_entries.clear()
-        self._pend_cutoff.clear()
-        self._row_overdue = False
+            self._sorted.pop(row, None)
+            self._tbl.finish_row(row, int(ln), int(ct))
+        self._tbl.finish_drain_end()
 
-    @timed_drain(
-        "TLOG",
-        lambda self: len(set(self._pend_entries) | set(self._pend_cutoff)),
-    )
+    @timed_drain("TLOG", lambda self: self._tbl.touched_count())
     def drain(self, trim: tuple[int, int] | None = None) -> None:
         """Flush pending entries/cutoffs in one dispatch; with ``trim``
         = (row, count), the TRIM/CLR of that row fuses into the SAME
         dispatch via the kernel's per-row count column (counts of
         TRIM_NOOP leave other rows untouched)."""
-        if not self._pend_entries and not self._pend_cutoff and trim is None:
+        row_set = set(self._tbl.touched_rows())
+        if not row_set and trim is None:
             return
         self._maybe_compact_interner()
+        if trim is not None:
+            row_set.add(trim[0])
+        rows = sorted(row_set)
+        pend = {r: self._tbl.export_pend(r) for r in rows}
+        cuts_in = {r: self._tbl.pend_cutoff(r) for r in rows}
         # adaptive layout: the narrow (2-plane) state holds every ts below
         # TS32_MAX; the first wider timestamp or cutoff upgrades it
         # losslessly before this drain ships (mesh states start wide)
         if not self._state.wide and (
-            any(
-                ts > tlog.TS32_MAX
-                for lst in self._pend_entries.values()
-                for ts, _ in lst
-            )
-            or any(c > tlog.TS32_MAX for c in self._pend_cutoff.values())
+            any(ts > tlog.TS32_MAX for lst in pend.values() for ts, _ in lst)
+            or any(c > tlog.TS32_MAX for c in cuts_in.values())
         ):
             self._state = tlog.widen(self._state)
-        row_set = set(self._pend_entries) | set(self._pend_cutoff)
-        if trim is not None:
-            row_set.add(trim[0])
-        rows = sorted(row_set)
         # capacity: keys, then entry slots (worst case current + pending)
-        kcap = self._round_cap(bucket(max(len(self._keys), 1), self._key_cap))
+        kcap = self._round_cap(bucket(max(self._tbl.rows(), 1), self._key_cap))
         need_len = max(
-            self._len_cache.get(r, 0) + len(self._pend_entries.get(r, ()))
-            for r in rows
+            self._tbl.len_cache(r) + len(pend.get(r, ())) for r in rows
         )
         lcap = bucket(max(need_len, 1), self._len_cap)
         if kcap != self._key_cap or lcap != self._len_cap:
             self._key_cap, self._len_cap = kcap, lcap
             self._state = self._place(tlog.grow(self._state, kcap, lcap))
         if self._mesh is not None:
-            self._drain_sharded(rows, trim)
+            self._drain_sharded(rows, pend, cuts_in, trim)
             return
         while True:
-            ld = bucket(
-                max((len(self._pend_entries.get(r, ())) for r in rows), default=1),
-                1,
-            )
+            ld = bucket(max((len(pend.get(r, ())) for r in rows), default=1), 1)
             # dense path (repo_counters precedent): when the batch covers a
             # quarter of the keyspace and rows are narrow, aligned delta
             # rows skip the gather/scatter entirely
@@ -519,12 +475,10 @@ class RepoTLOG:
                 d_vid = np.full((kc, ld), -1, np.int64)
                 d_cut = np.zeros(kc, np.uint64)
                 for row in rows:
-                    for j, (ts, value) in enumerate(
-                        self._pend_entries.get(row, ())
-                    ):
+                    for j, (ts, value) in enumerate(pend.get(row, ())):
                         d_ts[row, j] = ts
                         d_vid[row, j] = self._interner.intern(value)
-                    d_cut[row] = self._pend_cutoff.get(row, 0)
+                    d_cut[row] = cuts_in.get(row, 0)
                 tb = bucket(1)
                 trim_ki = np.full(tb, PAD_ROW, np.int32)
                 counts = np.full(tb, tlog.TRIM_NOOP, np.int64)
@@ -555,10 +509,10 @@ class RepoTLOG:
             counts = np.full(b, tlog.TRIM_NOOP, np.int64)
             for i, row in enumerate(rows):
                 ki[i] = row
-                for j, (ts, value) in enumerate(self._pend_entries.get(row, ())):
+                for j, (ts, value) in enumerate(pend.get(row, ())):
                     d_ts[i, j] = ts
                     d_vid[i, j] = self._interner.intern(value)
-                d_cut[i] = self._pend_cutoff.get(row, 0)
+                d_cut[i] = cuts_in.get(row, 0)
                 if trim is not None and row == trim[0]:
                     counts[i] = trim[1]
             new_state, ovf, lens, cuts = _drain(
@@ -575,7 +529,7 @@ class RepoTLOG:
             self._finish_drain(zip(rows, lens, cuts))
             return
 
-    def _drain_sharded(self, rows, trim=None) -> None:
+    def _drain_sharded(self, rows, pend, cuts_in, trim=None) -> None:
         """Mesh-mode drain: per-row deltas route as u64 payload columns
         [ts(ld) | vid(ld) | cutoff | count]; the batched merge + fused
         trim runs per key block with per-slot lengths/cutoffs read back in
@@ -584,19 +538,16 @@ class RepoTLOG:
         import jax.numpy as jnp
 
         while True:
-            ld = bucket(
-                max((len(self._pend_entries.get(r, ())) for r in rows), default=1),
-                1,
-            )
+            ld = bucket(max((len(pend.get(r, ())) for r in rows), default=1), 1)
             payload = np.zeros((len(rows), 2 * ld + 2), np.uint64)
             # empty vid slots must read back as -1, not id 0
             payload[:, ld : 2 * ld] = np.uint64(0xFFFFFFFFFFFFFFFF)
             payload[:, 2 * ld + 1] = np.uint64(tlog.TRIM_NOOP)
             for i, row in enumerate(rows):
-                for j, (ts, value) in enumerate(self._pend_entries.get(row, ())):
+                for j, (ts, value) in enumerate(pend.get(row, ())):
                     payload[i, j] = ts
                     payload[i, ld + j] = self._interner.intern(value)
-                payload[i, 2 * ld] = self._pend_cutoff.get(row, 0)
+                payload[i, 2 * ld] = cuts_in.get(row, 0)
                 if trim is not None and row == trim[0]:
                     payload[i, 2 * ld + 1] = trim[1]
             lr, pay, slots = route_drain64(

@@ -8,6 +8,13 @@ compare-and-scatter call whose gathered results feed the host serving
 cache. Rank-prefix ties that the device cannot settle (flagged rows) are
 resolved here with full strings and patched with a tiny follow-up scatter.
 
+Host bookkeeping (keys, winner, pending window, delta accumulator) lives
+behind the table backends in treg_table.py: pure-Python dicts as the
+oracle, or the native C++ engine — the SAME state the server's native
+batch applier (native/serve_engine.cpp) mutates, so SETs applied natively
+and Python-side drains/flushes share one source of truth. GET never pays
+a device round-trip: the winner is an O(1) host compare.
+
 Delta wire shape: (value: bytes, ts: u64).
 """
 
@@ -18,6 +25,7 @@ from functools import partial
 import jax
 import numpy as np
 
+from ..native.engine import resolve_engine
 from ..ops import planes, treg
 from ..ops.interner import Interner, prefix_rank
 from ..parallel import (
@@ -28,6 +36,7 @@ from ..parallel import (
     shard_vec,
 )
 from .base import ParseError, bucket, need, pad_rows, parse_u64
+from .treg_table import NativeTregTable, PyTregTable
 from ..utils.metrics import timed_drain
 from .help import RepoHelp
 
@@ -35,7 +44,8 @@ TREG_HELP = RepoHelp("TREG", {"GET": "key", "SET": "key value timestamp"})
 
 # pending writes/deltas flush to the device once they pile this high:
 # reads never need the drain (GET computes the winner host-side), so this
-# bounds host memory while keeping device batches large
+# bounds host memory while keeping device batches large.
+# native/serve_engine.cpp TREG_PENDING_DRAIN must match.
 PENDING_DRAIN_THRESHOLD = 4096
 
 # interner compaction: once the table holds this many more ids than live
@@ -70,9 +80,10 @@ class RepoTREG:
     name = "TREG"
     help = TREG_HELP
 
-    def __init__(self, identity: int, key_cap: int = 1024, mesh="auto"):
+    def __init__(
+        self, identity: int, key_cap: int = 1024, mesh="auto", engine="auto"
+    ):
         # identity is ignored: LWW needs no replica identity (repo_treg.pony:15)
-        self._keys: dict[bytes, int] = {}
         # mesh mode mirrors the counter repos (repo_counters.py): with >1
         # visible device the five planes live keys-sharded and drains
         # route through parallel/sharded.drain_sharded_treg
@@ -82,8 +93,10 @@ class RepoTREG:
         self._state = self._place(treg.init(self._key_cap))
         self._interner = Interner()
         self._cache: dict[int, tuple[int, int]] = {}  # row -> (ts, vid)
-        self._pending: dict[int, tuple[int, bytes]] = {}  # row -> (ts, value)
-        self._deltas: dict[bytes, tuple[bytes, int]] = {}  # key -> (value, ts)
+        self.engine = engine = resolve_engine(engine)
+        self._tbl = (
+            NativeTregTable(engine) if engine is not None else PyTregTable()
+        )
 
     def _round_cap(self, k: int) -> int:
         ns = self._n_shards
@@ -94,31 +107,17 @@ class RepoTREG:
             return state
         return type(state)(*(shard_vec(self._mesh, p) for p in state))
 
-    def _row_for(self, key: bytes) -> int:
-        row = self._keys.get(key)
-        if row is None:
-            row = len(self._keys)
-            self._keys[key] = row
-        return row
-
     # -- commands (repo_treg.pony:24-68) -----------------------------------
 
     def apply(self, resp, args: list[bytes]) -> bool:
         op = need(args, 0)
         if op == b"GET":
-            # LWW winner = max over (drained cache, un-drained pending) by
-            # the exact (ts, value) rule — an O(1) host compare, so a GET
+            # LWW winner = join(drained cache, un-drained pending) by the
+            # exact (ts, value) rule — an O(1) host compare, so a GET
             # NEVER pays a device round-trip (the counters' host-shadow
             # posture; drains happen on write thresholds and snapshots)
-            row = self._keys.get(need(args, 1))
-            cand = None
-            if row is not None:
-                hit = self._cache.get(row)
-                if hit is not None and hit[1] >= 0:
-                    cand = (hit[0], self._interner.lookup(hit[1]))
-                pend = self._pending.get(row)
-                if pend is not None and (cand is None or pend > cand):
-                    cand = pend
+            row = self._tbl.find(need(args, 1))
+            cand = self._tbl.winner(row) if row >= 0 else None
             if cand is None:
                 resp.null()
             else:
@@ -131,31 +130,24 @@ class RepoTREG:
             key = need(args, 1)
             value = need(args, 2)
             ts = parse_u64(need(args, 3))
-            self._write(key, value, ts)
+            row = self._tbl.upsert(key)
+            self._tbl.write(row, ts, value)
             # local delta coalesces by the same LWW rule (exact, host-side)
-            cur = self._deltas.get(key)
-            if cur is None or (ts, value) > (cur[1], cur[0]):
-                self._deltas[key] = (value, ts)
-            if len(self._pending) >= PENDING_DRAIN_THRESHOLD:
+            self._tbl.note_delta(row, ts, value)
+            if self._tbl.pend_count() >= PENDING_DRAIN_THRESHOLD:
                 self.drain()
             resp.ok()
             return True
         raise ParseError()
 
-    def _write(self, key: bytes, value: bytes, ts: int) -> None:
-        row = self._row_for(key)
-        cur = self._pending.get(row)
-        if cur is None or (ts, value) > cur:
-            self._pending[row] = (ts, value)
-
     def converge(self, key: bytes, delta: tuple) -> None:
         # buffer only: the serving path drains via drain_overdue in a
         # worker thread; sync callers (snapshot restore) drain explicitly
         value, ts = delta
-        self._write(key, value, ts)
+        self._tbl.write(self._tbl.upsert(key), ts, value)
 
     def deltas_size(self) -> int:
-        return len(self._deltas)
+        return self._tbl.deltas_size()
 
     def may_drain(self, args: list[bytes]) -> bool:
         """GET never drains (host winner compare); a SET may trigger the
@@ -165,30 +157,25 @@ class RepoTREG:
         return (
             bool(args)
             and args[0] == b"SET"
-            and len(self._pending) + 1 >= PENDING_DRAIN_THRESHOLD
+            and self._tbl.pend_count() + 1 >= PENDING_DRAIN_THRESHOLD
         )
 
     def drain_overdue(self) -> bool:
         """Cluster converge path: after buffering a batch, the manager
         offloads the drain to a worker thread when this trips."""
-        return len(self._pending) >= PENDING_DRAIN_THRESHOLD
+        return self._tbl.pend_count() >= PENDING_DRAIN_THRESHOLD
 
     def flush_deltas(self):
-        out = sorted(self._deltas.items())
-        self._deltas.clear()
-        return out
+        return self._tbl.flush_deltas()
 
     # -- snapshot (persist.py): full state in the wire-delta shape ----------
 
     def dump_state(self):
+        # the host winner IS the join the device converges to, so the
+        # dump needs no device read; the drain keeps the device mirror
+        # caught up for the sharded/mesh serving path
         self.drain()
-        out = []
-        for key, row in sorted(self._keys.items()):
-            hit = self._cache.get(row)
-            if hit is not None and hit[1] >= 0:
-                ts, vid = hit
-                out.append((key, (self._interner.lookup(vid), ts)))
-        return out
+        return self._tbl.dump()
 
     def load_state(self, batch) -> None:
         for key, delta in batch:
@@ -196,20 +183,21 @@ class RepoTREG:
 
     # -- device drain -------------------------------------------------------
 
-    @timed_drain("TREG", lambda self: len(self._pending))
+    @timed_drain("TREG", lambda self: self._tbl.pend_count())
     def drain(self) -> None:
-        if not self._pending:
+        pend = self._tbl.export_pend()  # [(row, ts, value)], not yet cleared
+        if not pend:
             return
-        cap = self._round_cap(bucket(max(len(self._keys), 1), self._key_cap))
+        cap = self._round_cap(bucket(max(self._tbl.rows(), 1), self._key_cap))
         if cap != self._key_cap:
             self._key_cap = cap
             self._state = self._place(treg.grow(self._state, cap))
         self._maybe_compact_interner()
-        rows = list(self._pending)
         if self._mesh is not None:
-            self._drain_sharded(rows)
-            self._pending.clear()
+            self._drain_sharded(pend)
+            self._tbl.fold_pend()
             return
+        rows = [row for row, _ts, _v in pend]
         dense = len(rows) * DENSE_FRACTION >= self._key_cap
         b = self._key_cap if dense else bucket(len(rows))
         ki = pad_rows(b)
@@ -217,8 +205,7 @@ class RepoTREG:
         d_rank = np.zeros(b, np.uint64)
         d_vid = np.full(b, -1, np.int32)
         values: dict[int, bytes] = {}  # batch slot -> full delta string
-        for i, row in enumerate(rows):
-            ts, value = self._pending[row]
+        for i, (row, ts, value) in enumerate(pend):
             slot = row if dense else i
             ki[i] = row
             d_ts[slot] = ts
@@ -260,7 +247,7 @@ class RepoTREG:
                 self._state = _patch_vids(self._state, pk, pv)
         for row, slot in zip(rows, slots):
             self._cache[row] = (int(out_ts[slot]), int(out_vid[slot]))
-        self._pending.clear()
+        self._tbl.fold_pend()
 
     def _maybe_compact_interner(self) -> None:
         """Epoch compaction (weak-spot fix, VERDICT round 2): every value
@@ -289,14 +276,14 @@ class RepoTREG:
         )
         self._state = self._state._replace(vid=new_vid)
 
-    def _drain_sharded(self, rows) -> None:
+    def _drain_sharded(self, pend) -> None:
         """Mesh-mode drain: payload columns [ts, rank, vid] route to the
         key blocks; ties come back per slot and resolve on host exactly
         like the single-chip path, patched with a routed vid scatter."""
+        rows = [row for row, _ts, _v in pend]
         payload = np.zeros((len(rows), 3), np.uint64)
         values: dict[int, bytes] = {}
-        for i, row in enumerate(rows):
-            ts, value = self._pending[row]
+        for i, (row, ts, value) in enumerate(pend):
             payload[i, 0] = ts
             payload[i, 1] = prefix_rank(value)
             payload[i, 2] = self._interner.intern(value)  # vids are >= 0

@@ -78,10 +78,14 @@ class RepoUJSON:
     name = "UJSON"
     help = UJSON_HELP
 
-    def __init__(self, identity: int, mesh="auto"):
+    def __init__(self, identity: int, mesh="auto", engine=None):
         from ..parallel import serving_mesh
 
         self._identity = identity
+        # native serving engine (server/serve_engine.cpp): validated INS
+        # commands bank in its write queue; _flush_queue applies them (in
+        # arrival order) before any other UJSON work reads or writes
+        self.engine = engine
         # mesh mode: the resident store's row axis shards over the
         # serving mesh and drains use the row-aligned fold — SPMD with
         # zero collectives, like every plane-backed type
@@ -162,7 +166,29 @@ class RepoUJSON:
             raise ParseError()
         return args[1], _decode_path(args[2:-1]), args[-1].decode("utf-8", "replace")
 
+    def _flush_queue(self) -> None:
+        """Apply every INS the native engine banked (in arrival order).
+        Runs before any other UJSON work so the queue is invisible to
+        reads, flushes, drains and snapshots; the engine pre-validated
+        each value token, so the applies cannot fail (the +OK replies are
+        already on the wire)."""
+        if self.engine is None or not self.engine.uq_count():
+            return
+        for args in self.engine.uq_drain():
+            key, path, value = self._path_and_value(args)
+            self._demote(key)
+            self._data_for(key).ins(
+                self._identity, path, value, self._delta_for(key)
+            )
+
+    def prepare_flush(self) -> None:
+        """Manager hook (flush_async): drain the write queue in a worker
+        thread before the loop-side delta flush — a queued INS on a
+        resident key demotes, which can decode (a blocking device pull)."""
+        self._flush_queue()
+
     def apply(self, resp, args: list[bytes]) -> bool:
+        self._flush_queue()
         op = need(args, 0)
         if op == b"GET":
             key = need(args, 1)
@@ -248,7 +274,10 @@ class RepoUJSON:
         key whose pending exceeds the trickle budget (the drain folds on
         device), or a resident read/demotion that must decode (cache
         miss). A trickle on a warm cache stays on the loop — the drain
-        serves it host-side in microseconds."""
+        serves it host-side in microseconds. A non-empty native write
+        queue always offloads: the flush may demote resident keys."""
+        if self.engine is not None and self.engine.uq_count():
+            return True
         if len(args) < 2 or args[0] not in self.may_drain_OPS:
             return False
         key = args[1]
@@ -359,6 +388,7 @@ class RepoUJSON:
     # -- snapshot (persist.py): full state in the wire-delta shape ----------
 
     def dump_state(self):
+        self._flush_queue()
         self.drain()
         docs = dict(self._data)
         if self._res is not None:
@@ -376,6 +406,11 @@ class RepoUJSON:
             self.converge(key, delta)
 
     def deltas_size(self) -> int:
+        # the banked queue is NOT drained here: this runs on the event
+        # loop (proactive flush), and a queued INS on a resident key
+        # demotes with a blocking device decode. prepare_flush (threaded,
+        # manager.flush_async / clean_shutdown) drains it; deltas from
+        # still-banked INSes simply ship on the next heartbeat flush.
         return len(self._deltas)
 
     def flush_deltas(self):
@@ -384,6 +419,7 @@ class RepoUJSON:
         return out
 
     def drain(self) -> None:
+        self._flush_queue()
         # device pass first: every resident key with pending, plus every
         # key whose fan-in earns a slice of a shared launch, folds in ONE
         # dispatch; what remains (small fan-ins on host-mode keys, or

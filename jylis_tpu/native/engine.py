@@ -1,16 +1,19 @@
-"""ctypes wrapper for the native counter engine (native/counter_engine.cpp).
+"""ctypes wrapper for the native serving engine (native/engine.h,
+counter_engine.cpp + serve_engine.cpp).
 
-`CounterEngine` owns the GCOUNT/PNCOUNT host state (key table, own
-contributions, serving values, dirty/pending/foreign bookkeeping) and
+`ServeEngine` owns the host state every command touches — the
+GCOUNT/PNCOUNT counter tables, the TREG winner/pending/delta registers,
+the TLOG pending/merged-view/delta logs and the UJSON write queue — and
 applies whole pipelined command bursts per FFI call. The Python dict
-backend in models/repo_counters.py remains the semantic oracle and the
-fallback when no toolchain is available; differential tests pin the
-equivalence.
+backends (models/counter_table.py, models/treg_table.py,
+models/tlog_table.py) remain the semantic oracles and the fallback when
+no toolchain is available; differential tests pin the equivalence.
 """
 
 from __future__ import annotations
 
 import ctypes
+import struct
 
 import numpy as np
 
@@ -22,68 +25,113 @@ PN = 1
 _OUT_CAP = 1 << 16
 _MAX_ARGS = 1024
 
+# jy_tlog_export_merged's "view unavailable" sentinel (serve_engine.cpp)
+_TLOG_UNAVAILABLE = -1 - (1 << 40)
+
 
 def _declare(c: ctypes.CDLL) -> None:
     ct = ctypes
-    c.jy_eng_new.restype = ct.c_void_p
-    c.jy_eng_free.argtypes = [ct.c_void_p]
-    c.jy_eng_rows.restype = ct.c_int64
-    c.jy_eng_rows.argtypes = [ct.c_void_p, ct.c_int32]
-    c.jy_eng_upsert.restype = ct.c_int64
-    c.jy_eng_upsert.argtypes = [ct.c_void_p, ct.c_int32, ct.c_char_p, ct.c_int64]
-    c.jy_eng_find.restype = ct.c_int64
-    c.jy_eng_find.argtypes = [ct.c_void_p, ct.c_int32, ct.c_char_p, ct.c_int64]
-    c.jy_eng_key.argtypes = [
-        ct.c_void_p, ct.c_int32, ct.c_int64,
-        ct.POINTER(ct.c_void_p), ct.POINTER(ct.c_int64),
-    ]
-    c.jy_eng_inc.argtypes = [
-        ct.c_void_p, ct.c_int32, ct.c_int64, ct.c_int32, ct.c_uint64,
-    ]
-    c.jy_eng_is_foreign.restype = ct.c_int32
-    c.jy_eng_is_foreign.argtypes = [ct.c_void_p, ct.c_int32, ct.c_int64]
-    c.jy_eng_set_foreign.argtypes = [ct.c_void_p, ct.c_int32, ct.c_int64]
-    c.jy_eng_value.restype = ct.c_uint64
-    c.jy_eng_value.argtypes = [ct.c_void_p, ct.c_int32, ct.c_int64]
-    c.jy_eng_own.restype = ct.c_uint64
-    c.jy_eng_own.argtypes = [ct.c_void_p, ct.c_int32, ct.c_int64, ct.c_int32]
-    c.jy_eng_own_max.argtypes = [
-        ct.c_void_p, ct.c_int32, ct.c_int64, ct.c_int32, ct.c_uint64,
-    ]
-    c.jy_eng_apply_drain.argtypes = [
-        ct.c_void_p, ct.c_int32, ct.c_void_p, ct.c_void_p, ct.c_int64,
-    ]
-    c.jy_eng_export_pending.restype = ct.c_int64
-    c.jy_eng_export_pending.argtypes = [
-        ct.c_void_p, ct.c_int32, ct.c_void_p, ct.c_void_p, ct.c_void_p,
-        ct.c_int64, ct.c_int32,
-    ]
-    c.jy_eng_dirty_count.restype = ct.c_int64
-    c.jy_eng_dirty_count.argtypes = [ct.c_void_p, ct.c_int32]
-    c.jy_eng_pend_count.restype = ct.c_int64
-    c.jy_eng_pend_count.argtypes = [ct.c_void_p, ct.c_int32]
-    c.jy_eng_export_dirty.restype = ct.c_int64
-    c.jy_eng_export_dirty.argtypes = [
-        ct.c_void_p, ct.c_int32, ct.c_void_p, ct.c_void_p, ct.c_void_p,
-        ct.c_void_p, ct.c_int64,
-    ]
-    c.jy_eng_own_set.restype = ct.c_int32
-    c.jy_eng_own_set.argtypes = [ct.c_void_p, ct.c_int32, ct.c_int64]
-    c.jy_eng_scan_apply.restype = ct.c_int32
-    c.jy_eng_scan_apply.argtypes = [
-        ct.c_void_p, ct.c_void_p, ct.c_int64,                      # buf
-        ct.c_void_p, ct.c_int64, ct.POINTER(ct.c_int64),           # out
-        ct.POINTER(ct.c_int64),                                    # consumed
-        ct.c_void_p, ct.c_void_p, ct.c_int32, ct.POINTER(ct.c_int32),
-        ct.POINTER(ct.c_int32), ct.POINTER(ct.c_int32),            # changed
-    ]
+    vp, i32, i64, u64, u8p = (
+        ct.c_void_p, ct.c_int32, ct.c_int64, ct.c_uint64, ct.c_char_p,
+    )
+    pi64 = ct.POINTER(ct.c_int64)
+    pi32 = ct.POINTER(ct.c_int32)
+    pvp = ct.POINTER(ct.c_void_p)
+    pu64 = ct.POINTER(ct.c_uint64)
+    sigs = {
+        "jy_eng_new": (vp, []),
+        "jy_eng_free": (None, [vp]),
+        "jy_eng_rows": (i64, [vp, i32]),
+        "jy_eng_upsert": (i64, [vp, i32, u8p, i64]),
+        "jy_eng_find": (i64, [vp, i32, u8p, i64]),
+        "jy_eng_key": (None, [vp, i32, i64, pvp, pi64]),
+        "jy_eng_inc": (None, [vp, i32, i64, i32, u64]),
+        "jy_eng_is_foreign": (i32, [vp, i32, i64]),
+        "jy_eng_set_foreign": (None, [vp, i32, i64]),
+        "jy_eng_value": (u64, [vp, i32, i64]),
+        "jy_eng_own": (u64, [vp, i32, i64, i32]),
+        "jy_eng_own_max": (None, [vp, i32, i64, i32, u64]),
+        "jy_eng_own_set": (i32, [vp, i32, i64]),
+        "jy_eng_apply_drain": (None, [vp, i32, vp, vp, i64]),
+        "jy_eng_export_pending": (i64, [vp, i32, vp, vp, vp, i64, i32]),
+        "jy_eng_dirty_count": (i64, [vp, i32]),
+        "jy_eng_pend_count": (i64, [vp, i32]),
+        "jy_eng_export_dirty": (i64, [vp, i32, vp, vp, vp, vp, i64]),
+        # TREG
+        "jy_treg_rows": (i64, [vp]),
+        "jy_treg_upsert": (i64, [vp, u8p, i64]),
+        "jy_treg_find": (i64, [vp, u8p, i64]),
+        "jy_treg_key": (None, [vp, i64, pvp, pi64]),
+        "jy_treg_write": (None, [vp, i64, u64, u8p, i64]),
+        "jy_treg_note_delta": (None, [vp, i64, u64, u8p, i64]),
+        "jy_treg_winner": (i32, [vp, i64, pu64, pvp, pi64]),
+        "jy_treg_pend_count": (i64, [vp]),
+        "jy_treg_export_pend": (i64, [vp, vp, vp, i64]),
+        "jy_treg_pend_val": (None, [vp, i64, pvp, pi64]),
+        "jy_treg_fold_pend": (None, [vp]),
+        "jy_treg_delta_count": (i64, [vp]),
+        "jy_treg_export_deltas": (i64, [vp, vp, vp, i64]),
+        "jy_treg_delta_val": (None, [vp, i64, pvp, pi64]),
+        "jy_treg_clear_deltas": (None, [vp]),
+        # TLOG
+        "jy_tlog_rows": (i64, [vp]),
+        "jy_tlog_upsert": (i64, [vp, u8p, i64]),
+        "jy_tlog_find": (i64, [vp, u8p, i64]),
+        "jy_tlog_key": (None, [vp, i64, pvp, pi64]),
+        "jy_tlog_ins": (None, [vp, i64, u64, u8p, i64]),
+        "jy_tlog_conv_entry": (None, [vp, i64, u64, u8p, i64]),
+        "jy_tlog_conv_cutoff": (None, [vp, i64, u64]),
+        "jy_tlog_size": (i64, [vp, i64]),
+        "jy_tlog_len_cache": (i64, [vp, i64]),
+        "jy_tlog_cut_cache": (u64, [vp, i64]),
+        "jy_tlog_cutoff_view": (u64, [vp, i64]),
+        "jy_tlog_pend_cutoff": (u64, [vp, i64]),
+        "jy_tlog_quiescent": (i32, [vp, i64]),
+        "jy_tlog_gen": (u64, [vp, i64]),
+        "jy_tlog_pend_len": (i64, [vp, i64]),
+        "jy_tlog_pend_rows_count": (i64, [vp]),
+        "jy_tlog_row_overdue": (i32, [vp]),
+        "jy_tlog_touched_rows": (i64, [vp, vp, i64]),
+        "jy_tlog_touched_count": (i64, [vp]),
+        "jy_tlog_export_base": (i64, [vp, i64, vp, vp, i64]),
+        "jy_tlog_compact": (i32, [vp]),
+        "jy_tlog_base_valid": (i32, [vp, i64]),
+        "jy_tlog_live_total": (i64, [vp]),
+        "jy_tlog_export_pend": (i64, [vp, i64, vp, vp, i64]),
+        "jy_tlog_val": (None, [vp, i32, pvp, pi64]),
+        "jy_tlog_intern": (i32, [vp, u8p, i64]),
+        "jy_tlog_finish_row": (None, [vp, i64, i64, u64]),
+        "jy_tlog_finish_end": (None, [vp]),
+        "jy_tlog_set_base": (None, [vp, i64, i64, vp, vp]),
+        "jy_tlog_export_merged": (i64, [vp, i64, vp, vp, i64]),
+        "jy_tlog_delta_rows_count": (i64, [vp]),
+        "jy_tlog_export_delta_rows": (i64, [vp, vp, i64]),
+        "jy_tlog_export_delta": (i64, [vp, i64, vp, vp, i64]),
+        "jy_tlog_delta_cutoff": (u64, [vp, i64]),
+        "jy_tlog_delta_raise_cutoff": (None, [vp, i64, u64]),
+        "jy_tlog_clear_deltas": (None, [vp]),
+        # UJSON queue
+        "jy_uq_count": (i64, [vp]),
+        "jy_uq_bytes": (i64, [vp]),
+        "jy_uq_data": (i64, [vp, vp, i64]),
+        "jy_uq_clear": (None, [vp]),
+        # batch applier
+        "jy_eng_scan_apply2": (
+            i32,
+            [vp, vp, i64, vp, i64, pi64, pi64, vp, vp, i32, pi32, vp],
+        ),
+    }
+    for fn_name, (restype, argtypes) in sigs.items():
+        fn = getattr(c, fn_name)
+        fn.restype = restype
+        fn.argtypes = argtypes
 
 
 _declared = False
 
 
-class CounterEngine:
-    """One native engine instance = both counter tables of one node."""
+class ServeEngine:
+    """One native engine instance = all five data-type tables of one node."""
 
     def __init__(self, cdll):
         global _declared
@@ -95,13 +143,15 @@ class CounterEngine:
         self._out = (ctypes.c_uint8 * _OUT_CAP)()
         self._offs = (ctypes.c_int64 * _MAX_ARGS)()
         self._lens = (ctypes.c_int64 * _MAX_ARGS)()
+        self._changed = (ctypes.c_int32 * 5)()
+        self._tlog_vals: list[bytes] = []  # native vid -> bytes mirror
 
     def __del__(self):
         if getattr(self, "_h", None):
             self._lib.jy_eng_free(self._h)
             self._h = None
 
-    # ---- table ops ---------------------------------------------------------
+    # ---- counter table ops -------------------------------------------------
 
     def rows(self, which: int) -> int:
         return self._lib.jy_eng_rows(self._h, which)
@@ -185,26 +235,353 @@ class CounterEngine:
         """bit0 = P own ever written, bit1 = N own ever written."""
         return self._lib.jy_eng_own_set(self._h, which, row)
 
+    # ---- TREG table ops ----------------------------------------------------
+
+    def treg_rows(self) -> int:
+        return self._lib.jy_treg_rows(self._h)
+
+    def treg_upsert(self, key: bytes) -> int:
+        return self._lib.jy_treg_upsert(self._h, key, len(key))
+
+    def treg_find(self, key: bytes) -> int:
+        return self._lib.jy_treg_find(self._h, key, len(key))
+
+    def treg_key_of(self, row: int) -> bytes:
+        ptr = ctypes.c_void_p()
+        n = ctypes.c_int64()
+        self._lib.jy_treg_key(self._h, row, ctypes.byref(ptr), ctypes.byref(n))
+        return ctypes.string_at(ptr, n.value)
+
+    def treg_write(self, row: int, ts: int, value: bytes) -> None:
+        self._lib.jy_treg_write(self._h, row, ts, value, len(value))
+
+    def treg_note_delta(self, row: int, ts: int, value: bytes) -> None:
+        self._lib.jy_treg_note_delta(self._h, row, ts, value, len(value))
+
+    def treg_winner(self, row: int):
+        ts = ctypes.c_uint64()
+        ptr = ctypes.c_void_p()
+        n = ctypes.c_int64()
+        if not self._lib.jy_treg_winner(
+            self._h, row, ctypes.byref(ts), ctypes.byref(ptr), ctypes.byref(n)
+        ):
+            return None
+        return ts.value, ctypes.string_at(ptr, n.value)
+
+    def treg_pend_count(self) -> int:
+        return self._lib.jy_treg_pend_count(self._h)
+
+    def treg_export_pend(self):
+        """[(row, ts, value)] without clearing (clear = treg_fold_pend)."""
+        cap = 256
+        while True:
+            rows = np.empty(cap, np.int64)
+            ts = np.empty(cap, np.uint64)
+            n = self._lib.jy_treg_export_pend(
+                self._h, rows.ctypes.data, ts.ctypes.data, cap
+            )
+            if n >= 0:
+                break
+            cap = -n
+        ptr = ctypes.c_void_p()
+        ln = ctypes.c_int64()
+        out = []
+        for i in range(n):
+            self._lib.jy_treg_pend_val(
+                self._h, int(rows[i]), ctypes.byref(ptr), ctypes.byref(ln)
+            )
+            out.append((int(rows[i]), int(ts[i]), ctypes.string_at(ptr, ln.value)))
+        return out
+
+    def treg_fold_pend(self) -> None:
+        self._lib.jy_treg_fold_pend(self._h)
+
+    def treg_delta_count(self) -> int:
+        return self._lib.jy_treg_delta_count(self._h)
+
+    def treg_flush_deltas(self):
+        """Sorted [(key, (value, ts))]; clears the delta window."""
+        cap = 256
+        while True:
+            rows = np.empty(cap, np.int64)
+            ts = np.empty(cap, np.uint64)
+            n = self._lib.jy_treg_export_deltas(
+                self._h, rows.ctypes.data, ts.ctypes.data, cap
+            )
+            if n >= 0:
+                break
+            cap = -n
+        ptr = ctypes.c_void_p()
+        ln = ctypes.c_int64()
+        out = []
+        for i in range(n):
+            row = int(rows[i])
+            self._lib.jy_treg_delta_val(
+                self._h, row, ctypes.byref(ptr), ctypes.byref(ln)
+            )
+            out.append(
+                (self.treg_key_of(row), (ctypes.string_at(ptr, ln.value), int(ts[i])))
+            )
+        self._lib.jy_treg_clear_deltas(self._h)
+        out.sort()
+        return out
+
+    # ---- TLOG table ops ----------------------------------------------------
+
+    def _tlog_val(self, vid: int) -> bytes:
+        vals = self._tlog_vals
+        while vid >= len(vals):  # vids are dense and append-only
+            ptr = ctypes.c_void_p()
+            n = ctypes.c_int64()
+            self._lib.jy_tlog_val(
+                self._h, len(vals), ctypes.byref(ptr), ctypes.byref(n)
+            )
+            vals.append(ctypes.string_at(ptr, n.value))
+        return vals[vid]
+
+    def tlog_rows(self) -> int:
+        return self._lib.jy_tlog_rows(self._h)
+
+    def tlog_upsert(self, key: bytes) -> int:
+        return self._lib.jy_tlog_upsert(self._h, key, len(key))
+
+    def tlog_find(self, key: bytes) -> int:
+        return self._lib.jy_tlog_find(self._h, key, len(key))
+
+    def tlog_key_of(self, row: int) -> bytes:
+        ptr = ctypes.c_void_p()
+        n = ctypes.c_int64()
+        self._lib.jy_tlog_key(self._h, row, ctypes.byref(ptr), ctypes.byref(n))
+        return ctypes.string_at(ptr, n.value)
+
+    def tlog_ins(self, row: int, ts: int, value: bytes) -> None:
+        self._lib.jy_tlog_ins(self._h, row, ts, value, len(value))
+
+    def tlog_conv_entry(self, row: int, ts: int, value: bytes) -> None:
+        self._lib.jy_tlog_conv_entry(self._h, row, ts, value, len(value))
+
+    def tlog_conv_cutoff(self, row: int, c: int) -> None:
+        self._lib.jy_tlog_conv_cutoff(self._h, row, c)
+
+    def tlog_size(self, row: int) -> int:
+        return self._lib.jy_tlog_size(self._h, row)
+
+    def tlog_len_cache(self, row: int) -> int:
+        return self._lib.jy_tlog_len_cache(self._h, row)
+
+    def tlog_cut_cache(self, row: int) -> int:
+        return self._lib.jy_tlog_cut_cache(self._h, row)
+
+    def tlog_cutoff_view(self, row: int) -> int:
+        return self._lib.jy_tlog_cutoff_view(self._h, row)
+
+    def tlog_pend_cutoff(self, row: int) -> int:
+        return self._lib.jy_tlog_pend_cutoff(self._h, row)
+
+    def tlog_quiescent(self, row: int) -> bool:
+        return bool(self._lib.jy_tlog_quiescent(self._h, row))
+
+    def tlog_gen(self, row: int) -> int:
+        return self._lib.jy_tlog_gen(self._h, row)
+
+    def tlog_pend_len(self, row: int) -> int:
+        return self._lib.jy_tlog_pend_len(self._h, row)
+
+    def tlog_pend_rows_count(self) -> int:
+        return self._lib.jy_tlog_pend_rows_count(self._h)
+
+    def tlog_row_overdue(self) -> bool:
+        return bool(self._lib.jy_tlog_row_overdue(self._h))
+
+    def tlog_touched_rows(self) -> list[int]:
+        cap = 256
+        while True:
+            rows = np.empty(cap, np.int64)
+            n = self._lib.jy_tlog_touched_rows(self._h, rows.ctypes.data, cap)
+            if n >= 0:
+                return rows[:n].tolist()
+            cap = -n
+
+    def tlog_touched_count(self) -> int:
+        return self._lib.jy_tlog_touched_count(self._h)
+
+    def tlog_base_entries(self, row: int):
+        """[(ts, value)] of the drained row content when the carried base
+        is valid; None when the repo must gather it from the device."""
+        cap = 64
+        while True:
+            ts = np.empty(cap, np.uint64)
+            vid = np.empty(cap, np.int32)
+            n = self._lib.jy_tlog_export_base(
+                self._h, row, ts.ctypes.data, vid.ctypes.data, cap
+            )
+            if n == _TLOG_UNAVAILABLE:
+                return None
+            if n >= 0:
+                return [
+                    (int(ts[i]), self._tlog_val(int(vid[i]))) for i in range(n)
+                ]
+            cap = -n
+
+    def tlog_compact(self) -> bool:
+        """Native value-interner compaction; resets the vid mirror when a
+        remap happened."""
+        if self._lib.jy_tlog_compact(self._h):
+            self._tlog_vals.clear()
+            return True
+        return False
+
+    def tlog_base_valid(self, row: int) -> bool:
+        return bool(self._lib.jy_tlog_base_valid(self._h, row))
+
+    def tlog_live_total(self) -> int:
+        return self._lib.jy_tlog_live_total(self._h)
+
+    def tlog_export_pend(self, row: int) -> list[tuple[int, bytes]]:
+        cap = max(self.tlog_pend_len(row), 1)
+        ts = np.empty(cap, np.uint64)
+        vid = np.empty(cap, np.int32)
+        n = self._lib.jy_tlog_export_pend(
+            self._h, row, ts.ctypes.data, vid.ctypes.data, cap
+        )
+        assert n >= 0
+        return [(int(ts[i]), self._tlog_val(int(vid[i]))) for i in range(n)]
+
+    def tlog_intern(self, value: bytes) -> int:
+        return self._lib.jy_tlog_intern(self._h, value, len(value))
+
+    def tlog_finish_row(self, row: int, length: int, cut: int) -> None:
+        self._lib.jy_tlog_finish_row(self._h, row, length, cut)
+
+    def tlog_finish_end(self) -> None:
+        self._lib.jy_tlog_finish_end(self._h)
+
+    def tlog_set_base(self, row: int, entries) -> None:
+        """entries: [(ts, value bytes)] — the drained row content."""
+        n = len(entries)
+        ts = np.empty(max(n, 1), np.uint64)
+        vid = np.empty(max(n, 1), np.int32)
+        for i, (t, v) in enumerate(entries):
+            ts[i] = t
+            vid[i] = self.tlog_intern(v)
+        self._lib.jy_tlog_set_base(
+            self._h, row, n, ts.ctypes.data, vid.ctypes.data
+        )
+
+    def tlog_merged_entries(self, row: int):
+        """[(ts, value)] of the merged view, unsorted; None when the
+        drained base is unknown (call tlog_size / tlog_set_base first)."""
+        cap = 64
+        while True:
+            ts = np.empty(cap, np.uint64)
+            vid = np.empty(cap, np.int32)
+            n = self._lib.jy_tlog_export_merged(
+                self._h, row, ts.ctypes.data, vid.ctypes.data, cap
+            )
+            if n == _TLOG_UNAVAILABLE:
+                return None
+            if n >= 0:
+                return [
+                    (int(ts[i]), self._tlog_val(int(vid[i]))) for i in range(n)
+                ]
+            cap = -n
+
+    def tlog_deltas_size(self) -> int:
+        return self._lib.jy_tlog_delta_rows_count(self._h)
+
+    def tlog_delta_raise_cutoff(self, row: int, c: int) -> None:
+        self._lib.jy_tlog_delta_raise_cutoff(self._h, row, c)
+
+    def tlog_flush_deltas(self):
+        """Sorted [(key, (entries latest-first, cutoff))]; clears."""
+        cap = 256
+        while True:
+            rows = np.empty(cap, np.int64)
+            n = self._lib.jy_tlog_export_delta_rows(
+                self._h, rows.ctypes.data, cap
+            )
+            if n >= 0:
+                break
+            cap = -n
+        out = []
+        for i in range(n):
+            row = int(rows[i])
+            dn = 16
+            while True:
+                ts = np.empty(dn, np.uint64)
+                vid = np.empty(dn, np.int32)
+                m = self._lib.jy_tlog_export_delta(
+                    self._h, row, ts.ctypes.data, vid.ctypes.data, dn
+                )
+                if m >= 0:
+                    break
+                dn = -m
+            ents = sorted(
+                ((int(ts[j]), self._tlog_val(int(vid[j]))) for j in range(m)),
+                reverse=True,
+            )
+            out.append(
+                (
+                    self.tlog_key_of(row),
+                    (
+                        [(v, t) for t, v in ents],
+                        self._lib.jy_tlog_delta_cutoff(self._h, row),
+                    ),
+                )
+            )
+        self._lib.jy_tlog_clear_deltas(self._h)
+        out.sort()
+        return out
+
+    # ---- UJSON queue -------------------------------------------------------
+
+    def uq_count(self) -> int:
+        return self._lib.jy_uq_count(self._h)
+
+    def uq_drain(self) -> list[list[bytes]]:
+        """Pop every banked UJSON INS as its raw argument list (without
+        the leading type word), in arrival order."""
+        nbytes = self._lib.jy_uq_bytes(self._h)
+        if nbytes == 0:
+            return []
+        blob = (ctypes.c_uint8 * nbytes)()
+        got = self._lib.jy_uq_data(self._h, blob, nbytes)
+        assert got == nbytes
+        self._lib.jy_uq_clear(self._h)
+        data = bytes(blob)
+        out = []
+        pos = 0
+        while pos < len(data):
+            (argc,) = struct.unpack_from("<I", data, pos)
+            pos += 4
+            args = []
+            for _ in range(argc):
+                (ln,) = struct.unpack_from("<I", data, pos)
+                pos += 4
+                args.append(data[pos : pos + ln])
+                pos += ln
+            out.append(args)
+        return out
+
     # ---- the batch applier -------------------------------------------------
 
     def scan_apply(self, buf):
         """Apply a pipelined burst. Returns
         (rc, consumed, replies: bytes, unhandled: list[bytes] | None,
-        changed_g, changed_pn); rc as documented in counter_engine.cpp."""
+        changed: tuple of 5 per-type counts (G, PN, TREG, TLOG, UJSON));
+        rc as documented in serve_engine.cpp."""
         if not buf:
-            return 0, 0, b"", None, 0, 0
+            return 0, 0, b"", None, (0, 0, 0, 0, 0)
         base = ctypes.addressof(ctypes.c_char.from_buffer(buf))
         out_len = ctypes.c_int64()
         consumed = ctypes.c_int64()
         n_args = ctypes.c_int32()
-        ch_g = ctypes.c_int32()
-        ch_pn = ctypes.c_int32()
-        rc = self._lib.jy_eng_scan_apply(
+        rc = self._lib.jy_eng_scan_apply2(
             self._h, ctypes.c_void_p(base), len(buf),
             self._out, _OUT_CAP, ctypes.byref(out_len),
             ctypes.byref(consumed),
             self._offs, self._lens, _MAX_ARGS, ctypes.byref(n_args),
-            ctypes.byref(ch_g), ctypes.byref(ch_pn),
+            self._changed,
         )
         replies = ctypes.string_at(self._out, out_len.value)
         unhandled = None
@@ -215,9 +592,25 @@ class CounterEngine:
                 for i in range(n_args.value)
             ]
             del view
-        return rc, consumed.value, replies, unhandled, ch_g.value, ch_pn.value
+        return rc, consumed.value, replies, unhandled, tuple(self._changed)
 
 
-def make_engine() -> CounterEngine | None:
+# the counter-only name the round-3 engine shipped under; kept for callers
+CounterEngine = ServeEngine
+
+
+def make_engine() -> ServeEngine | None:
     cdll = lib()
-    return CounterEngine(cdll) if cdll is not None else None
+    return ServeEngine(cdll) if cdll is not None else None
+
+
+def resolve_engine(engine):
+    """The repos'/Database's shared engine-argument convention:
+    "auto" -> a fresh native engine (None without a toolchain),
+    "python" -> None (pure-Python table backends), anything else is
+    passed through (a shared ServeEngine instance or None)."""
+    if engine == "auto":
+        return make_engine()
+    if engine == "python":
+        return None
+    return engine

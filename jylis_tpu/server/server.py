@@ -102,20 +102,25 @@ class Server:
             except (ConnectionResetError, BrokenPipeError):
                 pass
 
+    # the engine's changed-counter order (serve_engine.cpp scan_apply2)
+    _ENGINE_TYPES = ("GCOUNT", "PNCOUNT", "TREG", "TLOG", "UJSON")
+
+    def _engine_managers(self):
+        return [self._database.manager(n) for n in self._ENGINE_TYPES]
+
     def _native_busy(self, parser) -> bool:
-        g = self._database.manager("GCOUNT")
-        pn = self._database.manager("PNCOUNT")
-        return g.busy() or pn.busy() or parser.has_pending()
+        return parser.has_pending() or any(
+            m.busy() for m in self._engine_managers()
+        )
 
     async def _apply_native(self, engine, buf, parser, resp, writer):
-        """Drain `buf` through the native counter engine; commands it
+        """Drain `buf` through the native serving engine; commands it
         can't settle route through the normal per-repo async path in
         order. Returns True (stay native) or False (demote this
         connection to the Python path; tail moved into `parser` — on
         malformed input the Python parser then renders its specific
         error and the connection drops)."""
-        g_mgr = self._database.manager("GCOUNT")
-        pn_mgr = self._database.manager("PNCOUNT")
+        mgrs = self._engine_managers()
 
         def demote() -> bool:
             parser.append(bytes(buf))
@@ -123,24 +128,26 @@ class Server:
             return False
 
         while True:
-            if g_mgr._shutdown or pn_mgr._shutdown:
+            if any(m._shutdown for m in mgrs):
                 return demote()
-            # both counter tables mutate inside one native call: hold both
-            # repo locks (fixed order), exactly the boundary apply_async
-            # enforces per repo
-            async with g_mgr._lock:
-                async with pn_mgr._lock:
-                    rc, consumed, replies, unhandled, ch_g, ch_pn = (
-                        engine.scan_apply(buf)
-                    )
-                    if replies:
-                        writer.write(replies)
-                    if ch_g:
-                        g_mgr._on_change()
-                        g_mgr._maybe_proactive_flush()
-                    if ch_pn:
-                        pn_mgr._on_change()
-                        pn_mgr._maybe_proactive_flush()
+            # all five type tables can mutate inside one native call: hold
+            # every engine-backed repo lock, exactly the boundary
+            # apply_async enforces per repo — a threaded drain holding any
+            # one of them keeps the engine out entirely. Acquisition
+            # follows the DATABASE MAP order (TREG, TLOG, G, PN, UJSON),
+            # the same order database.all_locks uses, so the shutdown
+            # snapshot can never deadlock against a serving burst.
+            async with mgrs[2]._lock, mgrs[3]._lock, mgrs[0]._lock, \
+                    mgrs[1]._lock, mgrs[4]._lock:
+                rc, consumed, replies, unhandled, changed = (
+                    engine.scan_apply(buf)
+                )
+                if replies:
+                    writer.write(replies)
+                for mgr, ch in zip(mgrs, changed):
+                    if ch:
+                        mgr._on_change()
+                        mgr._maybe_proactive_flush()
             del buf[:consumed]
             if rc == 1:  # one command for the Python path, in order
                 await self._database.apply_async(resp, unhandled)

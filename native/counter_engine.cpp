@@ -1,200 +1,26 @@
-// Native counter engine — the GCOUNT/PNCOUNT command hot path.
+// Native counter engine — the GCOUNT/PNCOUNT host-state surface.
 //
 // The reference executes every command inside compiled Pony actors
 // (repo_gcount.pony:25-60, repo_pncount.pony:26-67); the rebuild's
-// Python engine seam tops out on interpreter dispatch. This engine owns
-// the counters' HOST state (key table, own contributions, serving value
-// cache, dirty/pending/foreign bookkeeping — the exact fields
-// jylis_tpu/models/repo_counters.py otherwise keeps in dicts) and
-// applies whole pipelined bursts per FFI call: parse (via resp_scan,
-// same .so) + table update + reply bytes, all in C++.
+// Python engine seam tops out on interpreter dispatch. The counter
+// tables (engine.h Table) own the counters' HOST state (key table, own
+// contributions, serving value cache, dirty/pending/foreign bookkeeping
+// — the exact fields jylis_tpu/models/repo_counters.py otherwise keeps
+// in dicts); whole pipelined bursts apply through the all-types batch
+// applier in serve_engine.cpp.
 //
 // Split of responsibilities (single source of truth):
 //   * native: per-key own/value/dirty/pending-own/foreign + INC/DEC/GET
 //   * Python: device drains, foreign-delta pending (dict of sparse
 //     cols), flush/snapshot orchestration, cluster converge — all via
 //     the bulk export/apply calls below.
-// Any command the engine can't settle exactly like the Python oracle
-// (other types, parse errors -> help, GET over a foreign-dirty row,
-// variadic weirdness) is returned to Python with its argument slices —
-// the caller applies THAT command and re-enters, preserving per-
-// connection ordering.
 //
 // All values are u64 bit patterns; PNCOUNT's serving value is the
 // two's-complement wrapped i64 the reference's (p-n).i64() defines.
 
-#include <cstdint>
-#include <cstring>
-#include <vector>
+#include "engine.h"
 
-extern "C" int32_t resp_scan(const uint8_t* buf, int64_t len,
-                             int64_t* consumed, int64_t* offs, int64_t* lens,
-                             int32_t max_args, int32_t* n_args);
-
-namespace {
-
-constexpr uint8_t F_FOREIGN = 1;
-constexpr uint8_t F_DIRTY = 2;
-constexpr uint8_t F_PEND_P = 4;
-constexpr uint8_t F_PEND_N = 8;
-// "own was ever written" per polarity: flush emits a polarity's entry
-// only when set, matching the Python dicts' key-presence semantics
-// (an INC of 0 still creates the entry)
-constexpr uint8_t F_OWNSET_P = 16;
-constexpr uint8_t F_OWNSET_N = 32;
-
-struct Table {
-    // open-addressing key table (FNV-1a, power-of-two, linear probe)
-    std::vector<int64_t> slot_row;  // -1 empty
-    std::vector<uint8_t> arena;     // key bytes, append-only
-    std::vector<int64_t> key_off;
-    std::vector<int64_t> key_len;
-    std::vector<uint64_t> key_hash;
-    // per-row state
-    std::vector<uint64_t> value;  // serving value (u64 bits)
-    std::vector<uint64_t> own_p;
-    std::vector<uint64_t> own_n;
-    std::vector<uint64_t> pend_p;  // max own within the drain window
-    std::vector<uint64_t> pend_n;
-    std::vector<uint8_t> flags;
-    std::vector<int64_t> dirty_rows;  // insertion order; F_DIRTY dedups
-    std::vector<int64_t> pend_rows;   // rows with any F_PEND_*
-
-    Table() : slot_row(64, -1) {}
-
-    size_t mask() const { return slot_row.size() - 1; }
-
-    static uint64_t hash(const uint8_t* k, int64_t n) {
-        uint64_t h = 1469598103934665603ull;
-        for (int64_t i = 0; i < n; i++) h = (h ^ k[i]) * 1099511628211ull;
-        return h;
-    }
-
-    bool key_eq(int64_t row, const uint8_t* k, int64_t n) const {
-        return key_len[row] == n &&
-               memcmp(arena.data() + key_off[row], k, static_cast<size_t>(n)) == 0;
-    }
-
-    void rehash() {
-        std::vector<int64_t> fresh(slot_row.size() * 2, -1);
-        size_t m = fresh.size() - 1;
-        for (size_t r = 0; r < key_off.size(); r++) {
-            size_t i = key_hash[r] & m;
-            while (fresh[i] >= 0) i = (i + 1) & m;
-            fresh[i] = static_cast<int64_t>(r);
-        }
-        slot_row.swap(fresh);
-    }
-
-    int64_t find(const uint8_t* k, int64_t n) const {
-        uint64_t h = hash(k, n);
-        size_t i = h & mask();
-        while (true) {
-            int64_t row = slot_row[i];
-            if (row < 0) return -1;
-            if (key_hash[row] == h && key_eq(row, k, n)) return row;
-            i = (i + 1) & mask();
-        }
-    }
-
-    int64_t upsert(const uint8_t* k, int64_t n) {
-        uint64_t h = hash(k, n);
-        size_t i = h & mask();
-        while (true) {
-            int64_t row = slot_row[i];
-            if (row < 0) break;
-            if (key_hash[row] == h && key_eq(row, k, n)) return row;
-            i = (i + 1) & mask();
-        }
-        int64_t row = static_cast<int64_t>(key_off.size());
-        key_off.push_back(static_cast<int64_t>(arena.size()));
-        key_len.push_back(n);
-        key_hash.push_back(h);
-        arena.insert(arena.end(), k, k + n);
-        value.push_back(0);
-        own_p.push_back(0);
-        own_n.push_back(0);
-        pend_p.push_back(0);
-        pend_n.push_back(0);
-        flags.push_back(0);
-        slot_row[i] = row;
-        if (key_off.size() * 10 >= slot_row.size() * 7) rehash();
-        return row;
-    }
-
-    void mark_dirty(int64_t row) {
-        if (!(flags[row] & F_DIRTY)) {
-            flags[row] |= F_DIRTY;
-            dirty_rows.push_back(row);
-        }
-    }
-
-    // INC (polarity 0) / DEC (polarity 1): the exact sequence of
-    // repo_counters.py _inc / PN apply
-    void bump(int64_t row, int polarity, uint64_t amount) {
-        uint64_t& own = polarity ? own_n[row] : own_p[row];
-        uint64_t& pend = polarity ? pend_n[row] : pend_p[row];
-        uint8_t bit = polarity ? F_PEND_N : F_PEND_P;
-        flags[row] |= polarity ? F_OWNSET_N : F_OWNSET_P;
-        own += amount;  // u64 wrap
-        if (own > pend) pend = own;
-        if (!(flags[row] & (F_PEND_P | F_PEND_N))) pend_rows.push_back(row);
-        flags[row] |= bit;
-        mark_dirty(row);
-        value[row] += polarity ? static_cast<uint64_t>(-amount) : amount;
-    }
-};
-
-struct Engine {
-    Table t[2];  // 0 = GCOUNT, 1 = PNCOUNT
-};
-
-// ---- reply formatting ------------------------------------------------------
-
-int64_t fmt_u64(uint8_t* out, uint64_t v) {
-    char tmp[24];
-    int n = 0;
-    do {
-        tmp[n++] = static_cast<char>('0' + v % 10);
-        v /= 10;
-    } while (v);
-    for (int i = 0; i < n; i++) out[i] = static_cast<uint8_t>(tmp[n - 1 - i]);
-    return n;
-}
-
-int64_t fmt_int_reply(uint8_t* out, uint64_t bits, bool signed_i64) {
-    int64_t n = 0;
-    out[n++] = ':';
-    if (signed_i64 && static_cast<int64_t>(bits) < 0) {
-        out[n++] = '-';
-        bits = ~bits + 1;  // unsigned-domain negate: defined for INT64_MIN
-    }
-    n += fmt_u64(out + n, bits);
-    out[n++] = '\r';
-    out[n++] = '\n';
-    return n;
-}
-
-// strict u64 parse: ASCII digits only, must fit (Python parse_u64)
-bool parse_amount(const uint8_t* s, int64_t n, uint64_t* out) {
-    if (n <= 0) return false;
-    uint64_t v = 0;
-    for (int64_t i = 0; i < n; i++) {
-        if (s[i] < '0' || s[i] > '9') return false;
-        uint64_t d = static_cast<uint64_t>(s[i] - '0');
-        if (v > (UINT64_MAX - d) / 10) return false;
-        v = v * 10 + d;
-    }
-    *out = v;
-    return true;
-}
-
-bool word_is(const uint8_t* buf, int64_t off, int64_t len, const char* w) {
-    int64_t n = static_cast<int64_t>(strlen(w));
-    return len == n && memcmp(buf + off, w, static_cast<size_t>(n)) == 0;
-}
-
-}  // namespace
+using namespace jy;
 
 extern "C" {
 
@@ -202,8 +28,7 @@ void* jy_eng_new() { return new Engine(); }
 void jy_eng_free(void* e) { delete static_cast<Engine*>(e); }
 
 int64_t jy_eng_rows(void* e, int32_t which) {
-    return static_cast<int64_t>(
-        static_cast<Engine*>(e)->t[which].key_off.size());
+    return static_cast<Engine*>(e)->t[which].idx.rows();
 }
 
 int64_t jy_eng_upsert(void* e, int32_t which, const uint8_t* k, int64_t n) {
@@ -217,8 +42,8 @@ int64_t jy_eng_find(void* e, int32_t which, const uint8_t* k, int64_t n) {
 void jy_eng_key(void* e, int32_t which, int64_t row, const uint8_t** ptr,
                 int64_t* len) {
     Table& t = static_cast<Engine*>(e)->t[which];
-    *ptr = t.arena.data() + t.key_off[row];
-    *len = t.key_len[row];
+    *ptr = t.idx.key_ptr(row);
+    *len = t.idx.key_len[row];
 }
 
 void jy_eng_inc(void* e, int32_t which, int64_t row, int32_t polarity,
@@ -321,95 +146,6 @@ int64_t jy_eng_export_dirty(void* e, int32_t which, int64_t* rows,
     }
     t.dirty_rows.clear();
     return n;
-}
-
-// ---- the batch applier -----------------------------------------------------
-//
-// Returns:
-//   0  consumed all complete commands (tail incomplete or buffer empty)
-//   1  stopped at a command Python must apply: its slices are in
-//      offs/lens/n_args and *consumed INCLUDES it
-//   2  reply buffer nearly full: flush replies and call again
-//  -1  protocol error at the stop point (serve replies, drop connection)
-//  -2  a command has more than max_args arguments (grow and retry)
-int32_t jy_eng_scan_apply(void* ev, const uint8_t* buf, int64_t len,
-                          uint8_t* out, int64_t out_cap, int64_t* out_len,
-                          int64_t* consumed, int64_t* offs, int64_t* lens,
-                          int32_t max_args, int32_t* n_args,
-                          int32_t* changed_g, int32_t* changed_pn) {
-    Engine* eng = static_cast<Engine*>(ev);
-    *out_len = 0;
-    *consumed = 0;
-    *n_args = 0;
-    *changed_g = 0;
-    *changed_pn = 0;
-    while (true) {
-        if (out_cap - *out_len < 32) return 2;
-        int64_t sub_consumed = 0;
-        int32_t argc = 0;
-        int32_t rc = resp_scan(buf + *consumed, len - *consumed, &sub_consumed,
-                               offs, lens, max_args, &argc);
-        if (rc == 0) return 0;
-        if (rc == -1) return -1;
-        if (rc == -2) {
-            *n_args = argc;
-            return -2;
-        }
-        for (int32_t i = 0; i < argc; i++) offs[i] += *consumed;
-        bool inline_blank = argc == 0 && buf[*consumed] != '*';
-        if (inline_blank) {  // oracle parser skips blank inline lines
-            *consumed += sub_consumed;
-            continue;
-        }
-        // which table?
-        int32_t which = -1;
-        if (argc >= 1 && word_is(buf, offs[0], lens[0], "GCOUNT")) which = 0;
-        if (argc >= 1 && word_is(buf, offs[0], lens[0], "PNCOUNT")) which = 1;
-        if (which < 0) {
-            *n_args = argc;
-            *consumed += sub_consumed;
-            return 1;
-        }
-        Table& t = eng->t[which];
-        int32_t* changed = which ? changed_pn : changed_g;
-        // GET key — reply from the value cache unless foreign-dirty
-        if (argc >= 3 && word_is(buf, offs[1], lens[1], "GET")) {
-            int64_t row = t.find(buf + offs[2], lens[2]);
-            if (row >= 0 && (t.flags[row] & F_FOREIGN)) {
-                *n_args = argc;  // Python drains and serves this one
-                *consumed += sub_consumed;
-                return 1;
-            }
-            uint64_t v = row >= 0 ? t.value[row] : 0;
-            *out_len += fmt_int_reply(out + *out_len, v, which == 1);
-            *consumed += sub_consumed;
-            continue;
-        }
-        // INC/DEC key amount
-        int polarity = -1;
-        if (argc >= 4 && word_is(buf, offs[1], lens[1], "INC")) polarity = 0;
-        if (which == 1 && argc >= 4 && word_is(buf, offs[1], lens[1], "DEC"))
-            polarity = 1;
-        if (polarity >= 0) {
-            uint64_t amount = 0;
-            if (!parse_amount(buf + offs[3], lens[3], &amount)) {
-                *n_args = argc;  // ParseError -> help text, Python's job
-                *consumed += sub_consumed;
-                return 1;
-            }
-            int64_t row = t.upsert(buf + offs[2], lens[2]);
-            t.bump(row, polarity, amount);
-            (*changed)++;
-            memcpy(out + *out_len, "+OK\r\n", 5);
-            *out_len += 5;
-            *consumed += sub_consumed;
-            continue;
-        }
-        // unknown subcommand / wrong arity -> help path in Python
-        *n_args = argc;
-        *consumed += sub_consumed;
-        return 1;
-    }
 }
 
 }  // extern "C"

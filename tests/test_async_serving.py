@@ -192,7 +192,7 @@ def test_treg_threshold_offload_predicate():
 
     repo = repo_treg.RepoTREG(identity=1)
     for i in range(repo_treg.PENDING_DRAIN_THRESHOLD - 1):
-        repo._write(b"t%d" % i, b"v", 1)
+        repo.converge(b"t%d" % i, (b"v", 1))
     assert repo.may_drain([b"SET", b"tX", b"v", b"1"])
     assert not repo.may_drain([b"GET", b"tX"])
     repo.converge(b"tX", (b"v", 1))  # tips the threshold: buffered only

@@ -259,7 +259,7 @@ def test_dense_drain_equivalence():
             repo.converge(k, (shared + (b"zzz" if i % 2 else b"aaa"), 100))
         repo.drain()  # tie rows resolve on host: zzz must win either order
     for k in keys:
-        srow, brow = small._keys[k], big._keys[k]
+        srow, brow = small._tbl.find(k), big._tbl.find(k)
         assert small._cache[srow][0] == big._cache[brow][0] == 100
         assert (
             small._interner.lookup(small._cache[srow][1])

@@ -87,7 +87,7 @@ def test_tlog_ins_trim_churn_keeps_interner_flat():
         repo.drain()
         for k in range(n_keys):
             repo.apply(r, [b"TRIM", b"log%d" % k, b"%d" % keep])
-    live = sum(repo._len_cache.values())
+    live = sum(repo._tbl.len_cache(r) for r in range(repo._tbl.rows()))
     assert live == keep * n_keys
     bound = 2 * live + mod.COMPACT_SLACK
     assert len(repo._interner) <= bound, len(repo._interner)

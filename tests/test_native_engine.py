@@ -111,9 +111,7 @@ def test_int64_min_reply_formatting():
         cfg = Config()
         cfg.port = "0"
         cfg.log = Log.create_none()
-        db = Database(identity=1)
-        if force_python:
-            db.native_engine = None
+        db = Database(identity=1, engine="python" if force_python else "auto")
         server = Server(cfg, db)
         await server.start()
         try:
@@ -170,9 +168,7 @@ def test_server_replies_identical_native_vs_python():
         cfg = Config()
         cfg.port = "0"
         cfg.log = Log.create_none()
-        db = Database(identity=1)
-        if force_python:
-            db.native_engine = None
+        db = Database(identity=1, engine="python" if force_python else "auto")
         server = Server(cfg, db)
         await server.start()
         try:
@@ -230,9 +226,7 @@ def test_server_random_stream_differential(seed):
         cfg = Config()
         cfg.port = "0"
         cfg.log = Log.create_none()
-        db = Database(identity=1)
-        if force_python:
-            db.native_engine = None
+        db = Database(identity=1, engine="python" if force_python else "auto")
         db.manager("GCOUNT").repo.converge(keys[0], {44: 5})
         server = Server(cfg, db)
         await server.start()
