@@ -24,7 +24,7 @@ U64_MASK = (1 << 64) - 1
 class PyTable:
     __slots__ = (
         "_keys", "_rkeys", "_value", "_own", "_ownset", "_pend", "_pendset",
-        "_pend_rows", "_dirty", "_foreign",
+        "_pend_rows", "_dirty", "_foreign", "_sync_dirty",
     )
 
     def __init__(self):
@@ -38,6 +38,7 @@ class PyTable:
         self._pend_rows: dict[int, None] = {}
         self._dirty: dict[int, None] = {}
         self._foreign: set[int] = set()
+        self._sync_dirty: dict[int, None] = {}  # since last digest pass
 
     def rows(self) -> int:
         return len(self._rkeys)
@@ -72,6 +73,7 @@ class PyTable:
             self._pend_rows[row] = None
         self._pendset[polarity][row] = True
         self._dirty[row] = None
+        self._sync_dirty[row] = None
         delta = amount if polarity == 0 else -amount
         self._value[row] = (self._value[row] + delta) & U64_MASK
 
@@ -126,6 +128,11 @@ class PyTable:
         sb = [self.own_set(r) for r in rows]
         self._dirty.clear()
         return rows, op, on, sb
+
+    def export_sync_dirty(self) -> list[int]:
+        rows = list(self._sync_dirty)
+        self._sync_dirty.clear()
+        return rows
 
 
 class NativeTable:
@@ -186,3 +193,6 @@ class NativeTable:
     def export_dirty(self):
         rows, op, on, sb = self._eng.export_dirty(self._which)
         return rows.tolist(), op.tolist(), on.tolist(), sb.tolist()
+
+    def export_sync_dirty(self) -> list[int]:
+        return self._eng.export_sync_dirty(self._which)

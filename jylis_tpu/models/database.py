@@ -8,6 +8,7 @@ words, fans flush/converge to the repos, and joins shutdown.
 from __future__ import annotations
 
 import asyncio
+import hashlib
 from contextlib import AsyncExitStack, asynccontextmanager
 
 from .help import DATATYPE_HELP, respond_help
@@ -34,9 +35,6 @@ class Database:
         # engine="python" pins the pure-Python table backends everywhere
         # (differential tests compare the two whole stacks).
         self.native_engine = resolve_engine(engine)
-        # monotone data-mutation stamp: bumped on every state-changing
-        # apply/converge; the cluster's sync digest caches against it
-        self.stamp = 0
         self._map: dict[bytes, RepoManager] = {}
         for repo in (
             RepoTREG(identity, engine=self.native_engine),
@@ -46,17 +44,59 @@ class Database:
             RepoUJSON(identity, engine=self.native_engine),
             self.system,
         ):
-            # SYSTEM is excluded from the stamp: its keepalive delta ships
-            # every heartbeat (deltas_size()==1 quirk), which would bump
-            # the stamp continuously and defeat the sync-digest cache —
-            # and the sync path streams SYSTEM fresh each time anyway
-            bump = None if repo is self.system else self._bump
             self._map[repo.name.encode()] = RepoManager(
-                repo.name, repo, repo.help, on_change=bump
+                repo.name, repo, repo.help
             )
 
-    def _bump(self) -> None:
-        self.stamp += 1
+        # incremental sync digest (round-5 verdict item 2): per data type,
+        # a map of key -> sha256(canonical per-key state) and the running
+        # XOR of those hashes. Updating costs O(keys dirty since the last
+        # pass) — a reconnect never dumps the keyspace to compute 32 bytes.
+        self.DATA_TYPES = ("TREG", "TLOG", "GCOUNT", "PNCOUNT", "UJSON")
+        self._sync_hash: dict[str, dict[bytes, bytes]] = {
+            n: {} for n in self.DATA_TYPES
+        }
+        self._sync_xor: dict[str, bytes] = {
+            n: bytes(32) for n in self.DATA_TYPES
+        }
+
+    def _sync_update_repo(self, name: str, repo) -> None:
+        """Fold the repo's dirty keys into its digest accumulator (worker
+        thread, repo lock held by the caller)."""
+        prep = getattr(repo, "sync_prepare", None)
+        if prep is not None:
+            prep()
+        dirty = repo.sync_dirty_keys()
+        if not dirty:
+            return
+        hmap = self._sync_hash[name]
+        x = int.from_bytes(self._sync_xor[name], "big")
+        tag = name.encode()
+        for key in dirty:
+            old = hmap.pop(key, None)
+            if old is not None:
+                x ^= int.from_bytes(old, "big")
+            canon = repo.sync_canon(key)
+            if canon is not None:
+                h = hashlib.sha256(
+                    tag + b"\x00" + len(key).to_bytes(4, "big") + key + canon
+                ).digest()
+                hmap[key] = h
+                x ^= int.from_bytes(h, "big")
+        self._sync_xor[name] = x.to_bytes(32, "big")
+
+    async def sync_digest_async(self) -> bytes:
+        """The 32-byte digest of the five data types' canonical state —
+        converged peers (any op order, any backend) produce equal bytes.
+        Cost is O(keys written since the last call): each repo folds only
+        its dirty keys, under its own lock, in a worker thread."""
+        for name in self.DATA_TYPES:
+            mgr = self._map[name.encode()]
+            async with mgr._lock:
+                await asyncio.to_thread(self._sync_update_repo, name, mgr.repo)
+        return hashlib.sha256(
+            b"".join(self._sync_xor[n] for n in self.DATA_TYPES)
+        ).digest()
 
     def manager(self, name: str) -> RepoManager:
         return self._map[name.encode()]

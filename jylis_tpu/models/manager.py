@@ -64,9 +64,7 @@ SHUTDOWN_ERR = "SHUTDOWN (server is shutting down, rejecting all requests)"
 
 
 class RepoManager:
-    def __init__(
-        self, name: str, repo, help_obj, clock=time.monotonic, on_change=None
-    ):
+    def __init__(self, name: str, repo, help_obj, clock=time.monotonic):
         self.name = name
         self.repo = repo
         self.help = help_obj
@@ -75,9 +73,6 @@ class RepoManager:
         self._last_proactive = None
         self._shutdown = False
         self._lock = asyncio.Lock()
-        # notified on every state-changing apply/converge (the database's
-        # mutation stamp, which keys the cluster's sync-digest cache)
-        self._on_change = on_change or (lambda: None)
 
     def apply(self, resp, cmd: list[bytes]) -> None:
         """cmd includes the routing word (cmd[0] == data type name).
@@ -86,7 +81,6 @@ class RepoManager:
             resp.err(SHUTDOWN_ERR)
             return
         if self._apply_core(resp, cmd):
-            self._on_change()
             self._maybe_proactive_flush()
 
     def _apply_core(self, resp, cmd: list[bytes]) -> bool:
@@ -116,7 +110,6 @@ class RepoManager:
             may = getattr(self.repo, "may_drain", None)
             if may is None or not may(cmd[1:]):
                 if self._apply_core(resp, cmd):
-                    self._on_change()
                     self._maybe_proactive_flush()
                 return
         async with self._lock:
@@ -134,7 +127,6 @@ class RepoManager:
             else:
                 changed = self._apply_core(resp, cmd)
             if changed:
-                self._on_change()
                 self._maybe_proactive_flush()
 
     async def converge_async(self, batch) -> None:
@@ -199,8 +191,6 @@ class RepoManager:
     def converge_deltas(self, batch) -> None:
         for key, delta in batch:
             self.repo.converge(key, delta)
-        if batch:
-            self._on_change()
 
     def clean_shutdown(self) -> None:
         self._shutdown = True

@@ -120,6 +120,12 @@ class _CounterRepo:
         # foreign delta columns buffered per row per polarity (sparse
         # {col: max-value} maps from cluster converges)
         self._pending_f: tuple[dict[int, dict[int, int]], ...] = ({}, {})
+        # sync-digest bookkeeping (cluster/syncdigest): a CUMULATIVE join
+        # of every foreign column ever converged, keyed by replica id —
+        # unlike _pending_f it never clears, so the per-key canonical
+        # state (own ⊔ foreign) reads host-side with no device pull
+        self._sync_f: tuple[dict[int, dict[int, int]], ...] = ({}, {})
+        self._sync_dirty_extra: set[int] = set()  # converge-path rows
 
     def _get_raw(self, key: bytes) -> int:
         """Serving value bits for a key (drains first when foreign deltas
@@ -180,10 +186,14 @@ class _CounterRepo:
     def converge_polarity(self, key: bytes, polarity: int, delta: dict) -> None:
         row = self._tbl.upsert(key)
         p = self._pending_f[polarity].setdefault(row, {})
+        sf = self._sync_f[polarity].setdefault(row, {})
         for rid, v in delta.items():
             col = self._col_for(rid)
             if v > p.get(col, 0):
                 p[col] = v
+            if v > sf.get(rid, 0):
+                sf[rid] = v
+        self._sync_dirty_extra.add(row)
         self._tbl.set_foreign(row)
 
     def _collect_rows(self):
@@ -214,6 +224,27 @@ class _CounterRepo:
         self._pending_f[0].clear()
         self._pending_f[1].clear()
 
+    # -- sync digest (cluster/syncdigest.py) ---------------------------------
+
+    def sync_dirty_keys(self) -> list[bytes]:
+        """Keys whose canonical state may have changed since the last
+        digest pass (native INC/DEC fast path ∪ converge/load); clears."""
+        rows = set(self._tbl.export_sync_dirty())
+        rows.update(self._sync_dirty_extra)
+        self._sync_dirty_extra.clear()
+        return [self._tbl.key_of(r) for r in rows]
+
+    def _sync_cols(self, row: int, polarity: int) -> list[tuple[int, int]]:
+        """{rid: max} for one polarity: own contribution ⊔ the cumulative
+        foreign mirror — exactly the column state the device converges
+        to, with no device read."""
+        d = dict(self._sync_f[polarity].get(row, ()))
+        if self._tbl.own_set(row) & (1 << polarity):
+            own = self._tbl.own(row, polarity)
+            if own > d.get(self._identity, 0):
+                d[self._identity] = own
+        return sorted((rid, v) for rid, v in d.items() if v)
+
     # -- snapshot plumbing shared by both types ------------------------------
 
     def _sorted_keys(self):
@@ -234,6 +265,13 @@ class RepoGCOUNT(_CounterRepo):
 
     def _get_value(self, key: bytes) -> int:
         return self._get_raw(key)
+
+    def sync_canon(self, key: bytes) -> bytes | None:
+        row = self._tbl.find(key)
+        if row < 0:
+            return None
+        cols = self._sync_cols(row, 0)
+        return repr(cols).encode() if cols else None
 
     # -- commands (repo_gcount.pony:25-60) ---------------------------------
 
@@ -350,6 +388,14 @@ class RepoPNCOUNT(_CounterRepo):
 
     def _get_value(self, key: bytes) -> int:
         return _wrap_i64(self._get_raw(key))
+
+    def sync_canon(self, key: bytes) -> bytes | None:
+        row = self._tbl.find(key)
+        if row < 0:
+            return None
+        p = self._sync_cols(row, 0)
+        n = self._sync_cols(row, 1)
+        return repr((p, n)).encode() if p or n else None
 
     # -- commands (repo_pncount.pony:26-67) --------------------------------
 

@@ -349,6 +349,23 @@ class RepoTLOG:
     def flush_deltas(self):
         return self._tbl.flush_deltas()
 
+    # -- sync digest (cluster/syncdigest.py) ---------------------------------
+
+    def sync_dirty_keys(self) -> list[bytes]:
+        return [self._tbl.key_of(r) for r in self._tbl.export_sync_dirty()]
+
+    def sync_canon(self, key: bytes) -> bytes | None:
+        """Canonical per-key state: the merged view (the exact post-drain
+        lattice content, pending included) plus the grow-only cutoff —
+        host-side except for the rare base-invalid row's one-row gather."""
+        row = self._tbl.find(key)
+        if row < 0:
+            return None
+        ents, cut = self._merged_view(row)
+        if not ents and not cut:
+            return None
+        return repr((ents, cut)).encode()
+
     # -- snapshot (persist.py): full state in the wire-delta shape ----------
 
     def dump_state(self):
@@ -441,7 +458,7 @@ class RepoTLOG:
         if trim is not None:
             row_set.add(trim[0])
         rows = sorted(row_set)
-        pend = {r: self._tbl.export_pend(r) for r in rows}
+        pend = self._tbl.export_pend_bulk(rows)
         cuts_in = {r: self._tbl.pend_cutoff(r) for r in rows}
         # adaptive layout: the narrow (2-plane) state holds every ts below
         # TS32_MAX; the first wider timestamp or cutoff upgrades it

@@ -168,6 +168,19 @@ class RepoTREG:
     def flush_deltas(self):
         return self._tbl.flush_deltas()
 
+    # -- sync digest (cluster/syncdigest.py) ---------------------------------
+
+    def sync_dirty_keys(self) -> list[bytes]:
+        return [self._tbl.key_of(r) for r in self._tbl.export_sync_dirty()]
+
+    def sync_canon(self, key: bytes) -> bytes | None:
+        """Canonical per-key state: the LWW winner — an O(1) host read
+        (every converged replica agrees on it by the exact
+        (ts, value) rule)."""
+        row = self._tbl.find(key)
+        w = self._tbl.winner(row) if row >= 0 else None
+        return None if w is None else repr(w).encode()
+
     # -- snapshot (persist.py): full state in the wire-delta shape ----------
 
     def dump_state(self):

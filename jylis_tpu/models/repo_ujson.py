@@ -101,6 +101,7 @@ class RepoUJSON:
         # (the GET-path trickle), so repeat reads don't re-walk the doc
         self._res_applied: dict[bytes, int] = {}
         self._host_only: set[bytes] = set()  # seqs past u32: never promote
+        self._sync_dirty: set[bytes] = set()  # since last digest pass
 
     # -- mode plumbing -------------------------------------------------------
 
@@ -180,6 +181,7 @@ class RepoUJSON:
             self._data_for(key).ins(
                 self._identity, path, value, self._delta_for(key)
             )
+            self._sync_dirty.add(key)
 
     def prepare_flush(self) -> None:
         """Manager hook (flush_async): drain the write queue in a worker
@@ -190,6 +192,8 @@ class RepoUJSON:
     def apply(self, resp, args: list[bytes]) -> bool:
         self._flush_queue()
         op = need(args, 0)
+        if op in (b"SET", b"CLR", b"INS", b"RM") and len(args) >= 2:
+            self._sync_dirty.add(args[1])
         if op == b"GET":
             key = need(args, 1)
             self._drain_key(key)
@@ -253,6 +257,7 @@ class RepoUJSON:
         lst = self._pend.setdefault(key, [])
         lst.append(delta)
         self._pend_total += 1
+        self._sync_dirty.add(key)
         if len(lst) >= DEVICE_FANIN_MIN:
             self._overdue = True
 
@@ -384,6 +389,33 @@ class RepoUJSON:
                 self._res_cache.pop(k, None)
                 self._res_applied.pop(k, None)
         return fallback
+
+    # -- sync digest (cluster/syncdigest.py) ---------------------------------
+
+    def sync_prepare(self) -> None:
+        """Fold all pending deltas in ONE device/host pass before the
+        canon reads (a per-key fold would dispatch per dirty key)."""
+        self._flush_queue()
+        self.drain()
+
+    def sync_dirty_keys(self) -> list[bytes]:
+        out = list(self._sync_dirty)
+        self._sync_dirty.clear()
+        return out
+
+    def sync_canon(self, key: bytes) -> bytes | None:
+        """Canonical per-key state: the doc's dot-store + causal context
+        with every unordered container sorted, so converged replicas
+        (whose dict/set iteration orders differ) hash identically."""
+        doc = self._view(key)
+        if doc is None or not (doc.entries or doc.ctx.vv or doc.ctx.cloud):
+            return None
+        ents = sorted(
+            (dot, path, token) for dot, (path, token) in doc.entries.items()
+        )
+        return repr(
+            (ents, sorted(doc.ctx.vv.items()), sorted(doc.ctx.cloud))
+        ).encode()
 
     # -- snapshot (persist.py): full state in the wire-delta shape ----------
 

@@ -59,7 +59,7 @@ class _Row:
 class PyTlogTable:
     __slots__ = (
         "_keys", "_rkeys", "_rows", "_pend_rows_count", "_row_overdue",
-        "_delta_rows", "_touched", "_live_total",
+        "_delta_rows", "_touched", "_live_total", "_sync_dirty",
     )
 
     def __init__(self):
@@ -71,6 +71,7 @@ class PyTlogTable:
         self._delta_rows: list[int] = []
         self._touched: list[int] = []  # rows with pend or pend_cutoff
         self._live_total = 0  # sum of len_cache over all rows
+        self._sync_dirty: dict[int, None] = {}  # since last digest pass
 
     # -- keys ---------------------------------------------------------------
 
@@ -113,6 +114,7 @@ class PyTlogTable:
         if not r.touched:
             r.touched = True
             self._touched.append(row)
+        self._sync_dirty[row] = None
 
     def _append_pend(self, r: _Row, row: int, e: tuple[int, bytes]) -> None:
         if not r.pend:
@@ -133,6 +135,7 @@ class PyTlogTable:
             cut = max(r.pend_cutoff, r.cut_cache)
             if r.memo_plen != len(r.pend) - 1 or r.memo_cut != cut:
                 r.memo_valid = False
+                r.memo = set()  # free, don't retain dead sets
             else:
                 if ts >= cut:
                     r.memo.add(e)
@@ -196,6 +199,11 @@ class PyTlogTable:
     def live_total(self) -> int:
         return self._live_total
 
+    def export_sync_dirty(self) -> list[int]:
+        rows = list(self._sync_dirty)
+        self._sync_dirty.clear()
+        return rows
+
     def compact_values(self) -> bool:
         return False  # raw bytes, freed with their entries: nothing interned
 
@@ -239,6 +247,9 @@ class PyTlogTable:
     def export_pend(self, row: int) -> list[tuple[int, bytes]]:
         return list(self._rows[row].pend)
 
+    def export_pend_bulk(self, rows: list[int]):
+        return {r: list(self._rows[r].pend) for r in rows}
+
     def finish_row(self, row: int, length: int, cut: int) -> None:
         r = self._rows[row]
         if self._memo_current(r):
@@ -247,6 +258,7 @@ class PyTlogTable:
         else:
             r.base = []
             r.base_valid = length == 0
+        self._sync_dirty[row] = None  # a fused trim can change the view
         self._live_total += int(length) - r.len_cache
         r.len_cache = int(length)
         r.cut_cache = int(cut)
@@ -360,6 +372,9 @@ class NativeTlogTable:
     def live_total(self) -> int:
         return self._eng.tlog_live_total()
 
+    def export_sync_dirty(self) -> list[int]:
+        return self._eng.tlog_export_sync_dirty()
+
     def compact_values(self) -> bool:
         return self._eng.tlog_compact()
 
@@ -395,6 +410,9 @@ class NativeTlogTable:
 
     def export_pend(self, row: int) -> list[tuple[int, bytes]]:
         return self._eng.tlog_export_pend(row)
+
+    def export_pend_bulk(self, rows: list[int]):
+        return self._eng.tlog_export_pend_bulk(rows)
 
     def finish_row(self, row: int, length: int, cut: int) -> None:
         self._eng.tlog_finish_row(row, int(length), int(cut))

@@ -22,7 +22,8 @@ from __future__ import annotations
 
 
 class PyTregTable:
-    __slots__ = ("_keys", "_rkeys", "_cache", "_pending", "_deltas")
+    __slots__ = ("_keys", "_rkeys", "_cache", "_pending", "_deltas",
+                 "_sync_dirty")
 
     def __init__(self):
         self._keys: dict[bytes, int] = {}
@@ -30,6 +31,7 @@ class PyTregTable:
         self._cache: dict[int, tuple[int, bytes]] = {}  # drained winner
         self._pending: dict[int, tuple[int, bytes]] = {}  # max since drain
         self._deltas: dict[int, tuple[int, bytes]] = {}  # max since flush
+        self._sync_dirty: dict[int, None] = {}  # since last digest pass
 
     def rows(self) -> int:
         return len(self._rkeys)
@@ -49,6 +51,7 @@ class PyTregTable:
         return self._rkeys[row]
 
     def write(self, row: int, ts: int, value: bytes) -> None:
+        self._sync_dirty[row] = None
         cur = self._pending.get(row)
         if cur is None or (ts, value) > cur:
             self._pending[row] = (ts, value)
@@ -97,6 +100,11 @@ class PyTregTable:
             if w is not None:
                 out.append((key, (w[1], w[0])))
         return out
+
+    def export_sync_dirty(self) -> list[int]:
+        rows = list(self._sync_dirty)
+        self._sync_dirty.clear()
+        return rows
 
 
 class NativeTregTable:
@@ -151,3 +159,6 @@ class NativeTregTable:
                 out.append((self._eng.treg_key_of(row), (w[1], w[0])))
         out.sort()
         return out
+
+    def export_sync_dirty(self) -> list[int]:
+        return self._eng.treg_export_sync_dirty()

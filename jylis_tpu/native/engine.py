@@ -57,6 +57,20 @@ def _declare(c: ctypes.CDLL) -> None:
         "jy_eng_dirty_count": (i64, [vp, i32]),
         "jy_eng_pend_count": (i64, [vp, i32]),
         "jy_eng_export_dirty": (i64, [vp, i32, vp, vp, vp, vp, i64]),
+        "jy_eng_export_sync_dirty": (i64, [vp, i32, vp, i64]),
+        "jy_treg_export_sync_dirty": (i64, [vp, vp, i64]),
+        "jy_tlog_export_sync_dirty": (i64, [vp, vp, i64]),
+        "jy_treg_deltas_info": (None, [vp, pi64, pi64, pi64]),
+        "jy_treg_export_deltas_bulk": (
+            None, [vp, vp, vp, vp, vp, vp, vp, vp],
+        ),
+        "jy_tlog_deltas_info": (None, [vp, pi64, pi64, pi64]),
+        "jy_tlog_export_deltas_bulk": (
+            None, [vp, vp, vp, vp, vp, vp, vp, vp],
+        ),
+        "jy_tlog_export_pend_bulk": (i64, [vp, vp, i64, vp, vp, vp, i64]),
+        "jy_tlog_vals_info": (None, [vp, i32, pi64, pi64]),
+        "jy_tlog_export_vals": (None, [vp, i32, vp, vp, vp]),
         # TREG
         "jy_treg_rows": (i64, [vp]),
         "jy_treg_upsert": (i64, [vp, u8p, i64]),
@@ -235,6 +249,27 @@ class ServeEngine:
         """bit0 = P own ever written, bit1 = N own ever written."""
         return self._lib.jy_eng_own_set(self._h, which, row)
 
+    def _export_sync_dirty(self, fn, *head) -> list[int]:
+        cap = 256
+        while True:
+            rows = np.empty(cap, np.int64)
+            n = fn(self._h, *head, rows.ctypes.data, cap)
+            if n >= 0:
+                return rows[:n].tolist()
+            cap = -n
+
+    def export_sync_dirty(self, which: int) -> list[int]:
+        """Counter rows changed since the last digest pass; clears."""
+        return self._export_sync_dirty(
+            self._lib.jy_eng_export_sync_dirty, which
+        )
+
+    def treg_export_sync_dirty(self) -> list[int]:
+        return self._export_sync_dirty(self._lib.jy_treg_export_sync_dirty)
+
+    def tlog_export_sync_dirty(self) -> list[int]:
+        return self._export_sync_dirty(self._lib.jy_tlog_export_sync_dirty)
+
     # ---- TREG table ops ----------------------------------------------------
 
     def treg_rows(self) -> int:
@@ -300,29 +335,40 @@ class ServeEngine:
         return self._lib.jy_treg_delta_count(self._h)
 
     def treg_flush_deltas(self):
-        """Sorted [(key, (value, ts))]; clears the delta window."""
-        cap = 256
-        while True:
-            rows = np.empty(cap, np.int64)
-            ts = np.empty(cap, np.uint64)
-            n = self._lib.jy_treg_export_deltas(
-                self._h, rows.ctypes.data, ts.ctypes.data, cap
-            )
-            if n >= 0:
-                break
-            cap = -n
-        ptr = ctypes.c_void_p()
-        ln = ctypes.c_int64()
-        out = []
-        for i in range(n):
-            row = int(rows[i])
-            self._lib.jy_treg_delta_val(
-                self._h, row, ctypes.byref(ptr), ctypes.byref(ln)
-            )
-            out.append(
-                (self.treg_key_of(row), (ctypes.string_at(ptr, ln.value), int(ts[i])))
-            )
+        """Sorted [(key, (value, ts))]; clears the delta window. ONE bulk
+        FFI pass — per-row round-trips made a 20k-key flush ~12x slower
+        than the dict oracle."""
+        n = ctypes.c_int64()
+        vb = ctypes.c_int64()
+        kb = ctypes.c_int64()
+        self._lib.jy_treg_deltas_info(
+            self._h, ctypes.byref(n), ctypes.byref(vb), ctypes.byref(kb)
+        )
+        n = n.value
+        if n == 0:
+            return []
+        ts = np.empty(n, np.uint64)
+        vo = np.empty(n, np.int64)
+        vl = np.empty(n, np.int64)
+        ko = np.empty(n, np.int64)
+        kl = np.empty(n, np.int64)
+        vblob = np.empty(max(vb.value, 1), np.uint8)
+        kblob = np.empty(max(kb.value, 1), np.uint8)
+        self._lib.jy_treg_export_deltas_bulk(
+            self._h, ts.ctypes.data, vo.ctypes.data, vl.ctypes.data,
+            vblob.ctypes.data, ko.ctypes.data, kl.ctypes.data,
+            kblob.ctypes.data,
+        )
         self._lib.jy_treg_clear_deltas(self._h)
+        vbytes = vblob.tobytes()
+        kbytes = kblob.tobytes()
+        out = [
+            (kbytes[o : o + ln], (vbytes[vo_ : vo_ + vl_], t))
+            for o, ln, vo_, vl_, t in zip(
+                ko.tolist(), kl.tolist(), vo.tolist(), vl.tolist(),
+                ts.tolist(),
+            )
+        ]
         out.sort()
         return out
 
@@ -330,14 +376,31 @@ class ServeEngine:
 
     def _tlog_val(self, vid: int) -> bytes:
         vals = self._tlog_vals
-        while vid >= len(vals):  # vids are dense and append-only
-            ptr = ctypes.c_void_p()
-            n = ctypes.c_int64()
-            self._lib.jy_tlog_val(
-                self._h, len(vals), ctypes.byref(ptr), ctypes.byref(n)
-            )
-            vals.append(ctypes.string_at(ptr, n.value))
+        if vid >= len(vals):
+            self._tlog_refill_vals()
         return vals[vid]
+
+    def _tlog_refill_vals(self) -> None:
+        """Mirror every native-interned value from the current mirror
+        length up, in ONE bulk export."""
+        lo = len(self._tlog_vals)
+        n = ctypes.c_int64()
+        nb = ctypes.c_int64()
+        self._lib.jy_tlog_vals_info(
+            self._h, lo, ctypes.byref(n), ctypes.byref(nb)
+        )
+        if n.value <= 0:
+            return
+        off = np.empty(n.value, np.int64)
+        ln = np.empty(n.value, np.int64)
+        blob = np.empty(max(nb.value, 1), np.uint8)
+        self._lib.jy_tlog_export_vals(
+            self._h, lo, off.ctypes.data, ln.ctypes.data, blob.ctypes.data
+        )
+        data = blob.tobytes()
+        self._tlog_vals.extend(
+            data[o : o + l] for o, l in zip(off.tolist(), ln.tolist())
+        )
 
     def tlog_rows(self) -> int:
         return self._lib.jy_tlog_rows(self._h)
@@ -447,6 +510,36 @@ class ServeEngine:
         assert n >= 0
         return [(int(ts[i]), self._tlog_val(int(vid[i]))) for i in range(n)]
 
+    def tlog_export_pend_bulk(self, rows: list[int]):
+        """{row: [(ts, value)]} for the drain's row set in one call."""
+        nrows = len(rows)
+        if nrows == 0:
+            return {}
+        rows_a = np.asarray(rows, np.int64)
+        counts = np.empty(nrows, np.int64)
+        cap = 256
+        while True:
+            ts = np.empty(cap, np.uint64)
+            vid = np.empty(cap, np.int32)
+            total = self._lib.jy_tlog_export_pend_bulk(
+                self._h, rows_a.ctypes.data, nrows, counts.ctypes.data,
+                ts.ctypes.data, vid.ctypes.data, cap,
+            )
+            if total >= 0:
+                break
+            cap = -total
+        if int(vid[:total].max(initial=-1)) >= len(self._tlog_vals):
+            self._tlog_refill_vals()
+        vals = self._tlog_vals
+        ts_l = ts[:total].tolist()
+        vid_l = vid[:total].tolist()
+        out = {}
+        e = 0
+        for row, c in zip(rows, counts.tolist()):
+            out[row] = [(ts_l[j], vals[vid_l[j]]) for j in range(e, e + c)]
+            e += c
+        return out
+
     def tlog_intern(self, value: bytes) -> int:
         return self._lib.jy_tlog_intern(self._h, value, len(value))
 
@@ -493,43 +586,47 @@ class ServeEngine:
         self._lib.jy_tlog_delta_raise_cutoff(self._h, row, c)
 
     def tlog_flush_deltas(self):
-        """Sorted [(key, (entries latest-first, cutoff))]; clears."""
-        cap = 256
-        while True:
-            rows = np.empty(cap, np.int64)
-            n = self._lib.jy_tlog_export_delta_rows(
-                self._h, rows.ctypes.data, cap
-            )
-            if n >= 0:
-                break
-            cap = -n
+        """Sorted [(key, (entries latest-first, cutoff))]; clears. ONE
+        bulk FFI pass (see treg_flush_deltas)."""
+        n = ctypes.c_int64()
+        te = ctypes.c_int64()
+        kb = ctypes.c_int64()
+        self._lib.jy_tlog_deltas_info(
+            self._h, ctypes.byref(n), ctypes.byref(te), ctypes.byref(kb)
+        )
+        n = n.value
+        if n == 0:
+            return []
+        counts = np.empty(n, np.int64)
+        cutoffs = np.empty(n, np.uint64)
+        ts_flat = np.empty(max(te.value, 1), np.uint64)
+        vid_flat = np.empty(max(te.value, 1), np.int32)
+        ko = np.empty(n, np.int64)
+        kl = np.empty(n, np.int64)
+        kblob = np.empty(max(kb.value, 1), np.uint8)
+        self._lib.jy_tlog_export_deltas_bulk(
+            self._h, counts.ctypes.data, cutoffs.ctypes.data,
+            ts_flat.ctypes.data, vid_flat.ctypes.data,
+            ko.ctypes.data, kl.ctypes.data, kblob.ctypes.data,
+        )
+        self._lib.jy_tlog_clear_deltas(self._h)
+        if int(vid_flat[: te.value].max(initial=-1)) >= len(self._tlog_vals):
+            self._tlog_refill_vals()
+        vals = self._tlog_vals
+        kbytes = kblob.tobytes()
+        ts_l = ts_flat.tolist()
+        vid_l = vid_flat.tolist()
         out = []
-        for i in range(n):
-            row = int(rows[i])
-            dn = 16
-            while True:
-                ts = np.empty(dn, np.uint64)
-                vid = np.empty(dn, np.int32)
-                m = self._lib.jy_tlog_export_delta(
-                    self._h, row, ts.ctypes.data, vid.ctypes.data, dn
-                )
-                if m >= 0:
-                    break
-                dn = -m
+        e = 0
+        for i, (c, cut, o, ln) in enumerate(
+            zip(counts.tolist(), cutoffs.tolist(), ko.tolist(), kl.tolist())
+        ):
             ents = sorted(
-                ((int(ts[j]), self._tlog_val(int(vid[j]))) for j in range(m)),
+                ((ts_l[j], vals[vid_l[j]]) for j in range(e, e + c)),
                 reverse=True,
             )
-            out.append(
-                (
-                    self.tlog_key_of(row),
-                    (
-                        [(v, t) for t, v in ents],
-                        self._lib.jy_tlog_delta_cutoff(self._h, row),
-                    ),
-                )
-            )
-        self._lib.jy_tlog_clear_deltas(self._h)
+            e += c
+            out.append((kbytes[o : o + ln], ([(v, t) for t, v in ents], cut)))
         out.sort()
         return out
 

@@ -447,6 +447,12 @@ class ResidentStore:
         self._floor_w = 1  # admission widths until the next exact read
         self._floor_c = 1
         self._inflight: list = []  # [(live_arr, grow_w, grow_c), ...]
+        # lazily-batched broadcast deltas (fold_in_broadcast): joins
+        # commute, so buffered rounds coalesce into ONE (R*D, W) fold at
+        # the next read/drain/threshold — amortising the per-dispatch
+        # latency that bounded the small-doc anti-entropy stream
+        # (round-5 verdict item 5)
+        self._bcast_pend: list = []
         # the largest seq ever encoded into the store: a causal context
         # covers its dot store, so the running max over delta vv/cloud
         # seqs bounds every seq on device — which is what makes the
@@ -491,6 +497,7 @@ class ResidentStore:
 
     def block(self) -> None:
         """Wait for every queued device mutation (timing/shutdown)."""
+        self._flush_broadcast()
         if self._batch is not None:
             jax.block_until_ready(self._batch.dots)
 
@@ -789,6 +796,8 @@ class ResidentStore:
     def admit(self, items: list[tuple[bytes, object]]) -> None:
         """Make keys resident with their current host docs (encoded ONCE;
         after this only reads ever decode them again)."""
+        # buffered broadcasts target the rows present when they arrived
+        self._flush_broadcast()
         items = [(k, d) for k, d in items if k not in self._rows]
         if not items:
             return
@@ -855,6 +864,7 @@ class ResidentStore:
     def discard(self, key: bytes) -> None:
         """Drop a key's row WITHOUT decoding (the caller already holds a
         current host view, e.g. the serving repo's read cache)."""
+        self._flush_broadcast()  # the departing row must absorb its share
         row = self._rows.pop(key)
         mask = np.zeros(self._row_axis(), bool)
         mask[row] = True
@@ -869,6 +879,7 @@ class ResidentStore:
         Raises OverflowError (rows unchanged) when a delta exceeds the
         u64/32 layout; the caller demotes those keys to the host
         lattice."""
+        self._flush_broadcast()
         pending = {k: v for k, v in pending.items() if v and k in self._rows}
         if not pending:
             return
@@ -900,14 +911,31 @@ class ResidentStore:
         else:
             self._fold_aligned(pending, grow_w, grow_c)
 
+    # buffered broadcast deltas past this count force a flush, bounding
+    # host memory and the single fold's delta axis
+    BCAST_FLUSH_DELTAS = 4096
+
     def fold_in_broadcast(self, deltas: list) -> None:
         """Fold one delta list into EVERY resident row (the all-replicas
-        anti-entropy shape). Same contracts as fold_in."""
+        anti-entropy shape). Same contracts as fold_in, but LAZY: the
+        join is commutative and associative, so consecutive rounds buffer
+        and coalesce into one (R*D, W) fold at the next read, per-key
+        drain, admission/eviction, or threshold — one dispatch where the
+        eager path paid one per round."""
         if not deltas or not self._rows:
             return
-        from .ujson_host import UJSON
-
         self._note_seqs(deltas)
+        self._bcast_pend.extend(deltas)
+        if len(self._bcast_pend) >= self.BCAST_FLUSH_DELTAS:
+            self._flush_broadcast()
+
+    def _flush_broadcast(self) -> None:
+        if not self._bcast_pend:
+            return
+        deltas, self._bcast_pend = self._bcast_pend, []
+        if not self._rows:
+            return
+        from .ujson_host import UJSON
         # wire path: the whole list as ONE (1, D, W) grid segment
         grid = self._grid_from_wire([list(deltas)])
         if grid is not None:
@@ -971,6 +999,7 @@ class ResidentStore:
         return self.read_many([key])[0]
 
     def read_many(self, keys: list[bytes]) -> list:
+        self._flush_broadcast()
         rows = jnp.asarray(
             np.array([self._rows[k] for k in keys], np.int32)
         )

@@ -146,7 +146,6 @@ class Server:
                     writer.write(replies)
                 for mgr, ch in zip(mgrs, changed):
                     if ch:
-                        mgr._on_change()
                         mgr._maybe_proactive_flush()
             del buf[:consumed]
             if rc == 1:  # one command for the Python path, in order

@@ -116,6 +116,20 @@ int64_t jy_eng_export_pending(void* e, int32_t which, int64_t* rows,
     return n;
 }
 
+// rows changed since the last sync-digest pass (F_SYNCD); clears
+int64_t jy_eng_export_sync_dirty(void* e, int32_t which, int64_t* rows,
+                                 int64_t cap) {
+    Table& t = static_cast<Engine*>(e)->t[which];
+    int64_t n = static_cast<int64_t>(t.sync_dirty.size());
+    if (n > cap) return -n;
+    for (int64_t i = 0; i < n; i++) {
+        rows[i] = t.sync_dirty[i];
+        t.flags[t.sync_dirty[i]] &= static_cast<uint8_t>(~F_SYNCD);
+    }
+    t.sync_dirty.clear();
+    return n;
+}
+
 int64_t jy_eng_dirty_count(void* e, int32_t which) {
     return static_cast<int64_t>(
         static_cast<Engine*>(e)->t[which].dirty_rows.size());

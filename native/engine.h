@@ -117,6 +117,8 @@ constexpr uint8_t F_PEND_N = 8;
 // (an INC of 0 still creates the entry)
 constexpr uint8_t F_OWNSET_P = 16;
 constexpr uint8_t F_OWNSET_N = 32;
+// row changed since the last sync-digest pass (cluster/syncdigest)
+constexpr uint8_t F_SYNCD = 64;
 
 struct Table {
     KeyIndex idx;
@@ -129,6 +131,7 @@ struct Table {
     std::vector<uint8_t> flags;
     std::vector<int64_t> dirty_rows;  // insertion order; F_DIRTY dedups
     std::vector<int64_t> pend_rows;   // rows with any F_PEND_*
+    std::vector<int64_t> sync_dirty;  // rows changed since last digest
 
     int64_t find(const uint8_t* k, int64_t n) const { return idx.find(k, n); }
 
@@ -164,6 +167,10 @@ struct Table {
         if (!(flags[row] & (F_PEND_P | F_PEND_N))) pend_rows.push_back(row);
         flags[row] |= bit;
         mark_dirty(row);
+        if (!(flags[row] & F_SYNCD)) {
+            flags[row] |= F_SYNCD;
+            sync_dirty.push_back(row);
+        }
         value[row] += polarity ? static_cast<uint64_t>(-amount) : amount;
     }
 };
@@ -192,6 +199,9 @@ struct TregTable {
     std::vector<std::string> delta_val;
     std::vector<uint8_t> delta_set;
     std::vector<int64_t> delta_rows;
+    // rows changed since the last sync-digest pass
+    std::vector<uint8_t> sync_flag;
+    std::vector<int64_t> sync_dirty;
 
     static bool wins(uint64_t ts, const uint8_t* v, int64_t n,
                      uint64_t cur_ts, const std::string& cur) {
@@ -215,12 +225,17 @@ struct TregTable {
             delta_ts.push_back(0);
             delta_val.emplace_back();
             delta_set.push_back(0);
+            sync_flag.push_back(0);
         }
         return row;
     }
 
     // local SET / cluster converge both funnel here (repo_treg.py _write)
     void write(int64_t row, uint64_t ts, const uint8_t* v, int64_t n) {
+        if (!sync_flag[row]) {
+            sync_flag[row] = 1;
+            sync_dirty.push_back(row);
+        }
         if (!pend_set[row]) {
             pend_set[row] = 1;
             pend_ts[row] = ts;
@@ -334,6 +349,7 @@ struct TlogRow {
     bool delta_present = false;
     TlogSet delta;
     uint64_t delta_cutoff = 0;
+    bool sync_flag = false;  // in TlogTable::sync_dirty
 };
 
 struct TlogTable {
@@ -346,6 +362,7 @@ struct TlogTable {
     bool row_overdue = false;     // some row's pend crossed ROW_DRAIN
     std::vector<int64_t> delta_rows;    // rows with delta_present
     std::vector<int64_t> touched_list;  // rows with pend or pend_cutoff
+    std::vector<int64_t> sync_dirty;    // rows changed since last digest
     int64_t live_total = 0;  // sum of len_cache over all rows (O(1) reads)
     int64_t compact_floor;  // value-interner size below which no compact
 
@@ -390,6 +407,14 @@ struct TlogTable {
             r.touched = true;
             touched_list.push_back(row_i);
         }
+        mark_sync(r, row_i);
+    }
+
+    void mark_sync(TlogRow& r, int64_t row_i) {
+        if (!r.sync_flag) {
+            r.sync_flag = true;
+            sync_dirty.push_back(row_i);
+        }
     }
 
     void append_pend(TlogRow& r, int64_t row_i, TlogEnt e) {
@@ -413,6 +438,7 @@ struct TlogTable {
             if (r.memo_plen != static_cast<int64_t>(r.pend.size()) - 1 ||
                 r.memo_cut != cut) {
                 r.memo_valid = false;
+                TlogSet().swap(r.memo);  // free, don't retain dead sets
             } else {
                 if (ts >= cut) r.memo.insert(e);
                 r.memo_plen = static_cast<int64_t>(r.pend.size());
@@ -479,6 +505,7 @@ struct TlogTable {
             r.base.clear();
             r.base_valid = (len == 0);
         }
+        mark_sync(r, row_i);  // a fused trim can change the merged view
         live_total += len - r.len_cache;
         r.len_cache = len;
         r.cut_cache = cut;
@@ -531,10 +558,18 @@ struct TlogTable {
                 live++;
             }
         };
-        for (const TlogRow& r : rows) {
+        for (TlogRow& r : rows) {
             for (const TlogEnt& e : r.pend) see(e);
             for (const TlogEnt& e : r.base) see(e);
-            for (const TlogEnt& e : r.memo) see(e);
+            if (memo_current(r)) {
+                for (const TlogEnt& e : r.memo) see(e);
+            } else if (!r.memo.empty()) {
+                // a state-stale memo (e.g. converge_entry appended past
+                // it) is dead weight: free it rather than keeping its
+                // vids alive through the compaction
+                r.memo_valid = false;
+                TlogSet().swap(r.memo);
+            }
             for (const TlogEnt& e : r.delta) see(e);
         }
         if (static_cast<int64_t>(vals.size()) <= 2 * live + VAL_COMPACT_SLACK) {

