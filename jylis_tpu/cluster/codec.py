@@ -89,6 +89,54 @@ def delta_signature() -> bytes:
     return hashlib.sha256("\n".join(delta_lines).encode()).digest()
 
 
+# the exact schema texts earlier releases stamped into snapshot headers
+# via the FULL signature() — their delta lines are byte-identical to
+# v3's, so those files remain loadable; kept verbatim (not derived from
+# _SCHEMA_TEXT) so future schema edits cannot silently change what a
+# legacy header means
+_LEGACY_V1_TEXT = """jylis-tpu cluster schema v1
+varint=LEB128 bytes=varint-len-prefixed str=utf8-bytes
+addr=(host:str port:str name:str)
+p2set=(adds:[addr] removes:[addr])
+msg0=Pong
+msg1=ExchangeAddrs(p2set)
+msg2=AnnounceAddrs(p2set)
+msg3=PushDeltas(name:str batch:[(key:bytes delta)])
+delta/TREG=(value:bytes ts:varint)
+delta/TLOG=delta/SYSTEM=(entries:[(value:bytes ts:varint)] cutoff:varint)
+delta/GCOUNT=[(rid:varint v:varint)]
+delta/PNCOUNT=(gcount gcount)
+delta/UJSON=(entries:[(rid seq path:[str] token:str)] vv:[(rid seq)] cloud:[(rid seq)])
+"""
+
+_LEGACY_V2_TEXT = """jylis-tpu cluster schema v2
+varint=LEB128 bytes=varint-len-prefixed str=utf8-bytes
+addr=(host:str port:str name:str)
+p2set=(adds:[addr] removes:[addr])
+msg0=Pong
+msg1=ExchangeAddrs(p2set)
+msg2=AnnounceAddrs(p2set)
+msg3=PushDeltas(name:str batch:[(key:bytes delta)])
+msg4=SyncRequest
+delta/TREG=(value:bytes ts:varint)
+delta/TLOG=delta/SYSTEM=(entries:[(value:bytes ts:varint)] cutoff:varint)
+delta/GCOUNT=[(rid:varint v:varint)]
+delta/PNCOUNT=(gcount gcount)
+delta/UJSON=(entries:[(rid seq path:[str] token:str)] vv:[(rid seq)] cloud:[(rid seq)])
+"""
+
+
+def legacy_snapshot_signatures() -> tuple[bytes, ...]:
+    """Snapshot headers older releases wrote that THIS build still reads:
+    the delta encodings they version are unchanged (persist.py accepts
+    these alongside delta_signature(), so upgrading a single-node
+    deployment never strands its only data copy)."""
+    return (
+        hashlib.sha256(_LEGACY_V1_TEXT.encode()).digest(),
+        hashlib.sha256(_LEGACY_V2_TEXT.encode()).digest(),
+    )
+
+
 # the reader primitives live in utils/wire.py (shared with the lazy wire
 # objects in ops/ujson_wire.py); a WireError IS this module's CodecError
 CodecError = WireError

@@ -104,20 +104,21 @@ def encode_push(msg: MsgPushDeltas) -> bytes | None:
 def _encode_counters(cdll, msg: MsgPushDeltas, ndicts: int) -> bytes | None:
     batch = msg.batch
     key_blob, key_off, key_len = _key_blob(batch)
-    counts = np.empty(len(batch) * ndicts, np.int64)
+    counts_l: list[int] = []
     rids: list[int] = []
     vals: list[int] = []
-    for i, (_key, delta) in enumerate(batch):
+    # spans ship in dict-iteration order (keys()/values() extends are
+    # C-speed); the native encoder sorts each span by rid on the wire —
+    # the per-key sorted() this replaces dominated the whole encode
+    for _key, delta in batch:
         dicts = (delta,) if ndicts == 1 else delta
         if len(dicts) != ndicts:
             return None
-        for d, dct in enumerate(dicts):
-            items = sorted(dct.items())
-            counts[i * ndicts + d] = len(items)
-            if items:
-                r, v = zip(*items)
-                rids.extend(r)
-                vals.extend(v)
+        for dct in dicts:
+            counts_l.append(len(dct))
+            rids.extend(dct.keys())
+            vals.extend(dct.values())
+    counts = np.asarray(counts_l, np.int64)
     rid_arr = _u64_array(rids)
     val_arr = _u64_array(vals)
     if rid_arr is None or val_arr is None:
@@ -330,6 +331,66 @@ def decode_push(body: bytes) -> Msg | None:
     return None
 
 
+class LazyU64Map:
+    """A counter delta ({rid: u64}) decoded lazily from the wire arrays —
+    the counter analog of ops/ujson_wire.WireUJSON: the wire decode
+    banks list slices in O(1) per key and the dict materialises only
+    when a consumer (converge's .items(), re-encode, equality) actually
+    walks it. Compares equal to the real dict it denotes."""
+
+    __slots__ = ("_rids", "_vals", "_lo", "_n", "_real")
+
+    def __init__(self, rids, vals, lo, n):
+        self._rids = rids
+        self._vals = vals
+        self._lo = lo
+        self._n = n
+        self._real = None
+
+    def _mat(self) -> dict:
+        real = self._real
+        if real is None:
+            lo = self._lo
+            real = self._real = dict(
+                zip(self._rids[lo : lo + self._n], self._vals[lo : lo + self._n])
+            )
+        return real
+
+    def __eq__(self, other):
+        if isinstance(other, LazyU64Map):
+            other = other._mat()
+        return self._mat() == other
+
+    __hash__ = None  # mutable-mapping semantics, like dict
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __iter__(self):
+        return iter(self._mat())
+
+    def __getitem__(self, k):
+        return self._mat()[k]
+
+    def __contains__(self, k) -> bool:
+        return k in self._mat()
+
+    def get(self, k, default=None):
+        return self._mat().get(k, default)
+
+    def items(self):
+        return self._mat().items()
+
+    def keys(self):
+        return self._mat().keys()
+
+    def values(self):
+        return self._mat().values()
+
+    def __repr__(self) -> str:
+        return repr(self._mat())
+
+
 def _decode_counters(cdll, name, rest, ndicts) -> Msg | None:
     n_keys = ctypes.c_int64()
     total = ctypes.c_int64()
@@ -362,7 +423,7 @@ def _decode_counters(cdll, name, rest, ndicts) -> Msg | None:
         dicts = []
         for d in range(ndicts):
             c = cl[k * ndicts + d]
-            dicts.append(dict(zip(rid_l[e : e + c], val_l[e : e + c])))
+            dicts.append(LazyU64Map(rid_l, val_l, e, c))
             e += c
         batch.append((key, dicts[0] if ndicts == 1 else tuple(dicts)))
     return MsgPushDeltas(name, tuple(batch))

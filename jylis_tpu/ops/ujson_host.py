@@ -60,6 +60,12 @@ class CausalContext:
             and self.cloud == other.cloud
         )
 
+    # defining __eq__ sets __hash__ to None implicitly; keep that intent
+    # EXPLICIT: contexts are mutable lattice state and must never be
+    # dict keys or set members (a silent identity-hash would let two
+    # equal contexts land in different buckets)
+    __hash__ = None
+
     def add(self, dot: Dot) -> None:
         self.cloud.add(dot)
         self.compact()
@@ -149,6 +155,8 @@ class UJSON:
             and self.entries == other.entries
             and self.ctx == other.ctx
         )
+
+    __hash__ = None  # see CausalContext.__hash__: mutable, never hashable
 
     # ---- queries ----------------------------------------------------------
 

@@ -253,11 +253,20 @@ def fold_join_aligned(
 
 @partial(jax.jit, static_argnames=("shift", "out_w", "out_c"))
 def fold_broadcast_rows(
-    resident: DocBatch, deltas: DocBatch, shift: int, out_w: int, out_c: int
+    resident: DocBatch,
+    deltas: DocBatch,
+    occupied,
+    shift: int,
+    out_w: int,
+    out_c: int,
 ) -> tuple[DocBatch, jax.Array]:
-    """Fold a (D, W) delta batch to ONE doc and join it into EVERY
-    resident row — the N-replica anti-entropy fan-in with the replica
-    documents already resident (bench config 5 drives this)."""
+    """Fold a (D, W) delta batch to ONE doc and join it into every
+    OCCUPIED resident row — the N-replica anti-entropy fan-in with the
+    replica documents already resident (bench config 5 drives this).
+    Scratch row 0 and free rows re-clear in the same dispatch, so the
+    row-0-is-identity invariant holds and the returned live widths
+    measure occupied rows only (free-row garbage would inflate the
+    store's width bound — ADVICE round 4)."""
     if deltas.dots.shape[0] <= 64:
         folded = _fold_flat_one(deltas, shift)
         folded = DocBatch(*(p[None] for p in folded))
@@ -268,6 +277,7 @@ def fold_broadcast_rows(
         *(jnp.broadcast_to(p, (b,) + p.shape[1:]) for p in folded)
     )
     out = _finish(_join_inside(resident, tiled, shift), shift, out_w, out_c)
+    out = clear_rows(out, ~occupied)
     return out, live_widths(out)
 
 
@@ -912,8 +922,12 @@ class ResidentStore:
             self._fold_aligned(pending, grow_w, grow_c)
 
     # buffered broadcast deltas past this count force a flush, bounding
-    # host memory and the single fold's delta axis
-    BCAST_FLUSH_DELTAS = 4096
+    # host memory and the single fold's delta axis. Measured on the
+    # 32-replica stream (bench.py --config ujson-32): coalescing is
+    # monotonically better through 10k+ deltas (one 10240-delta fold
+    # beats two 5120-delta folds ~1.3x and eager per-round folds ~2.4x),
+    # so the cap is a memory/width bound, not a performance knob
+    BCAST_FLUSH_DELTAS = 16384
 
     def fold_in_broadcast(self, deltas: list) -> None:
         """Fold one delta list into EVERY resident row (the all-replicas
@@ -956,10 +970,13 @@ class ResidentStore:
                 grow_w += len(x.entries)
                 grow_c += len(x.ctx.cloud)
         out_w, out_c = self._budget_widths(grow_w, grow_c)
+        occ = np.zeros(self._row_axis(), bool)
+        occ[list(self._rows.values())] = True
         # the delta batch's leading axis is deltas, not resident rows;
         # it stays replicated (only the resident planes are row-sharded)
         out, live = fold_broadcast_rows(
-            self._batch, batch, shift=self._shift, out_w=out_w, out_c=out_c
+            self._batch, batch, jnp.asarray(occ),
+            shift=self._shift, out_w=out_w, out_c=out_c,
         )
         self._batch = self._shard(self._note_fold(out, live, grow_w, grow_c))
 

@@ -67,7 +67,11 @@ def load_snapshot(database, path: str) -> int:
     if blob[: len(MAGIC)] != MAGIC:
         raise SnapshotError("not a snapshot file")
     sig_end = len(MAGIC) + len(codec.delta_signature())
-    if blob[len(MAGIC) : sig_end] != codec.delta_signature():
+    header = blob[len(MAGIC) : sig_end]
+    accepted = (codec.delta_signature(),) + codec.legacy_snapshot_signatures()
+    if header not in accepted:
+        # NOT recoverable by this build: main.py moves the file aside as
+        # .unreadable rather than deleting it
         raise SnapshotError("snapshot schema signature mismatch")
     # snapshots are read whole from local disk: no adversarial peer to
     # bound against, so lift the wire-oriented frame cap

@@ -20,8 +20,11 @@
 // unencodable). measure/decode -> 0 ok, -1 malformed, -2 unsupported here
 // (caller falls back to the Python oracle, e.g. varints past 64 bits).
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
+#include <utility>
+#include <vector>
 
 namespace {
 
@@ -146,14 +149,42 @@ int64_t jy_push_counters_encode(
   w.bytes(name, name_len);
   w.varint(static_cast<uint64_t>(n_keys));
   int64_t e = 0;
+  // the wire orders each dict's entries by replica id; spans arrive in
+  // Python dict-iteration order and sort HERE (insertion sort on the
+  // small spans — the per-key sorted() this replaces dominated encode)
+  uint64_t sr[64];
+  uint64_t sv[64];
   for (int64_t k = 0; k < n_keys; k++) {
     w.bytes(key_base + key_off[k], key_len[k]);
     for (int32_t d = 0; d < ndicts; d++) {
       int64_t c = dict_counts[k * ndicts + d];
       w.varint(static_cast<uint64_t>(c));
-      for (int64_t i = 0; i < c; i++, e++) {
-        w.varint(rids[e]);
-        w.varint(vals[e]);
+      if (c <= 64) {
+        for (int64_t i = 0; i < c; i++) {
+          uint64_t r = rids[e + i], v = vals[e + i];
+          int64_t j = i;
+          while (j > 0 && sr[j - 1] > r) {
+            sr[j] = sr[j - 1];
+            sv[j] = sv[j - 1];
+            j--;
+          }
+          sr[j] = r;
+          sv[j] = v;
+        }
+        for (int64_t i = 0; i < c; i++) {
+          w.varint(sr[i]);
+          w.varint(sv[i]);
+        }
+        e += c;
+      } else {
+        std::vector<std::pair<uint64_t, uint64_t>> big;
+        big.reserve(c);
+        for (int64_t i = 0; i < c; i++, e++) big.emplace_back(rids[e], vals[e]);
+        std::sort(big.begin(), big.end());
+        for (auto& rv : big) {
+          w.varint(rv.first);
+          w.varint(rv.second);
+        }
       }
     }
   }

@@ -310,3 +310,37 @@ def test_repo_trickle_reads_stay_host_side(monkeypatch):
     r3 = _R()
     host.apply(r3, [b"GET", b"doc"])
     assert r3.vals[0] == got_trickle
+
+
+def test_broadcast_fold_keeps_free_rows_identity():
+    """ADVICE round 4: the broadcast fold must not leave garbage in
+    scratch row 0 or freed rows — the row-0-is-identity invariant holds
+    and live widths measure occupied rows only."""
+    import numpy as np
+
+    from jylis_tpu.ops.ujson_host import UJSON
+    from jylis_tpu.ops.ujson_resident import ResidentStore, _pad_of
+
+    store = ResidentStore(n_rep=4)
+    docs = []
+    for i in range(3):
+        d = UJSON()
+        d.set_doc(i + 1, ("f",), str(i))
+        docs.append((b"k%d" % i, d))
+    store.admit(docs)
+    store.discard(b"k1")  # a freed row between occupied ones
+    delta = UJSON()
+    delta.set_doc(9, ("g",), "42", delta=None)
+    store.fold_in_broadcast([delta])
+    store.block()
+    store._flush_broadcast()
+    batch = store._batch
+    dots = np.asarray(batch.dots)
+    pad = _pad_of(batch.dots.dtype)
+    freed = store._free + [0]
+    for row in freed:
+        assert (dots[row] == pad).all(), f"row {row} not identity"
+    # occupied rows absorbed the broadcast
+    for key in (b"k0", b"k2"):
+        doc = store.read(key)
+        assert doc.render(("g",)) == "42"

@@ -216,3 +216,27 @@ def test_online_snapshot_survives_sigkill(tmp_path):
         assert c.execute_command("TLOG", "SIZE", "log") == 1
     finally:
         stop_node(proc)
+
+
+def test_legacy_v2_snapshot_header_loads(tmp_path):
+    """Snapshots written by the v2-era release stamped the FULL schema
+    signature; the delta encodings are unchanged, so this build must
+    load them (ADVICE round 4: an upgrade must not strand a single-node
+    deployment's only data copy)."""
+    from jylis_tpu.cluster import codec
+
+    db = Database(identity=1)
+    populate(db)
+    path = tmp_path / "snap"
+    persist.save_snapshot(db, str(path))
+    blob = path.read_bytes()
+    for v, legacy in enumerate(codec.legacy_snapshot_signatures(), start=1):
+        assert len(legacy) == len(codec.delta_signature())
+        sig_end = len(persist.MAGIC) + len(legacy)
+        old_style = persist.MAGIC + legacy + blob[sig_end:]
+        old_path = tmp_path / f"snap_v{v}"
+        old_path.write_bytes(old_style)
+        db2 = Database(identity=1)
+        assert persist.load_snapshot(db2, str(old_path)) > 0
+        for args, want in READS.items():
+            assert call(db2, *args) == want, (v, args)
