@@ -4,10 +4,14 @@
 PY ?= python
 
 .PHONY: test bench bench-all bench-full native run clean check-graft ci \
-        image compose-smoke smoke3 release
+        check-prose image compose-smoke smoke3 release
 
 # what CI runs per commit (.github/workflows/ci.yml): hermetic on any host
-ci: native test check-graft
+ci: native test check-graft check-prose
+
+# every README headline number must match the committed BENCH_full.json
+check-prose:
+	$(PY) scripts/check_prose.py
 
 test:
 	$(PY) -m pytest tests/ -x -q

@@ -267,10 +267,13 @@ def config_concurrent() -> dict:
     under CONCURRENT connections — 16 and 64 pipelined clients issuing a
     mixed all-five-types workload (INC/DEC/GET/SET/INS/SIZE) against
     per-client keys, through the real RESP server. The reference serves
-    each connection in its own actor (server_notify.pony:33-36); here the
-    asyncio loop multiplexes connections with device-bound work pushed to
-    threads. Baseline: the same command mix as bare Python dict/list
-    loops (the reference's per-command work), single-threaded."""
+    each connection in its own actor (server_notify.pony:33-36); here
+    whole pipelined bursts of ANY command mix settle in the native
+    serving engine (native/serve_engine.cpp) in one FFI call, with
+    device-bound work pushed to threads. Baseline: the same command mix
+    as bare Python dict/list loops (the reference's per-command work),
+    single-threaded — a baseline that pays no parsing, sockets, or
+    replies."""
     from jylis_tpu.ops.hostref import GCounter, PNCounter
 
     r16 = _concurrent_rate(16)

@@ -126,6 +126,27 @@ delta/UJSON=(entries:[(rid seq path:[str] token:str)] vv:[(rid seq)] cloud:[(rid
 """
 
 
+# the early-v3 window ALSO stamped the full signature() (persist.py
+# switched to delta_signature() later in that release cycle); the v3
+# text is frozen verbatim like the others so a future schema v4 cannot
+# silently change what this header means
+_LEGACY_V3_TEXT = """jylis-tpu cluster schema v3
+varint=LEB128 bytes=varint-len-prefixed str=utf8-bytes
+addr=(host:str port:str name:str)
+p2set=(adds:[addr] removes:[addr])
+msg0=Pong
+msg1=ExchangeAddrs(p2set)
+msg2=AnnounceAddrs(p2set)
+msg3=PushDeltas(name:str batch:[(key:bytes delta)])
+msg4=SyncRequest(digest:bytes)
+delta/TREG=(value:bytes ts:varint)
+delta/TLOG=delta/SYSTEM=(entries:[(value:bytes ts:varint)] cutoff:varint)
+delta/GCOUNT=[(rid:varint v:varint)]
+delta/PNCOUNT=(gcount gcount)
+delta/UJSON=(entries:[(rid seq path:[str] token:str)] vv:[(rid seq)] cloud:[(rid seq)])
+"""
+
+
 def legacy_snapshot_signatures() -> tuple[bytes, ...]:
     """Snapshot headers older releases wrote that THIS build still reads:
     the delta encodings they version are unchanged (persist.py accepts
@@ -134,6 +155,7 @@ def legacy_snapshot_signatures() -> tuple[bytes, ...]:
     return (
         hashlib.sha256(_LEGACY_V1_TEXT.encode()).digest(),
         hashlib.sha256(_LEGACY_V2_TEXT.encode()).digest(),
+        hashlib.sha256(_LEGACY_V3_TEXT.encode()).digest(),
     )
 
 

@@ -391,6 +391,59 @@ class LazyU64Map:
         return repr(self._mat())
 
 
+class LazyPNPair:
+    """A PNCOUNT delta ((p_dict, n_dict)) decoded lazily from the wire
+    arrays — one banked object per key instead of two maps plus a tuple,
+    which matters because decode cost at this batch scale is dominated
+    by Python allocation (each allocation tranche triggers gen-0 GC
+    passes that walk every live JAX buffer). Compares equal to the real
+    pair it denotes and unpacks like one."""
+
+    __slots__ = ("_rids", "_vals", "_lo", "_np", "_nn", "_real")
+
+    def __init__(self, rids, vals, lo, n_p, n_n):
+        self._rids = rids
+        self._vals = vals
+        self._lo = lo
+        self._np = n_p
+        self._nn = n_n
+        self._real = None
+
+    def _mat(self) -> tuple:
+        real = self._real
+        if real is None:
+            lo, mid = self._lo, self._lo + self._np
+            real = self._real = (
+                dict(zip(self._rids[lo:mid], self._vals[lo:mid])),
+                dict(
+                    zip(
+                        self._rids[mid : mid + self._nn],
+                        self._vals[mid : mid + self._nn],
+                    )
+                ),
+            )
+        return real
+
+    def __eq__(self, other):
+        if isinstance(other, LazyPNPair):
+            other = other._mat()
+        return self._mat() == other
+
+    __hash__ = None
+
+    def __len__(self) -> int:
+        return 2
+
+    def __iter__(self):
+        return iter(self._mat())
+
+    def __getitem__(self, i):
+        return self._mat()[i]
+
+    def __repr__(self) -> str:
+        return repr(self._mat())
+
+
 def _decode_counters(cdll, name, rest, ndicts) -> Msg | None:
     n_keys = ctypes.c_int64()
     total = ctypes.c_int64()
@@ -418,14 +471,24 @@ def _decode_counters(cdll, name, rest, ndicts) -> Msg | None:
     cl = counts.tolist()
     batch = []
     e = 0
-    for k in range(nk):
-        key = rest[ko[k] : ko[k] + kl[k]]
-        dicts = []
-        for d in range(ndicts):
-            c = cl[k * ndicts + d]
-            dicts.append(LazyU64Map(rid_l, val_l, e, c))
+    if ndicts == 1:
+        for k in range(nk):
+            c = cl[k]
+            batch.append(
+                (rest[ko[k] : ko[k] + kl[k]], LazyU64Map(rid_l, val_l, e, c))
+            )
             e += c
-        batch.append((key, dicts[0] if ndicts == 1 else tuple(dicts)))
+    else:
+        for k in range(nk):
+            cp = cl[2 * k]
+            cn = cl[2 * k + 1]
+            batch.append(
+                (
+                    rest[ko[k] : ko[k] + kl[k]],
+                    LazyPNPair(rid_l, val_l, e, cp, cn),
+                )
+            )
+            e += cp + cn
     return MsgPushDeltas(name, tuple(batch))
 
 

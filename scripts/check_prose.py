@@ -1,0 +1,83 @@
+"""Prose-vs-record guard (round-5 verdict item 4).
+
+Every headline number in README.md must be derived from the committed
+BENCH_full.json — this script regenerates the expected prose token for
+each pinned claim from the record and fails if the README does not
+contain it. Re-recording the sweep without updating the prose (or vice
+versa) fails CI instead of shipping a disagreement.
+
+Run via `make check-prose` (part of `make ci`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def fmt_millions(v: float) -> str:
+    return f"{v / 1e6:.1f}M"
+
+
+def fmt_ratio(v: float) -> str:
+    return f"{v:.1f}×"
+
+
+# (file, config, record field, formatter, human label) — each token must
+# appear verbatim in its file
+CLAIMS = [
+    ("README.md", "north-star", "value", fmt_millions, "north-star merges/sec"),
+    ("README.md", "north-star", "vs_baseline", fmt_ratio, "north-star ratio"),
+    ("README.md", "treg-1m", "vs_baseline", fmt_ratio, "TREG ratio"),
+    ("README.md", "tlog-trim", "vs_baseline", fmt_ratio, "TLOG ratio"),
+    ("README.md", "ujson-multikey", "vs_baseline", fmt_ratio,
+     "UJSON deep-fan-in ratio"),
+    ("README.md", "ujson-32", "vs_baseline", fmt_ratio,
+     "UJSON 32-replica ratio"),
+    ("README.md", "gcount-smoke", "value", fmt_millions,
+     "gcount-smoke commands/sec"),
+    ("README.md", "gcount-smoke", "vs_baseline", fmt_ratio,
+     "gcount-smoke ratio"),
+    ("README.md", "concurrent", "value", fmt_millions,
+     "concurrent commands/sec"),
+    ("README.md", "concurrent", "vs_baseline", fmt_ratio,
+     "concurrent ratio"),
+    # type docs that cite BENCH_full.json by name carry the same duty
+    ("docs/types/pncount.md", "north-star", "value", fmt_millions,
+     "pncount doc merges/sec"),
+    ("docs/types/ujson.md", "ujson-multikey", "vs_baseline", fmt_ratio,
+     "ujson doc deep-fan-in ratio"),
+]
+
+
+def main() -> int:
+    with open(os.path.join(ROOT, "BENCH_full.json")) as f:
+        record = {row["config"]: row for row in json.load(f)}
+    texts = {}
+    failures = []
+    for fname, config, field, fmt, label in CLAIMS:
+        if fname not in texts:
+            with open(os.path.join(ROOT, fname)) as f:
+                texts[fname] = f.read()
+        expect = fmt(record[config][field])
+        if expect not in texts[fname]:
+            failures.append(
+                f"  {label}: {fname} lacks '{expect}' "
+                f"(BENCH_full.json {config}.{field} = {record[config][field]})"
+            )
+    if failures:
+        print("prose/record disagreement (update the prose or re-record):")
+        print("\n".join(failures))
+        return 1
+    print(
+        f"check-prose: {len(CLAIMS)} claims across {len(texts)} files "
+        "match BENCH_full.json"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
