@@ -26,30 +26,45 @@ def fmt_ratio(v: float) -> str:
     return f"{v:.1f}×"
 
 
-# (file, config, record field, formatter, human label) — each token must
-# appear verbatim in its file
+def fmt_percent(v: float) -> str:
+    return f"{v * 100:.0f}%"
+
+
+# (file, config, record field, formatter, anchor template, human label):
+# the formatted token substitutes into the template, and THAT phrase must
+# appear verbatim in its file. Templates anchor each claim to its own
+# sentence so a cross-sweep RANGE elsewhere in the prose (e.g.
+# "0.9-1.2×") can never satisfy a drifted headline by substring accident.
 CLAIMS = [
-    ("README.md", "north-star", "value", fmt_millions, "north-star merges/sec"),
-    ("README.md", "north-star", "vs_baseline", fmt_ratio, "north-star ratio"),
-    ("README.md", "treg-1m", "vs_baseline", fmt_ratio, "TREG ratio"),
-    ("README.md", "tlog-trim", "vs_baseline", fmt_ratio, "TLOG ratio"),
+    ("README.md", "north-star", "value", fmt_millions,
+     "**{}", "north-star merges/sec"),
+    ("README.md", "north-star", "vs_baseline", fmt_ratio,
+     "{} a vectorised-numpy", "north-star ratio"),
+    ("README.md", "treg-1m", "vs_baseline", fmt_ratio,
+     "TREG {}", "TREG ratio"),
+    ("README.md", "tlog-trim", "vs_baseline", fmt_ratio,
+     "TLOG {}", "TLOG ratio"),
     ("README.md", "ujson-multikey", "vs_baseline", fmt_ratio,
-     "UJSON deep-fan-in ratio"),
+     "records **{}**", "UJSON deep-fan-in ratio"),
     ("README.md", "ujson-32", "vs_baseline", fmt_ratio,
-     "UJSON 32-replica ratio"),
+     "edit stream {}", "UJSON 32-replica ratio"),
     ("README.md", "gcount-smoke", "value", fmt_millions,
-     "gcount-smoke commands/sec"),
+     "**{} commands/sec**", "gcount-smoke commands/sec"),
     ("README.md", "gcount-smoke", "vs_baseline", fmt_ratio,
-     "gcount-smoke ratio"),
+     "recorded, {} the bare", "gcount-smoke ratio"),
+    ("README.md", "gcount-smoke", "engine_only", fmt_millions,
+     "`engine_only` = {}", "gcount-smoke engine-only rate"),
+    ("README.md", "gcount-smoke", "socket_cost_frac", fmt_percent,
+     "`socket_cost_frac` = {}", "gcount-smoke socket cost"),
     ("README.md", "concurrent", "value", fmt_millions,
-     "concurrent commands/sec"),
+     "**{} commands/sec**", "concurrent commands/sec"),
     ("README.md", "concurrent", "vs_baseline", fmt_ratio,
-     "concurrent ratio"),
+     "recorded, {} the bare", "concurrent ratio"),
     # type docs that cite BENCH_full.json by name carry the same duty
     ("docs/types/pncount.md", "north-star", "value", fmt_millions,
-     "pncount doc merges/sec"),
+     "{} key-merges/sec recorded", "pncount doc merges/sec"),
     ("docs/types/ujson.md", "ujson-multikey", "vs_baseline", fmt_ratio,
-     "ujson doc deep-fan-in ratio"),
+     "stream: {} recorded", "ujson doc deep-fan-in ratio"),
 ]
 
 
@@ -58,11 +73,11 @@ def main() -> int:
         record = {row["config"]: row for row in json.load(f)}
     texts = {}
     failures = []
-    for fname, config, field, fmt, label in CLAIMS:
+    for fname, config, field, fmt, template, label in CLAIMS:
         if fname not in texts:
             with open(os.path.join(ROOT, fname)) as f:
                 texts[fname] = f.read()
-        expect = fmt(record[config][field])
+        expect = template.format(fmt(record[config][field]))
         if expect not in texts[fname]:
             failures.append(
                 f"  {label}: {fname} lacks '{expect}' "
