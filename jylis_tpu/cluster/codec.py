@@ -47,7 +47,7 @@ from .msg import (
     MsgSyncRequest,
 )
 
-SCHEMA_VERSION = 3
+SCHEMA_VERSION = 4
 
 # The canonical schema text: any change to the wire format MUST change this
 # string (bump SCHEMA_VERSION), which changes the signature, which makes
@@ -60,7 +60,7 @@ msg0=Pong
 msg1=ExchangeAddrs(p2set)
 msg2=AnnounceAddrs(p2set)
 msg3=PushDeltas(name:str batch:[(key:bytes delta)])
-msg4=SyncRequest(digest:bytes)
+msg4=SyncRequest(digests:[bytes] order=TREG,TLOG,GCOUNT,PNCOUNT,UJSON)
 delta/TREG=(value:bytes ts:varint)
 delta/TLOG=delta/SYSTEM=(entries:[(value:bytes ts:varint)] cutoff:varint)
 delta/GCOUNT=[(rid:varint v:varint)]
@@ -342,7 +342,9 @@ def _encode_oracle(msg: Msg) -> bytes:
             _w_delta(out, msg.name, delta)
     elif isinstance(msg, MsgSyncRequest):
         out.append(_TAG_SYNC_REQ)
-        _w_bytes(out, msg.digest)
+        _w_varint(out, len(msg.digests))
+        for d in msg.digests:
+            _w_bytes(out, d)
     else:
         raise CodecError(f"cannot encode {type(msg).__name__}")
     return bytes(out)
@@ -377,7 +379,7 @@ def _decode_oracle(body: bytes) -> Msg:
         )
         msg = MsgPushDeltas(name, batch)
     elif tag == _TAG_SYNC_REQ:
-        msg = MsgSyncRequest(r.bytes_())
+        msg = MsgSyncRequest(tuple(r.bytes_() for _ in range(r.varint())))
     else:
         raise CodecError(f"unknown message tag: {tag}")
     if not r.done():

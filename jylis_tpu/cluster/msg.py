@@ -50,18 +50,20 @@ class MsgSyncRequest:
     """Bootstrap/rejoin full-state sync (beyond the reference, which can
     permanently miss deltas flushed while a peer was away —
     cluster.pony:250-252 converges only what is pushed). The requester
-    sends this after establishing an active connection WITH its own
-    data-state digest; a peer whose digest matches replies MsgPong (the
-    requester is already in sync — a flapping connection re-ships
-    nothing), otherwise with its full state as chunked MsgPushDeltas
-    batches (the snapshot wire shape, persist.py), which converge
-    idempotently.
+    sends this after establishing an active connection (and periodically
+    thereafter) WITH its own PER-TYPE data-state digests; a peer whose
+    digests all match replies MsgPong (the requester is already in sync
+    — a flapping connection re-ships nothing), otherwise it streams ONLY
+    the mismatched types' state as chunked MsgPushDeltas batches (the
+    snapshot wire shape, persist.py), which converge idempotently.
 
-    digest: sha256 over the canonical encoded per-type dumps of the five
-    DATA types (SYSTEM excluded — its log advances on connection events
-    themselves, which would make two in-sync peers never match)."""
+    digests: one 32-byte incremental digest per DATA type, in
+    Database.DATA_TYPES order (TREG, TLOG, GCOUNT, PNCOUNT, UJSON —
+    SYSTEM excluded: its log advances on connection events themselves,
+    which would make two in-sync peers never match). Each is the XOR of
+    sha256(canonical per-key state) over the type's keys."""
 
-    digest: bytes = b""
+    digests: tuple = ()
 
 
 Msg = MsgPong | MsgExchangeAddrs | MsgAnnounceAddrs | MsgPushDeltas | MsgSyncRequest

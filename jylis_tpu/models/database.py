@@ -85,17 +85,22 @@ class Database:
                 x ^= int.from_bytes(h, "big")
         self._sync_xor[name] = x.to_bytes(32, "big")
 
-    async def sync_digest_async(self) -> bytes:
-        """The 32-byte digest of the five data types' canonical state —
-        converged peers (any op order, any backend) produce equal bytes.
+    async def sync_type_digests_async(self) -> tuple[bytes, ...]:
+        """One 32-byte digest PER data type (DATA_TYPES order) — converged
+        peers (any op order, any backend) produce equal bytes per type, so
+        a sync responder streams only the types that actually differ.
         Cost is O(keys written since the last call): each repo folds only
         its dirty keys, under its own lock, in a worker thread."""
         for name in self.DATA_TYPES:
             mgr = self._map[name.encode()]
             async with mgr._lock:
                 await asyncio.to_thread(self._sync_update_repo, name, mgr.repo)
+        return tuple(self._sync_xor[n] for n in self.DATA_TYPES)
+
+    async def sync_digest_async(self) -> bytes:
+        """The combined 32-byte digest over every data type."""
         return hashlib.sha256(
-            b"".join(self._sync_xor[n] for n in self.DATA_TYPES)
+            b"".join(await self.sync_type_digests_async())
         ).digest()
 
     def manager(self, name: str) -> RepoManager:

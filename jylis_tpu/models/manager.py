@@ -129,11 +129,23 @@ class RepoManager:
             if changed:
                 self._maybe_proactive_flush()
 
+    # keys converged per event-loop slice: a multi-thousand-key batch (a
+    # sync dump chunk, a post-load flush) converged in one go blocks the
+    # loop long enough to slip heartbeats and Pongs past peers'
+    # idle-eviction windows — the connection churn then LOSES deltas
+    # (fire-and-forget). Slicing under the same lock keeps liveness
+    # traffic flowing between slices with identical lattice results.
+    CONVERGE_SLICE = 256
+
     async def converge_async(self, batch) -> None:
         async with self._lock:
             if self._shutdown:
                 return  # fire-and-forget: late deltas re-deliver elsewhere
-            self.converge_deltas(batch)  # buffers only: host-fast
+            batch = list(batch)
+            for i in range(0, len(batch), self.CONVERGE_SLICE):
+                self.converge_deltas(batch[i : i + self.CONVERGE_SLICE])
+                if i + self.CONVERGE_SLICE < len(batch):
+                    await asyncio.sleep(0)  # let pings/pongs interleave
             # threshold drains run AFTER buffering, in a worker thread —
             # never inline on the event loop; the post-state check is
             # exact where any pre-batch prediction can miss per-row sizes

@@ -77,11 +77,18 @@ def main() -> int:
         if fname not in texts:
             with open(os.path.join(ROOT, fname)) as f:
                 texts[fname] = f.read()
-        expect = template.format(fmt(record[config][field]))
+        value = record[config].get(field)
+        if value is None:
+            failures.append(
+                f"  {label}: BENCH_full.json {config} lacks field "
+                f"'{field}' (re-record with the native toolchain present?)"
+            )
+            continue
+        expect = template.format(fmt(value))
         if expect not in texts[fname]:
             failures.append(
                 f"  {label}: {fname} lacks '{expect}' "
-                f"(BENCH_full.json {config}.{field} = {record[config][field]})"
+                f"(BENCH_full.json {config}.{field} = {value})"
             )
     if failures:
         print("prose/record disagreement (update the prose or re-record):")

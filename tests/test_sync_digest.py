@@ -251,3 +251,134 @@ def test_digest_equal_across_nodes_and_backends():
         assert (await digest(a)) != db_
 
     asyncio.run(main())
+
+
+def test_periodic_digest_exchange_heals_silent_loss():
+    """Round-5: deltas lost on the SENDER's churned outbound connection
+    are invisible to the receiver — only the periodic digest exchange
+    can heal them. Simulate the loss by converging state directly into
+    A (converge buffers never re-flush, so broadcast will NEVER carry
+    it); B must still converge within ~one SYNC_PERIOD."""
+
+    async def main():
+        pa, pb = free_port(), free_port()
+        a = Node("pera", pa)
+        b = Node("perb", pb, seeds=[a.config.addr])
+        await a.start()
+        await b.start()
+        try:
+            def meshed():
+                return any(
+                    c.established for c in b.cluster._actives.values()
+                ) and any(c.established for c in a.cluster._actives.values())
+
+            assert await converge_wait(meshed, ticks=60)
+            await asyncio.sleep(4 * TICK)  # initial sync settles
+            # silent loss: state exists on A that no broadcast will carry
+            a.database.manager("GCOUNT").repo.converge(b"ghost", {44: 7})
+
+            async def b_sees():
+                out = await resp_call(
+                    b.server.port,
+                    b"*3\r\n$6\r\nGCOUNT\r\n$3\r\nGET\r\n$5\r\nghost\r\n",
+                )
+                return out == b":7\r\n"
+
+            deadline = (
+                asyncio.get_event_loop().time()
+                + (3 * cluster_mod.SYNC_PERIOD_TICKS) * TICK
+                + 5.0
+            )
+            ok = False
+            while asyncio.get_event_loop().time() < deadline:
+                if await b_sees():
+                    ok = True
+                    break
+                await asyncio.sleep(TICK)
+            assert ok, "periodic digest exchange never healed the loss"
+        finally:
+            await a.stop()
+            await b.stop()
+
+    asyncio.run(main())
+
+
+def test_sync_streams_only_mismatched_types():
+    """Per-type digests (schema v4): a heal streams ONLY the data types
+    whose digests differ."""
+
+    async def main():
+        pa, pb = free_port(), free_port()
+        a = Node("sela", pa)
+        b = Node("selb", pb, seeds=[a.config.addr])
+        streamed_types = []
+        orig = cluster_mod.Cluster._data_frames
+
+        def recording_frames(self, name):
+            streamed_types.append(name)
+            return orig(self, name)
+
+        cluster_mod.Cluster._data_frames = recording_frames
+        try:
+            await a.start()
+            await b.start()
+            # converge both on some TREG+TLOG state via the real wire
+            got = await resp_call(a.server.port, b"TREG SET t v 5\r\n")
+            assert got == b"+OK\r\n"
+            got = await resp_call(a.server.port, b"TLOG INS l x 3\r\n")
+            assert got == b"+OK\r\n"
+
+            async def b_has_both():
+                out = await resp_call(b.server.port, b"TREG GET t\r\n")
+                if not out.startswith(b"*2"):
+                    return False
+                out = await resp_call(b.server.port, b"TLOG SIZE l\r\n")
+                return out == b":1\r\n"
+
+            deadline = asyncio.get_event_loop().time() + 60 * TICK
+            while asyncio.get_event_loop().time() < deadline:
+                if await b_has_both():
+                    break
+                await asyncio.sleep(TICK)
+            assert await b_has_both()
+
+            # deterministic quiesce barrier: proceed only once BOTH
+            # nodes' digests agree (delta traffic fully settled)
+            async def digests_match():
+                da = await a.database.sync_digest_async()
+                db_ = await b.database.sync_digest_async()
+                return da == db_
+
+            deadline = asyncio.get_event_loop().time() + 60 * TICK
+            while asyncio.get_event_loop().time() < deadline:
+                if await digests_match():
+                    break
+                await asyncio.sleep(TICK)
+            assert await digests_match(), "nodes never quiesced"
+            streamed_types.clear()
+            # silent GCOUNT-only divergence + forced re-establishment
+            a.database.manager("GCOUNT").repo.converge(b"only", {9: 3})
+            b.cluster._sync_req_tick.clear()
+            for conn in list(b.cluster._actives.values()):
+                b.cluster._drop(conn)
+
+            async def healed():
+                out = await resp_call(
+                    b.server.port, b"GCOUNT GET only\r\n"
+                )
+                return out == b":3\r\n"
+
+            deadline = asyncio.get_event_loop().time() + 120 * TICK
+            while asyncio.get_event_loop().time() < deadline:
+                if await healed():
+                    break
+                await asyncio.sleep(TICK)
+            assert await healed(), "GCOUNT divergence never healed"
+            assert streamed_types, "no dump streamed at all"
+            assert set(streamed_types) == {"GCOUNT"}, streamed_types
+        finally:
+            cluster_mod.Cluster._data_frames = orig
+            await a.stop()
+            await b.stop()
+
+    asyncio.run(main())
