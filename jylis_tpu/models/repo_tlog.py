@@ -17,8 +17,8 @@ Host bookkeeping (keys, pending windows, length/cutoff caches, the
 merged-view memo, delta accumulators) lives behind the table backends in
 tlog_table.py: pure-Python as the oracle, or the native C++ engine — the
 SAME state the server's native batch applier (native/serve_engine.cpp)
-mutates, so INS/SIZE settled natively and Python-side drains/flushes
-share one source of truth.
+mutates, so INS/SIZE/GET/CUTOFF settled natively and Python-side
+drains/flushes share one source of truth.
 
 Delta wire shape: (entries: list[(value: bytes, ts: u64)], cutoff: u64).
 """

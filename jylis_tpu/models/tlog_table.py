@@ -9,8 +9,8 @@ table interface with two implementations (the counter_table.py pattern):
   no C++ toolchain is available.
 * `NativeTlogTable` — a view over the native serving engine's TLOG table
   (native/engine.h TlogTable). The same state the server's batch applier
-  mutates, so INS/SIZE settled natively and Python-side drains/flushes
-  share one source of truth.
+  mutates, so INS/SIZE/GET/CUTOFF settled natively and Python-side
+  drains/flushes share one source of truth.
 
 Semantics mirror repo_tlog.pony:16-111 via docs tlog.md: entries dedup on
 (ts, value), cutoffs are grow-only and filter the view, TRIM/CLR raise

@@ -20,6 +20,7 @@
 
 #pragma once
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 #include <string>
@@ -345,6 +346,11 @@ struct TlogRow {
     int64_t memo_plen = 0;
     uint64_t memo_cut = 0;
     uint64_t gen = 0;  // bumped whenever the merged view may have changed
+    // GET-order memo: the merged view sorted (ts, value-bytes) desc —
+    // the native mirror of repo_tlog.py's _sorted cache, keyed by gen
+    std::vector<TlogEnt> sorted_view;
+    uint64_t sorted_gen = 0;
+    bool sorted_valid = false;
     // delta accumulator (hostref.TLog): entry set + grow-only cutoff
     bool delta_present = false;
     TlogSet delta;
@@ -492,9 +498,42 @@ struct TlogTable {
         return static_cast<int64_t>(r.memo.size());
     }
 
+    // the merged view sorted (ts, value-bytes) desc — TLOG GET's serving
+    // order (repo_tlog.py _merged_view). Returns nullptr when the drained
+    // base is unknown (Python rebuilds it from a device gather) — the
+    // caller defers the command. Cached per row, keyed by gen.
+    const std::vector<TlogEnt>* sorted_view_of(int64_t row_i) {
+        TlogRow& r = rows[row_i];
+        if (size(row_i) < 0) return nullptr;  // base unknown: defer
+        if (r.sorted_valid && r.sorted_gen == r.gen) return &r.sorted_view;
+        r.sorted_view.clear();
+        if (quiescent(r)) {
+            if (!r.base_valid) return nullptr;  // device row render needed
+            r.sorted_view = r.base;
+        } else if (memo_current(r)) {
+            r.sorted_view.assign(r.memo.begin(), r.memo.end());
+        } else {
+            return nullptr;  // unreachable after size() >= 0; stay safe
+        }
+        std::sort(r.sorted_view.begin(), r.sorted_view.end(),
+                  [this](const TlogEnt& a, const TlogEnt& b) {
+                      if (a.ts != b.ts) return a.ts > b.ts;
+                      return vals[b.vid] < vals[a.vid];  // value desc
+                  });
+        r.sorted_valid = true;
+        r.sorted_gen = r.gen;
+        return &r.sorted_view;
+    }
+
+    static void drop_sorted(TlogRow& r) {
+        r.sorted_valid = false;
+        std::vector<TlogEnt>().swap(r.sorted_view);
+    }
+
     // drain epilogue for one drained row: device reported (len, cut)
     void finish_drain_row(int64_t row_i, int64_t len, uint64_t cut) {
         TlogRow& r = rows[row_i];
+        drop_sorted(r);  // free rather than wait for the gen-key miss
         bool memo_cur = memo_current(r);
         if (memo_cur) {
             r.base.clear();
@@ -608,6 +647,9 @@ struct TlogTable {
             fix_vec(r.base);
             fix_set(r.memo);
             fix_set(r.delta);
+            // the GET-order cache holds vids too; a stale (old-gen) copy
+            // may reference dead ids the remap never saw — drop it
+            drop_sorted(r);
         }
         compact_floor =
             2 * static_cast<int64_t>(vals.size()) + VAL_COMPACT_SLACK;
@@ -666,6 +708,15 @@ struct Engine {
 };
 
 // ---- shared formatting / parsing helpers -----------------------------------
+
+inline int64_t digits10(uint64_t v) {
+    int64_t n = 1;
+    while (v >= 10) {
+        v /= 10;
+        n++;
+    }
+    return n;
+}
 
 inline int64_t fmt_u64(uint8_t* out, uint64_t v) {
     char tmp[24];

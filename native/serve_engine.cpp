@@ -2,12 +2,13 @@
 //
 // Extends the counter engine (counter_engine.cpp) to the full command
 // mix the reference serves from compiled actors on every core
-// (jylis/server_notify.pony:8-36): TREG SET/GET, TLOG INS/SIZE and the
-// UJSON INS write queue settle here, so a pipelined burst of mixed
-// traffic makes ONE FFI call instead of one interpreter dispatch per
-// command. Table semantics live in engine.h; models/treg_table.py and
-// models/tlog_table.py hold the pure-Python oracles, and differential
-// tests pin the equivalence.
+// (jylis/server_notify.pony:8-36): TREG SET/GET, TLOG INS/SIZE/GET/CUTOFF
+// and the UJSON INS write queue settle here, so a pipelined burst of
+// mixed traffic makes ONE FFI call instead of one interpreter dispatch
+// per command. TLOG TRIM/TRIMAT/CLR stay with Python: they dispatch a
+// device drain. Table semantics live in engine.h; models/treg_table.py
+// and models/tlog_table.py hold the pure-Python oracles, and
+// differential tests pin the equivalence.
 
 #include "engine.h"
 
@@ -708,6 +709,69 @@ int32_t jy_eng_scan_apply2(void* ev, const uint8_t* buf, int64_t len,
         // ---- TLOG ---------------------------------------------------------
         if (argc >= 1 && word_is(buf, offs[0], lens[0], "TLOG")) {
             TlogTable& t = eng->tlog;
+            if (argc >= 3 && word_is(buf, offs[1], lens[1], "CUTOFF")) {
+                int64_t row = t.idx.find(buf + offs[2], lens[2]);
+                uint64_t c = row < 0 ? 0 : t.cutoff_view(t.rows[row]);
+                *out_len += fmt_int_reply(out + *out_len, c, false);
+                *consumed += sub_consumed;
+                continue;
+            }
+            if (argc >= 3 && word_is(buf, offs[1], lens[1], "GET")) {
+                int64_t row = t.idx.find(buf + offs[2], lens[2]);
+                if (row < 0) {
+                    memcpy(out + *out_len, "*0\r\n", 4);
+                    *out_len += 4;
+                    *consumed += sub_consumed;
+                    continue;
+                }
+                // optional count: any missing/unparseable value means
+                // "all" (base.py parse_opt_count; repo_tlog.pony:49-50)
+                uint64_t count = UINT64_MAX;
+                if (argc >= 4 &&
+                    !parse_amount(buf + offs[3], lens[3], &count))
+                    count = UINT64_MAX;
+                const std::vector<TlogEnt>* view = t.sorted_view_of(row);
+                if (view == nullptr)
+                    return defer();  // device row render: Python's job
+                uint64_t n = static_cast<uint64_t>(view->size()) < count
+                                 ? view->size()
+                                 : count;
+                int64_t need = 1 + digits10(n) + 2;
+                for (uint64_t i = 0; i < n; i++) {
+                    const TlogEnt& en = (*view)[i];
+                    const std::string& v = t.vals[en.vid];
+                    need += 4 + 1 + digits10(v.size()) + 2 +
+                            static_cast<int64_t>(v.size()) + 2 + 1 +
+                            digits10(en.ts) + 2;
+                }
+                if (out_cap - *out_len < need) {
+                    if (*out_len > 0) return 2;  // flush replies, re-enter
+                    return defer();  // reply alone outgrows the buffer
+                }
+                uint8_t* o = out + *out_len;
+                int64_t m = 0;
+                o[m++] = '*';
+                m += fmt_u64(o + m, n);
+                o[m++] = '\r';
+                o[m++] = '\n';
+                for (uint64_t i = 0; i < n; i++) {
+                    const TlogEnt& en = (*view)[i];
+                    const std::string& v = t.vals[en.vid];
+                    memcpy(o + m, "*2\r\n$", 5);
+                    m += 5;
+                    m += fmt_u64(o + m, v.size());
+                    o[m++] = '\r';
+                    o[m++] = '\n';
+                    memcpy(o + m, v.data(), v.size());
+                    m += static_cast<int64_t>(v.size());
+                    o[m++] = '\r';
+                    o[m++] = '\n';
+                    m += fmt_int_reply(o + m, en.ts, false);
+                }
+                *out_len += m;
+                *consumed += sub_consumed;
+                continue;
+            }
             if (argc >= 3 && word_is(buf, offs[1], lens[1], "SIZE")) {
                 int64_t row = t.idx.find(buf + offs[2], lens[2]);
                 int64_t n = row < 0 ? 0 : t.size(row);

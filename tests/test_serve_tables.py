@@ -260,10 +260,27 @@ def test_tlog_merged_view_fuzz_vs_drain_rebuilt(engine, seed):
 def test_tlog_native_value_interner_stays_flat_under_churn():
     """INS/TRIM churn of ever-fresh values must not grow the native
     value table without bound (engine.h TlogTable::compact_values; the
-    device-vid interner has the same guard in repo_tlog)."""
+    device-vid interner has the same guard in repo_tlog). Also pins the
+    GET-order cache across the remap: a sorted view built BEFORE the
+    compaction on a row the churn never touches (gen unchanged) holds
+    pre-remap vids — compact_values must drop it, or the post-remap GET
+    would render aliased values."""
     repo = RepoTLOG(identity=1)
     eng = repo.engine
     r = R()
+    # cold row: build the scan-path sorted cache pre-compaction. The GET
+    # between the INSes and the drain makes the merged memo current, so
+    # the drain carries the base and the post-drain GET serves natively.
+    repo.apply(r, [b"INS", b"cold", b"keepme", b"1"])
+    repo.apply(r, [b"INS", b"cold", b"andme", b"2"])
+    rc, _, _, _, _ = eng.scan_apply(bytearray(b"TLOG GET cold\r\n"))
+    assert rc == 0
+    repo.drain()
+    cold_expect = (
+        b"*2\r\n*2\r\n$5\r\nandme\r\n:2\r\n*2\r\n$6\r\nkeepme\r\n:1\r\n"
+    )
+    rc, _, cold_before, _, _ = eng.scan_apply(bytearray(b"TLOG GET cold\r\n"))
+    assert rc == 0 and cold_before == cold_expect
     ts = 0
     keep = 4
     churned = 0
@@ -286,6 +303,106 @@ def test_tlog_native_value_interner_stays_flat_under_churn():
     repo.apply(out, [b"GET", b"log0", b"%d" % keep])
     assert out.vals[0] == "array_start" and out.vals[1] == keep
     assert out.vals[5].startswith(b"g5-0-")
+    # ... and the cold row's native GET still renders the original
+    # values: the pre-remap sorted cache was dropped, not reused
+    rc, _, cold_after, _, _ = eng.scan_apply(bytearray(b"TLOG GET cold\r\n"))
+    assert rc == 0 and cold_after == cold_expect
+
+
+def _oracle_reply(repo, args) -> bytes:
+    """Drive a repo command through the real RESP reply writer — the
+    byte-exact rendering the Python serving path produces."""
+    from jylis_tpu.server.resp import Respond
+
+    buf = bytearray()
+    repo.apply(Respond(buf.extend), args)
+    return bytes(buf)
+
+
+def test_scan_apply_tlog_get_and_cutoff_byte_match_oracle():
+    """TLOG GET/CUTOFF settled by the native batch applier
+    (serve_engine.cpp) must render byte-identically to the Python repo
+    through the real Respond writer: merged order (ts desc, value-bytes
+    desc on ties), dedup, count semantics (missing / 0 / over-long /
+    unparseable-means-all), and unknown keys."""
+    native, oracle = _tlog_pair()
+    for cmd in (
+        [b"INS", b"k", b"bb", b"5"],
+        [b"INS", b"k", b"aa", b"5"],  # tie: value-desc order
+        [b"INS", b"k", b"zz", b"3"],
+        [b"INS", b"k", b"aa", b"9"],
+        [b"INS", b"k", b"aa", b"9"],  # exact duplicate: dedup
+    ):
+        both(native, oracle, cmd)
+    gets = (
+        [b"GET", b"k"],
+        [b"CUTOFF", b"k"],
+        [b"GET", b"k", b"2"],
+        [b"GET", b"k", b"bogus"],  # unparseable count == all
+        [b"GET", b"k", b"0"],
+        [b"GET", b"k", b"999"],
+        [b"GET", b"missing"],
+        [b"CUTOFF", b"missing"],
+    )
+    burst = b"".join(b"TLOG " + b" ".join(a) + b"\r\n" for a in gets)
+    rc, consumed, replies, unhandled, changed = native.engine.scan_apply(
+        bytearray(burst)
+    )
+    assert rc == 0 and consumed == len(burst) and unhandled is None
+    assert changed == (0, 0, 0, 0, 0)  # reads change nothing
+    assert replies == b"".join(_oracle_reply(oracle, a) for a in gets)
+    # non-quiescent reads served that: pend was never drained. Now drain
+    # (memo is current after the GETs, so the base carries) and re-check
+    # the quiescent serving path against the oracle
+    native.drain()
+    oracle.drain()
+    rc, _, replies, _, _ = native.engine.scan_apply(
+        bytearray(b"TLOG GET k\r\nTLOG CUTOFF k\r\n")
+    )
+    assert rc == 0
+    assert replies == _oracle_reply(oracle, [b"GET", b"k"]) + _oracle_reply(
+        oracle, [b"CUTOFF", b"k"]
+    )
+
+
+def test_scan_apply_tlog_get_defers_when_base_unknown():
+    """A drain that lands while the merged memo is stale leaves the
+    drained base unknown (finish_drain_row) — the native GET must bounce
+    to Python, whose path pays the one-row device gather; SIZE keeps
+    serving natively from the length cache."""
+    native = RepoTLOG(identity=1)
+    native.converge(b"k", ([(b"v", 7)], 0))  # no memo upkeep on converge
+    native.drain()
+    rc, consumed, replies, unhandled, _ = native.engine.scan_apply(
+        bytearray(b"TLOG GET k\r\n")
+    )
+    assert rc == 1 and unhandled == [b"TLOG", b"GET", b"k"]
+    assert replies == b""
+    rc, _, replies, _, _ = native.engine.scan_apply(
+        bytearray(b"TLOG SIZE k\r\n")
+    )
+    assert rc == 0 and replies == b":1\r\n"
+    # the Python path (where the server routes the defer) serves it
+    assert _oracle_reply(native, [b"GET", b"k"]) == (
+        b"*1\r\n*2\r\n$1\r\nv\r\n:7\r\n"
+    )
+
+
+def test_scan_apply_tlog_get_big_reply_flushes_then_defers():
+    """A GET whose reply outgrows the 64 KB reply buffer: mid-burst it
+    flushes what settled first (rc 2), then alone it defers to Python
+    (rc 1) — the TREG big-value convention."""
+    native = RepoTLOG(identity=1)
+    r = R()
+    native.apply(r, [b"INS", b"k", b"x" * 70000, b"1"])
+    burst = bytearray(b"TLOG SIZE k\r\nTLOG GET k\r\n")
+    rc, consumed, replies, unhandled, _ = native.engine.scan_apply(burst)
+    assert rc == 2 and replies == b":1\r\n"
+    assert consumed == len(b"TLOG SIZE k\r\n")
+    del burst[:consumed]
+    rc, consumed, replies, unhandled, _ = native.engine.scan_apply(burst)
+    assert rc == 1 and unhandled == [b"TLOG", b"GET", b"k"]
+    assert replies == b"" and consumed == len(b"TLOG GET k\r\n")
 
 
 # ---- UJSON queue -----------------------------------------------------------
@@ -393,9 +510,23 @@ def test_server_all_types_stream_differential(seed):
         elif roll < 14:
             cmds.append(b"TLOG SIZE %s" % k)
         elif roll == 14:
-            cmds.append(b"TLOG GET %s %d" % (k, rng.integers(1, 8)))
+            sub = rng.integers(4)
+            if sub == 0:
+                cmds.append(b"TLOG GET %s %d" % (k, rng.integers(1, 8)))
+            elif sub == 1:
+                cmds.append(b"TLOG GET %s" % k)  # count omitted == all
+            elif sub == 2:
+                cmds.append(b"TLOG GET %s zz" % k)  # unparseable == all
+            else:
+                cmds.append(b"TLOG CUTOFF %s" % k)
         elif roll == 15:
-            cmds.append(b"TLOG TRIM %s %d" % (k, rng.integers(0, 5)))
+            sub = rng.integers(4)
+            if sub == 0:
+                cmds.append(b"TLOG CLR %s" % k)
+            elif sub == 1:
+                cmds.append(b"TLOG TRIMAT %s %d" % (k, rng.integers(1, 50)))
+            else:
+                cmds.append(b"TLOG TRIM %s %d" % (k, rng.integers(0, 5)))
         elif roll == 16:
             cmds.append(b"UJSON INS %s tags %d" % (k, rng.integers(20)))
         else:
