@@ -64,11 +64,16 @@ SHUTDOWN_ERR = "SHUTDOWN (server is shutting down, rejecting all requests)"
 
 
 class RepoManager:
-    def __init__(self, name: str, repo, help_obj, clock=time.monotonic):
+    def __init__(
+        self, name: str, repo, help_obj, clock=time.monotonic, served=None
+    ):
         self.name = name
         self.repo = repo
         self.help = help_obj
         self._clock = clock
+        # per-Database commands-served tally (SYSTEM METRICS "cmds");
+        # the native engine counts its own settles in its own tables
+        self._served = served if served is not None else {}
         self._deltas_fn = None
         self._last_proactive = None
         self._shutdown = False
@@ -84,6 +89,7 @@ class RepoManager:
             self._maybe_proactive_flush()
 
     def _apply_core(self, resp, cmd: list[bytes]) -> bool:
+        self._served[self.name] = self._served.get(self.name, 0) + 1
         try:
             return self.repo.apply(resp, cmd[1:])
         except ParseError:

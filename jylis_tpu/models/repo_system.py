@@ -40,6 +40,9 @@ class RepoSYSTEM:
         self._identity = identity
         self._log = hostref.TLog()
         self._delta = hostref.TLog()
+        # Database wires this to its per-instance commands-served totals
+        # (Python dispatch + native engine) for METRICS' "cmds" lines
+        self.served_fn = None
 
     def apply(self, resp, args: list[bytes]) -> bool:
         op = need(args, 0)
@@ -53,13 +56,17 @@ class RepoSYSTEM:
                 resp.u64(ts)
             return False
         if op == b"METRICS":
-            # live merge-path metrics (extension — the reference has no
-            # metrics surface at all; until round 3 these were visible
-            # only in the shutdown report): one "name key value" line per
-            # counter, flat and greppable from any Redis client
+            # live serving + merge-path metrics (extension — the
+            # reference has no metrics surface at all): one "name key
+            # value" line per counter, flat and greppable from any Redis
+            # client. "cmds" counts commands served on BOTH paths
+            # (native engine + Python); drains/keys/device_ms cover the
+            # device merge path
             from ..utils.metrics import metric_lines
 
-            lines = metric_lines()
+            lines = metric_lines(
+                self.served_fn() if self.served_fn else None
+            )
             resp.array_start(len(lines))
             for line in lines:
                 resp.string(line)

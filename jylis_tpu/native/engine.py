@@ -124,6 +124,7 @@ def _declare(c: ctypes.CDLL) -> None:
         "jy_tlog_delta_cutoff": (u64, [vp, i64]),
         "jy_tlog_delta_raise_cutoff": (None, [vp, i64, u64]),
         "jy_tlog_clear_deltas": (None, [vp]),
+        "jy_eng_served": (None, [vp, vp]),
         # UJSON queue
         "jy_uq_count": (i64, [vp]),
         "jy_uq_bytes": (i64, [vp]),
@@ -629,6 +630,15 @@ class ServeEngine:
             out.append((kbytes[o : o + ln], ([(v, t) for t, v in ents], cut)))
         out.sort()
         return out
+
+    # the engine's changed/served-counter type order (serve_engine.cpp)
+    TYPE_ORDER = ("GCOUNT", "PNCOUNT", "TREG", "TLOG", "UJSON")
+
+    def served_counts(self) -> dict[str, int]:
+        """Commands settled natively since startup, per data type."""
+        out = np.zeros(5, np.uint64)
+        self._lib.jy_eng_served(self._h, out.ctypes.data)
+        return dict(zip(self.TYPE_ORDER, out.tolist()))
 
     # ---- UJSON queue -------------------------------------------------------
 

@@ -99,9 +99,16 @@ def _type_stats():
             yield name, int(c["batches"]), int(c["keys"]), c["seconds"] * 1e3
 
 
-def metric_lines() -> list[str]:
-    """Flat `type counter value` lines — the SYSTEM METRICS reply body."""
-    lines = []
+def metric_lines(served: dict[str, int] | None = None) -> list[str]:
+    """Flat `type counter value` lines — the SYSTEM METRICS reply body.
+    ``served`` is the serving node's per-type commands-served totals
+    (Database merges its Python-path tally with its engine's native
+    counters and wires the result through RepoSYSTEM — per instance,
+    unlike the process-global drain counters, so test/bench Databases
+    in one process cannot cross-talk)."""
+    lines = [
+        f"{name} cmds {n}" for name, n in sorted((served or {}).items()) if n
+    ]
     for name, drains, keys, ms in _type_stats():
         lines.append(f"{name} drains {drains}")
         lines.append(f"{name} keys {keys}")

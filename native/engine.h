@@ -705,6 +705,11 @@ struct Engine {
     TregTable treg;
     TlogTable tlog;
     UjsonQueue uq;
+    // commands settled natively, per type (G, PN, TREG, TLOG, UJSON) —
+    // reads included; deferred commands count on the Python side instead
+    // (models/manager.py _apply_core's per-Database tally). SYSTEM
+    // METRICS reports the sum.
+    uint64_t served[5] = {0, 0, 0, 0, 0};
 };
 
 // ---- shared formatting / parsing helpers -----------------------------------

@@ -549,6 +549,13 @@ void jy_tlog_clear_deltas(void* e) {
     t.delta_rows.clear();
 }
 
+// commands settled natively since startup, per type (G, PN, TREG, TLOG,
+// UJSON) — the SYSTEM METRICS "cmds" surface's native half
+void jy_eng_served(void* e, uint64_t* out) {
+    Engine* eng = static_cast<Engine*>(e);
+    for (int i = 0; i < 5; i++) out[i] = eng->served[i];
+}
+
 // ---- UJSON queue -----------------------------------------------------------
 
 int64_t jy_uq_count(void* e) { return static_cast<Engine*>(e)->uq.count; }
@@ -626,6 +633,7 @@ int32_t jy_eng_scan_apply2(void* ev, const uint8_t* buf, int64_t len,
                     return defer();  // Python drains and serves this one
                 uint64_t v = row >= 0 ? t.value[row] : 0;
                 *out_len += fmt_int_reply(out + *out_len, v, which == 1);
+                eng->served[which]++;
                 *consumed += sub_consumed;
                 continue;
             }
@@ -642,6 +650,7 @@ int32_t jy_eng_scan_apply2(void* ev, const uint8_t* buf, int64_t len,
                 int64_t row = t.upsert(buf + offs[2], lens[2]);
                 t.bump(row, polarity, amount);
                 changed[which]++;
+                eng->served[which]++;
                 memcpy(out + *out_len, "+OK\r\n", 5);
                 *out_len += 5;
                 *consumed += sub_consumed;
@@ -660,6 +669,7 @@ int32_t jy_eng_scan_apply2(void* ev, const uint8_t* buf, int64_t len,
                 if (row < 0 || !t.winner(row, &ts, &val)) {
                     memcpy(out + *out_len, "$-1\r\n", 5);
                     *out_len += 5;
+                    eng->served[2]++;
                     *consumed += sub_consumed;
                     continue;
                 }
@@ -682,6 +692,7 @@ int32_t jy_eng_scan_apply2(void* ev, const uint8_t* buf, int64_t len,
                 o[n++] = '\n';
                 n += fmt_int_reply(o + n, ts, false);
                 *out_len += n;
+                eng->served[2]++;
                 *consumed += sub_consumed;
                 continue;
             }
@@ -698,6 +709,7 @@ int32_t jy_eng_scan_apply2(void* ev, const uint8_t* buf, int64_t len,
                 t.write(row, ts, buf + offs[3], lens[3]);
                 t.note_delta(row, ts, buf + offs[3], lens[3]);
                 changed[2]++;
+                eng->served[2]++;
                 memcpy(out + *out_len, "+OK\r\n", 5);
                 *out_len += 5;
                 *consumed += sub_consumed;
@@ -713,6 +725,7 @@ int32_t jy_eng_scan_apply2(void* ev, const uint8_t* buf, int64_t len,
                 int64_t row = t.idx.find(buf + offs[2], lens[2]);
                 uint64_t c = row < 0 ? 0 : t.cutoff_view(t.rows[row]);
                 *out_len += fmt_int_reply(out + *out_len, c, false);
+                eng->served[3]++;
                 *consumed += sub_consumed;
                 continue;
             }
@@ -721,6 +734,7 @@ int32_t jy_eng_scan_apply2(void* ev, const uint8_t* buf, int64_t len,
                 if (row < 0) {
                     memcpy(out + *out_len, "*0\r\n", 4);
                     *out_len += 4;
+                    eng->served[3]++;
                     *consumed += sub_consumed;
                     continue;
                 }
@@ -769,6 +783,7 @@ int32_t jy_eng_scan_apply2(void* ev, const uint8_t* buf, int64_t len,
                     m += fmt_int_reply(o + m, en.ts, false);
                 }
                 *out_len += m;
+                eng->served[3]++;
                 *consumed += sub_consumed;
                 continue;
             }
@@ -778,6 +793,7 @@ int32_t jy_eng_scan_apply2(void* ev, const uint8_t* buf, int64_t len,
                 if (n < 0) return defer();  // drained base unknown
                 *out_len += fmt_int_reply(out + *out_len,
                                           static_cast<uint64_t>(n), false);
+                eng->served[3]++;
                 *consumed += sub_consumed;
                 continue;
             }
@@ -798,6 +814,7 @@ int32_t jy_eng_scan_apply2(void* ev, const uint8_t* buf, int64_t len,
                 if (row < 0) row = t.upsert(buf + offs[2], lens[2]);
                 t.ins(row, ts, buf + offs[3], lens[3]);
                 changed[3]++;
+                eng->served[3]++;
                 memcpy(out + *out_len, "+OK\r\n", 5);
                 *out_len += 5;
                 *consumed += sub_consumed;
@@ -815,6 +832,7 @@ int32_t jy_eng_scan_apply2(void* ev, const uint8_t* buf, int64_t len,
                 ujson_token_ok(buf + offs[argc - 1], lens[argc - 1])) {
                 eng->uq.push(buf, offs + 1, lens + 1, argc - 1);
                 changed[4]++;
+                eng->served[4]++;
                 memcpy(out + *out_len, "+OK\r\n", 5);
                 *out_len += 5;
                 *consumed += sub_consumed;

@@ -448,3 +448,39 @@ def test_system_metrics_command(db):
     # unknown op still errors with the (extended) help table
     err = run(db, "SYSTEM", "NOPE")
     assert err.startswith(b"-BADCOMMAND") and b"METRICS" in err
+
+
+def _metric_value(out: bytes, prefix: bytes) -> int:
+    lines = [l for l in out.split(b"\r\n") if l.startswith(prefix)]
+    assert lines, out
+    return int(lines[0].rsplit(b" ", 1)[1])
+
+
+def test_system_metrics_counts_served_commands(db):
+    """METRICS "cmds" lines (extension): commands served per type,
+    counted on BOTH serving paths — Python dispatch (manager._apply_core
+    -> the per-Database tally) and the native batch applier
+    (Engine::served, merged in via RepoSYSTEM.served_fn)."""
+    run(db, "GCOUNT", "INC", "m:srv", "1")
+    run(db, "GCOUNT", "GET", "m:srv")
+    total = _metric_value(run(db, "SYSTEM", "METRICS"), b"GCOUNT cmds")
+    assert total == 2  # per-instance tally: exactly this test's commands
+    eng = db.native_engine
+    if eng is not None:
+        rc, _, replies, _, _ = eng.scan_apply(
+            bytearray(b"GCOUNT INC m:srv 1\r\nGCOUNT GET m:srv\r\n")
+        )
+        assert rc == 0 and replies == b"+OK\r\n:2\r\n"
+        assert eng.served_counts()["GCOUNT"] == 2
+        assert _metric_value(
+            run(db, "SYSTEM", "METRICS"), b"GCOUNT cmds"
+        ) == total + 2
+    # a second Database sees none of the first's counts (per-instance
+    # wiring, unlike the process-global drain counters)
+    other = Database(identity=2, engine="python")
+    out = run(other, "SYSTEM", "METRICS")
+    assert not [
+        l for l in out.split(b"\r\n") if l.startswith(b"GCOUNT cmds")
+    ], out
+    run(other, "GCOUNT", "GET", "m:srv")
+    assert _metric_value(run(other, "SYSTEM", "METRICS"), b"GCOUNT cmds") == 1
