@@ -150,6 +150,15 @@ class Cluster:
         # converging the very stream that fixes it starves its repo
         # locks (dump + converge + digest all contend) and wedges reads
         self._sync_rx_tick: int | None = None
+        # consecutive mid-heal serve deferrals, CAPPED like the
+        # requester-side write-hot defer: with cluster-wide aligned
+        # heartbeats, an ahead node's own periodic pull makes the behind
+        # peer stream its (stale) dump right before the behind peer's
+        # request arrives — an uncapped defer then starves the rejoiner
+        # FOREVER (each period repeats the same alignment). Bounding the
+        # streak keeps the contention relief while guaranteeing any
+        # refusal chain is finite.
+        self._sync_serve_defer_streak = 0
 
     # ---- lifecycle --------------------------------------------------------
 
@@ -386,15 +395,24 @@ class Cluster:
             # A node that is ITSELF mid-heal defers with a Pong: its
             # state is about to change anyway, and dumping it would
             # contend the same repo locks the inbound heal needs.
-            if (
+            rate_limited = (
                 conn.sync_served_tick is not None
                 and self._tick - conn.sync_served_tick < SYNC_PERIOD_TICKS
-            ) or (
+            )
+            mid_heal = (
                 self._sync_rx_tick is not None
                 and self._tick - self._sync_rx_tick < SYNC_REQUEST_COOLDOWN
-            ):
+            )
+            if rate_limited or (mid_heal and self._sync_serve_defer_streak < 2):
+                if mid_heal and not rate_limited:
+                    self._sync_serve_defer_streak += 1
+                    self._log.info() and self._log.i(
+                        "sync: mid-heal, deferring dump "
+                        f"(streak {self._sync_serve_defer_streak})"
+                    )
                 self._send(conn, MsgPong())
                 return
+            self._sync_serve_defer_streak = 0
             conn.sync_served_tick = self._tick
             conn.sync_digests = tuple(msg.digests)
             self._sync_waiters.append(conn)

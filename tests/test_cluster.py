@@ -10,6 +10,7 @@ TCP -> converge).
 """
 
 import asyncio
+import os
 
 import pytest
 
@@ -24,17 +25,35 @@ from jylis_tpu.utils.log import Log
 
 TICK = 0.05  # the reference test's 50 ms heartbeat (test_cluster.pony:70)
 
+_DEVNULL = None
+
+
+def _devnull():
+    """One shared discard sink for info-logging Nodes (a handle per Node
+    would leak until GC finalization)."""
+    global _DEVNULL
+    if _DEVNULL is None:
+        _DEVNULL = open(os.devnull, "w")
+    return _DEVNULL
+
 
 class Node:
-    """One full node stack on ephemeral loopback ports."""
+    """One full node stack on ephemeral loopback ports.
 
-    def __init__(self, name: str, cluster_port: int, seeds=()):
+    ``log_level="info"`` discards stream output but keeps the dual sink
+    into the replicated SYSTEM log — failure diagnostics can then read
+    each node's own account of its sync/cluster decisions."""
+
+    def __init__(self, name: str, cluster_port: int, seeds=(), log_level=None):
         self.config = Config()
         self.config.port = "0"
         self.config.addr = Address("127.0.0.1", str(cluster_port), name)
         self.config.seed_addrs = list(seeds)
         self.config.heartbeat_time = TICK
-        self.config.log = Log.create_none()
+        if log_level is None:
+            self.config.log = Log.create_none()
+        else:
+            self.config.log = Log(log_level, out=_devnull())
         self.system = System(self.config)
         self.database = Database(
             identity=self.config.addr.hash64(), system_repo=self.system.repo
@@ -49,6 +68,16 @@ class Node:
     async def stop(self):
         self.cluster.dispose()
         await self.server.dispose()
+
+
+class _CollectResp:
+    """Records reply-writer calls for failure diagnostics."""
+
+    def __init__(self):
+        self.vals = []
+
+    def __getattr__(self, name):
+        return lambda *a: self.vals.extend((name, *a))
 
 
 async def resp_call(port: int, payload: bytes) -> bytes:
@@ -721,7 +750,7 @@ def test_eight_node_churn_convergence():
         nodes = []
         for i in range(8):
             seeds = [seed.config.addr] if seed else []
-            n = Node("churn-%d" % i, ports[i], seeds)
+            n = Node("churn-%d" % i, ports[i], seeds, log_level="info")
             await n.start()
             nodes.append(n)
             if seed is None:
@@ -812,10 +841,16 @@ def test_eight_node_churn_convergence():
             # fresh generated name — which must blacklist its stale name
             # cluster-wide; plus a brand-new ninth node joins. Both must
             # bootstrap the full count, then contribute writes.
-            reborn = Node("churn-6-reborn", ports[6], [seed.config.addr])
+            reborn = Node(
+                "churn-6-reborn", ports[6], [seed.config.addr],
+                log_level="info",
+            )
             await reborn.start()
             alive.append(reborn)
-            fresh = Node("churn-8-late", ports[8], [seed.config.addr])
+            fresh = Node(
+                "churn-8-late", ports[8], [seed.config.addr],
+                log_level="info",
+            )
             await fresh.start()
             alive.append(fresh)
             assert await converge_wait(mesh_alive, ticks=400), (
@@ -823,8 +858,70 @@ def test_eight_node_churn_convergence():
             )
             total += await inc(reborn, 5)
             total += await inc(fresh, 7)
-            assert await converge_total(total), (
-                "post-rejoin totals diverged", total, await totals_detail())
+            ok = await converge_total(total)
+            if not ok:
+                # full diagnostics to a file (pytest truncates long
+                # assert reprs, which hid exactly the two bootstrapping
+                # nodes): per node — socket total vs repo-direct total
+                # vs native-engine row state (distinguishes
+                # never-converged from converged-but-served-stale),
+                # per-type digests, sync bookkeeping, and the node's own
+                # SYSTEM log (sync decisions log at info)
+                with open("/tmp/churn_diag.txt", "w") as f:
+                    f.write(f"DIVERGED total={total}\n")
+                    for n in alive:
+                        # per-node probes are best-effort: the nodes are
+                        # still serving, and a probe racing a threaded
+                        # drain must not mask the divergence assert below
+                        try:
+                            t = await read_total(n)
+                            r = _CollectResp()
+                            async with n.database.manager("GCOUNT")._lock:
+                                n.database.manager("GCOUNT").repo.apply(
+                                    r, [b"GET", b"churn"]
+                                )
+                            eng = n.database.native_engine
+                            row_state = None
+                            if eng is not None:
+                                row = eng.find(0, b"churn")
+                                if row >= 0:
+                                    row_state = dict(
+                                        value=eng.value(0, row),
+                                        foreign=eng.is_foreign(0, row),
+                                        own_p=eng.own(0, row, 0),
+                                    )
+                            digs = [
+                                d.hex()[:12]
+                                for d in
+                                await n.database.sync_type_digests_async()
+                            ]
+                            c = n.cluster
+                            f.write(
+                                f"NODE {n.config.addr.name} socket={t!r} "
+                                f"repo={r.vals!r} native={row_state!r} "
+                                f"digests={digs} tick={c._tick} "
+                                f"req_tick={ {a.name: v for a, v in c._sync_req_tick.items()} } "
+                                f"rx_tick={c._sync_rx_tick} "
+                                f"dump_inflight={c._sync_dump_inflight} "
+                                f"waiters={len(c._sync_waiters)} "
+                                f"known={len(list(c._known_addrs))}\n"
+                            )
+                        except Exception as e:  # noqa: BLE001
+                            f.write(
+                                f"NODE {n.config.addr.name} probe failed: "
+                                f"{e!r}\n"
+                            )
+                    for n in alive:
+                        try:
+                            f.write(f"==== SYSTEM log {n.config.addr.name}\n")
+                            for value, ts in n.system.repo._log.latest():
+                                f.write(
+                                    f"  {ts} {value.decode(errors='replace')}\n"
+                                )
+                        except Exception as e:  # noqa: BLE001
+                            f.write(f"  log probe failed: {e!r}\n")
+                print("diagnostics written to /tmp/churn_diag.txt", flush=True)
+            assert ok, ("post-rejoin totals diverged", total)
 
             # O(conn) sanity: established actives == alive-1 on every
             # node, and total actives bounded by alive+1 (the one re-dial

@@ -303,6 +303,74 @@ def test_periodic_digest_exchange_heals_silent_loss():
     asyncio.run(main())
 
 
+def test_mid_heal_serve_defer_is_capped():
+    """A responder constantly receiving sync data ("mid-heal") must
+    still serve a behind requester after a bounded number of deferrals.
+    With cluster-wide aligned heartbeat periods, an UNCAPPED defer
+    starves a rejoiner forever: the ahead node's own periodic pull makes
+    the behind peer stream its stale dump right before the behind
+    peer's request arrives, re-arming the defer window every period —
+    the eight-node churn test's rejoin phase hit exactly this (nodes
+    stuck at their post-join writes while every request got a silent
+    Pong)."""
+
+    async def main():
+        pa, pb = free_port(), free_port()
+        a = Node("capa", pa)
+        b = Node("capb", pb, seeds=[a.config.addr])
+        try:
+            await a.start()
+            await b.start()
+
+            def meshed():
+                return any(
+                    c.established for c in b.cluster._actives.values()
+                ) and any(c.established for c in a.cluster._actives.values())
+
+            assert await converge_wait(meshed, ticks=60)
+            await asyncio.sleep(4 * TICK)  # initial sync settles
+
+            # pin the responder permanently "mid-heal": every tick looks
+            # like fresh inbound sync data just arrived
+            async def pin():
+                while True:
+                    a.cluster._sync_rx_tick = a.cluster._tick
+                    await asyncio.sleep(TICK / 2)
+
+            pin_task = asyncio.get_event_loop().create_task(pin())
+            # silent-loss state on A: converge buffers never re-flush, so
+            # broadcast (and the held-delta path) will NEVER carry it —
+            # ONLY a served sync dump can deliver it to B
+            a.database.manager("GCOUNT").repo.converge(b"ghost", {44: 9})
+
+            async def b_sees():
+                out = await resp_call(
+                    b.server.port,
+                    b"*3\r\n$6\r\nGCOUNT\r\n$3\r\nGET\r\n$5\r\nghost\r\n",
+                )
+                return out == b":9\r\n"
+
+            # establishment request defers (streak 1); the next periodic
+            # pulse defers (streak 2); the one after that MUST serve —
+            # allow a couple of periods of slack on a loaded box
+            deadline = asyncio.get_event_loop().time() + (
+                5 * cluster_mod.SYNC_PERIOD_TICKS * TICK + 3.0
+            )
+            ok = False
+            while asyncio.get_event_loop().time() < deadline:
+                if await b_sees():
+                    ok = True
+                    break
+                await asyncio.sleep(TICK)
+            pin_task.cancel()
+            assert ok, "capped mid-heal defer never served the rejoiner"
+        finally:
+            await a.stop()
+            await b.stop()
+
+    asyncio.run(main())
+
+
 def test_sync_streams_only_mismatched_types():
     """Per-type digests (schema v4): a heal streams ONLY the data types
     whose digests differ."""
