@@ -157,8 +157,14 @@ class Cluster:
         # request arrives — an uncapped defer then starves the rejoiner
         # FOREVER (each period repeats the same alignment). Bounding the
         # streak keeps the contention relief while guaranteeing any
-        # refusal chain is finite.
+        # refusal chain is finite. The streak decays only when the last
+        # REFUSAL is much older than a period (_sync_defer_last_tick):
+        # a per-rx-episode reset would hand each aligned period a fresh
+        # defer allowance and reintroduce the starvation, while never
+        # decaying would let a stale streak from a long-dead episode
+        # skip the defers of the next one.
         self._sync_serve_defer_streak = 0
+        self._sync_defer_last_tick: int | None = None
 
     # ---- lifecycle --------------------------------------------------------
 
@@ -403,9 +409,24 @@ class Cluster:
                 self._sync_rx_tick is not None
                 and self._tick - self._sync_rx_tick < SYNC_REQUEST_COOLDOWN
             )
+            if (
+                self._sync_defer_last_tick is not None
+                and self._tick - self._sync_defer_last_tick
+                > 6 * SYNC_PERIOD_TICKS
+            ):
+                # stale streak from a long-dead heal episode (see the
+                # field's comment for why the decay keys off the last
+                # refusal, not the rx window). The window must EXCEED
+                # the slowest capped requester's pull spacing — a
+                # write-hot requester pulls every 4th period (heartbeat
+                # defer streak < 3) — or its refusals each look stale,
+                # decay resets the streak between them, and the cap
+                # never binds for exactly the starved node it protects.
+                self._sync_serve_defer_streak = 0
             if rate_limited or (mid_heal and self._sync_serve_defer_streak < 2):
                 if mid_heal and not rate_limited:
                     self._sync_serve_defer_streak += 1
+                    self._sync_defer_last_tick = self._tick
                     self._log.info() and self._log.i(
                         "sync: mid-heal, deferring dump "
                         f"(streak {self._sync_serve_defer_streak})"

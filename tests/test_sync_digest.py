@@ -371,6 +371,132 @@ def test_mid_heal_serve_defer_is_capped():
     asyncio.run(main())
 
 
+def test_write_hot_request_defer_is_capped():
+    """The requester-side twin of the mid-heal cap: a node whose local
+    writes never stop defers its periodic digest pull, but the defer
+    streak caps at 3 — a steadily write-hot node must still pull (and
+    heal a loss IT suffered) every few periods, not never."""
+
+    async def main():
+        pa, pb = free_port(), free_port()
+        a = Node("hota", pa)
+        b = Node("hotb", pb, seeds=[a.config.addr])
+        try:
+            await a.start()
+            await b.start()
+
+            def meshed():
+                return any(
+                    c.established for c in b.cluster._actives.values()
+                ) and any(c.established for c in a.cluster._actives.values())
+
+            assert await converge_wait(meshed, ticks=60)
+            await asyncio.sleep(4 * TICK)  # initial sync settles
+
+            # pin B permanently "write-hot": every tick re-arms the
+            # periodic-pull deferral the heartbeat keeps clearing
+            async def pin():
+                while True:
+                    b.cluster._local_writes_seen = True
+                    await asyncio.sleep(TICK / 2)
+
+            pin_task = asyncio.get_event_loop().create_task(pin())
+            # silent loss on A that only B's own pull can heal (converge
+            # buffers never re-flush; A defers serving nothing here)
+            a.database.manager("GCOUNT").repo.converge(b"ghost", {44: 5})
+
+            async def b_sees():
+                out = await resp_call(
+                    b.server.port,
+                    b"*3\r\n$6\r\nGCOUNT\r\n$3\r\nGET\r\n$5\r\nghost\r\n",
+                )
+                return out == b":5\r\n"
+
+            # the cap admits a pull at worst every 4th period; allow two
+            # such windows of slack on a loaded box
+            deadline = asyncio.get_event_loop().time() + (
+                9 * cluster_mod.SYNC_PERIOD_TICKS * TICK + 3.0
+            )
+            ok = False
+            while asyncio.get_event_loop().time() < deadline:
+                if await b_sees():
+                    ok = True
+                    break
+                await asyncio.sleep(TICK)
+            pin_task.cancel()
+            assert ok, "capped write-hot defer never pulled the heal"
+        finally:
+            await a.stop()
+            await b.stop()
+
+    asyncio.run(main())
+
+
+def test_write_hot_behind_node_heals_from_mid_heal_responder(monkeypatch):
+    """The two caps COMBINED: a behind node that is steadily write-hot
+    pulls only every 4th period (requester cap), while the responder is
+    kept perpetually mid-heal — the serve-defer streak must survive
+    between those widely-spaced requests (decay window > requester
+    spacing) or the responder's cap never binds and the behind node is
+    starved forever. Shrinks SYNC_PERIOD_TICKS so three pull cycles fit
+    a fast test."""
+    monkeypatch.setattr(cluster_mod, "SYNC_PERIOD_TICKS", 10)
+
+    async def main():
+        pa, pb = free_port(), free_port()
+        a = Node("comba", pa)
+        b = Node("combb", pb, seeds=[a.config.addr])
+        try:
+            await a.start()
+            await b.start()
+
+            def meshed():
+                return any(
+                    c.established for c in b.cluster._actives.values()
+                ) and any(c.established for c in a.cluster._actives.values())
+
+            assert await converge_wait(meshed, ticks=60)
+            await asyncio.sleep(4 * TICK)  # initial sync settles
+
+            async def pin():
+                while True:
+                    a.cluster._sync_rx_tick = a.cluster._tick  # mid-heal
+                    b.cluster._local_writes_seen = True  # write-hot
+                    await asyncio.sleep(TICK / 2)
+
+            pin_task = asyncio.get_event_loop().create_task(pin())
+            a.database.manager("GCOUNT").repo.converge(b"ghost", {44: 3})
+
+            async def b_sees():
+                out = await resp_call(
+                    b.server.port,
+                    b"*3\r\n$6\r\nGCOUNT\r\n$3\r\nGET\r\n$5\r\nghost\r\n",
+                )
+                return out == b":3\r\n"
+
+            # B pulls every 4th (shrunk) period; A serves its 3rd pull
+            # at the latest — allow double that for a loaded box
+            deadline = asyncio.get_event_loop().time() + (
+                24 * cluster_mod.SYNC_PERIOD_TICKS * TICK + 3.0
+            )
+            ok = False
+            while asyncio.get_event_loop().time() < deadline:
+                if await b_sees():
+                    ok = True
+                    break
+                await asyncio.sleep(TICK)
+            pin_task.cancel()
+            assert ok, (
+                "write-hot behind node never healed from the mid-heal "
+                "responder (combined defer caps starved it)"
+            )
+        finally:
+            await a.stop()
+            await b.stop()
+
+    asyncio.run(main())
+
+
 def test_sync_streams_only_mismatched_types():
     """Per-type digests (schema v4): a heal streams ONLY the data types
     whose digests differ."""
