@@ -641,6 +641,12 @@ class Cluster:
             for addr in list(self._actives):
                 if addr not in self._known_addrs:
                     self._drop(self._actives[addr])
+            # and their sync-request bookkeeping: blacklisted addresses
+            # never re-establish, so their cooldown entries are dead
+            # weight that would otherwise grow with name churn forever
+            for addr in list(self._sync_req_tick):
+                if addr not in self._known_addrs:
+                    del self._sync_req_tick[addr]
             self._sync_actives()
             self._broadcast_msg(MsgExchangeAddrs(self._known_addrs.copy()))
 

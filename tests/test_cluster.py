@@ -930,6 +930,14 @@ def test_eight_node_churn_convergence():
             assert await converge_wait(mesh_alive, ticks=120), (
                 "active connection counts never settled"
             )
+            # blacklisted addresses leave the sync-request bookkeeping
+            # too (membership convergence prunes them): every tracked
+            # cooldown entry belongs to a currently-known address
+            for n in alive:
+                assert all(
+                    a in n.cluster._known_addrs
+                    for a in n.cluster._sync_req_tick
+                ), (n.config.addr.name, dict(n.cluster._sync_req_tick))
 
 
             # tombstones bounded by actual churn: the only PERMANENT
