@@ -10,7 +10,7 @@ import pytest
 import jylis_tpu  # noqa: F401
 from jylis_tpu.cluster import cluster as cluster_mod
 
-from test_cluster import TICK, Node, converge_wait, resp_call
+from test_cluster import TICK, Node, _CollectResp, converge_wait, resp_call
 
 
 def free_port() -> int:
@@ -370,6 +370,58 @@ def test_mid_heal_serve_defer_is_capped():
 
     asyncio.run(main())
 
+
+def test_dispose_mid_sync_stream_completes_promptly(monkeypatch):
+    """Clean shutdown while a sync dump is streaming: dispose drops the
+    waiter's connection under the serve task's feet — the task must
+    drain out via its send-failure path (no hang, no unhandled error)
+    and dispose must not wait on the stream. Streaming is made slow and
+    many-framed deterministically (tiny chunks + a per-frame delay)."""
+    monkeypatch.setattr(cluster_mod, "SYNC_CHUNK_KEYS", 4)
+    orig_send = cluster_mod.Cluster._send_frame
+
+    async def slow_send(self, conn, data):
+        await asyncio.sleep(0.05)
+        return await orig_send(self, conn, data)
+
+    monkeypatch.setattr(cluster_mod.Cluster, "_send_frame", slow_send)
+
+    async def main():
+        pa, pb = free_port(), free_port()
+        a = Node("dispa", pa)
+        b = Node("dispb", pb, seeds=[a.config.addr])
+        try:
+            await a.start()
+            r = _CollectResp()
+            # 100 frames at 4 keys/chunk x 50 ms/frame = ~5 s of stream:
+            # a dispose that joined the stream would blow the 2 s bound
+            for i in range(400):
+                a.database.manager("GCOUNT").repo.apply(
+                    r, [b"INC", b"d%d" % i, b"5"]
+                )
+            await b.start()  # establishment sync request starts the dump
+
+            def streaming():
+                return a.cluster._sync_dump_inflight
+
+            assert await converge_wait(streaming, ticks=120), (
+                "sync dump never started"
+            )
+            await asyncio.sleep(4 * TICK)  # stream is mid-flight
+            t0 = asyncio.get_event_loop().time()
+            await a.stop()
+            assert asyncio.get_event_loop().time() - t0 < 2.0, (
+                "dispose blocked on the in-flight sync stream"
+            )
+            # the serve task unwinds via its send-failure path
+            assert await converge_wait(
+                lambda: not a.cluster._sync_dump_inflight, ticks=120
+            ), "serve task never unwound after dispose"
+        finally:
+            await a.stop()  # idempotent; covers pre-stop assertion exits
+            await b.stop()
+
+    asyncio.run(main())
 
 def test_write_hot_request_defer_is_capped():
     """The requester-side twin of the mid-heal cap: a node whose local
