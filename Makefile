@@ -3,10 +3,12 @@
 
 PY ?= python
 
-.PHONY: test bench bench-all bench-full native run clean check-graft ci \
+.PHONY: test soak bench bench-all bench-full native run clean check-graft ci \
         check-prose image compose-smoke smoke3 release
 
-# what CI runs per commit (.github/workflows/ci.yml): hermetic on any host
+# what CI runs per commit (.github/workflows/ci.yml): hermetic on any host.
+# `test` includes the journal suite (tests/test_journal.py — append/replay,
+# corruption classes, rotation, and a real SIGKILL/restart boot).
 ci: native test check-graft check-prose
 
 # every README headline number must match the committed BENCH_full.json
@@ -15,6 +17,11 @@ check-prose:
 
 test:
 	$(PY) -m pytest tests/ -x -q
+
+# nightly CI: the long-running real-process churn/crash drills, including
+# the SIGKILL-mid-traffic journal recovery soak
+soak:
+	$(PY) -m pytest tests/ -q -m soak
 
 bench:
 	$(PY) bench.py

@@ -75,7 +75,7 @@ class _Conn:
 
     __slots__ = (
         "writer", "active_addr", "established", "task", "sync_served_tick",
-        "sync_digests",
+        "sync_digests", "sync_defer_streak", "sync_defer_last_tick",
     )
 
     def __init__(self, writer, active_addr: Address | None):
@@ -87,6 +87,15 @@ class _Conn:
         # requests within the cooldown get a Pong, not another dump)
         self.sync_served_tick: int | None = None
         self.sync_digests = ()  # the requester's per-type digests, if any
+        # consecutive mid-heal serve deferrals for THIS requester, capped
+        # (see _passive_msg's MsgSyncRequest branch). Per-connection, not
+        # global (ADVICE round 5): a single shared streak lets the serve
+        # slot land repeatedly on one peer of several concurrently
+        # rejoining in stable order, starving the others even though the
+        # aggregate refusal chain is capped — per-peer streaks make the
+        # finite-refusal guarantee hold for EACH requester.
+        self.sync_defer_streak = 0
+        self.sync_defer_last_tick: int | None = None
 
     # a peer that keeps ponging but stops reading would otherwise grow the
     # transport write buffer without bound
@@ -148,23 +157,16 @@ class Cluster:
         # itself ingesting a heal, it defers serving dumps (Pong) — a
         # behind peer re-dumping its stale keyspace every period while
         # converging the very stream that fixes it starves its repo
-        # locks (dump + converge + digest all contend) and wedges reads
+        # locks (dump + converge + digest all contend) and wedges reads.
+        # The deferrals themselves are capped PER REQUESTER (the streak
+        # fields live on _Conn) so every rejoiner's refusal chain is
+        # finite even when several rejoin concurrently in stable order —
+        # PLUS a looser aggregate cap below: per-conn streaks reset on
+        # reconnect, so a requester whose connection churns every period
+        # would otherwise present a fresh allowance forever.
         self._sync_rx_tick: int | None = None
-        # consecutive mid-heal serve deferrals, CAPPED like the
-        # requester-side write-hot defer: with cluster-wide aligned
-        # heartbeats, an ahead node's own periodic pull makes the behind
-        # peer stream its (stale) dump right before the behind peer's
-        # request arrives — an uncapped defer then starves the rejoiner
-        # FOREVER (each period repeats the same alignment). Bounding the
-        # streak keeps the contention relief while guaranteeing any
-        # refusal chain is finite. The streak decays only when the last
-        # REFUSAL is much older than a period (_sync_defer_last_tick):
-        # a per-rx-episode reset would hand each aligned period a fresh
-        # defer allowance and reintroduce the starvation, while never
-        # decaying would let a stale streak from a long-dead episode
-        # skip the defers of the next one.
-        self._sync_serve_defer_streak = 0
-        self._sync_defer_last_tick: int | None = None
+        self._sync_serve_defer_total = 0  # consecutive defers, any conn
+        self._sync_defer_total_tick: int | None = None
 
     # ---- lifecycle --------------------------------------------------------
 
@@ -401,6 +403,20 @@ class Cluster:
             # A node that is ITSELF mid-heal defers with a Pong: its
             # state is about to change anyway, and dumping it would
             # contend the same repo locks the inbound heal needs.
+            # The mid-heal defer streak is CAPPED like the requester-side
+            # write-hot defer: with cluster-wide aligned heartbeats, an
+            # ahead node's own periodic pull makes the behind peer stream
+            # its (stale) dump right before the behind peer's request
+            # arrives — an uncapped defer then starves the rejoiner
+            # FOREVER (each period repeats the same alignment). The
+            # streak is PER REQUESTER (on _Conn, beside sync_served_tick):
+            # a global streak would let the serve slot land repeatedly on
+            # the same peer of several concurrently rejoining in stable
+            # order. It decays only when the conn's last REFUSAL is much
+            # older than a period: a per-rx-episode reset would hand each
+            # aligned period a fresh defer allowance and reintroduce the
+            # starvation, while never decaying would let a stale streak
+            # from a long-dead episode skip the defers of the next one.
             rate_limited = (
                 conn.sync_served_tick is not None
                 and self._tick - conn.sync_served_tick < SYNC_PERIOD_TICKS
@@ -410,30 +426,48 @@ class Cluster:
                 and self._tick - self._sync_rx_tick < SYNC_REQUEST_COOLDOWN
             )
             if (
-                self._sync_defer_last_tick is not None
-                and self._tick - self._sync_defer_last_tick
+                conn.sync_defer_last_tick is not None
+                and self._tick - conn.sync_defer_last_tick
                 > 6 * SYNC_PERIOD_TICKS
             ):
-                # stale streak from a long-dead heal episode (see the
-                # field's comment for why the decay keys off the last
-                # refusal, not the rx window). The window must EXCEED
-                # the slowest capped requester's pull spacing — a
-                # write-hot requester pulls every 4th period (heartbeat
-                # defer streak < 3) — or its refusals each look stale,
-                # decay resets the streak between them, and the cap
-                # never binds for exactly the starved node it protects.
-                self._sync_serve_defer_streak = 0
-            if rate_limited or (mid_heal and self._sync_serve_defer_streak < 2):
-                if mid_heal and not rate_limited:
-                    self._sync_serve_defer_streak += 1
-                    self._sync_defer_last_tick = self._tick
+                # stale streak from a long-dead heal episode. The decay
+                # window must EXCEED the slowest capped requester's pull
+                # spacing — a write-hot requester pulls every 4th period
+                # (heartbeat defer streak < 3) — or its refusals each
+                # look stale, decay resets the streak between them, and
+                # the cap never binds for exactly the starved node it
+                # protects.
+                conn.sync_defer_streak = 0
+            if (
+                self._sync_defer_total_tick is not None
+                and self._tick - self._sync_defer_total_tick
+                > 6 * SYNC_PERIOD_TICKS
+            ):
+                self._sync_serve_defer_total = 0  # same decay, aggregate
+            # a defer needs BOTH allowances: the per-conn streak (< 2,
+            # the fairness cap) and the aggregate consecutive-defer
+            # count (< 6 — a churning requester presents a fresh conn
+            # each period, so only an any-conn cap bounds ITS chain)
+            defer = (
+                mid_heal
+                and conn.sync_defer_streak < 2
+                and self._sync_serve_defer_total < 6
+            )
+            if rate_limited or defer:
+                if defer and not rate_limited:
+                    conn.sync_defer_streak += 1
+                    conn.sync_defer_last_tick = self._tick
+                    self._sync_serve_defer_total += 1
+                    self._sync_defer_total_tick = self._tick
                     self._log.info() and self._log.i(
                         "sync: mid-heal, deferring dump "
-                        f"(streak {self._sync_serve_defer_streak})"
+                        f"(streak {conn.sync_defer_streak}, "
+                        f"total {self._sync_serve_defer_total})"
                     )
                 self._send(conn, MsgPong())
                 return
-            self._sync_serve_defer_streak = 0
+            conn.sync_defer_streak = 0
+            self._sync_serve_defer_total = 0
             conn.sync_served_tick = self._tick
             conn.sync_digests = tuple(msg.digests)
             self._sync_waiters.append(conn)
@@ -675,11 +709,7 @@ class Cluster:
 
     @staticmethod
     def _worth_holding(name: str, batch) -> bool:
-        if not batch:
-            return False
-        if name == "SYSTEM":
-            return any(entries or cutoff for _, (entries, cutoff) in batch)
-        return True
+        return codec.batch_has_content(name, batch)
 
     def _send_to_actives(self, data: bytes) -> bool:
         """Write one pre-framed message to every established active conn;

@@ -164,6 +164,20 @@ def legacy_snapshot_signatures() -> tuple[bytes, ...]:
 CodecError = WireError
 
 
+def batch_has_content(name: str, batch) -> bool:
+    """True when a flushed delta batch carries joinable content. Empty
+    batches and the SYSTEM keepalive quirk (deltas_size()==1 even when
+    the delta log is empty) ship nothing a receiver — or the delta
+    journal — can use. The SYSTEM batch-shape knowledge lives here with
+    the rest of the per-type delta shapes; the cluster held-delta filter
+    and journal/journal.py both delegate to this one predicate."""
+    if not batch:
+        return False
+    if name == "SYSTEM":
+        return any(entries or cutoff for _, (entries, cutoff) in batch)
+    return True
+
+
 # ---- primitive writers ----------------------------------------------------
 
 

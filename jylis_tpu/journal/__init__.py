@@ -1,0 +1,12 @@
+"""Delta write-ahead journal (see journal/journal.py)."""
+
+from .journal import (  # noqa: F401
+    FSYNC_ALWAYS,
+    FSYNC_INTERVAL,
+    FSYNC_OFF,
+    Journal,
+    JournalError,
+    MAGIC,
+    recover,
+    replay_journal,
+)

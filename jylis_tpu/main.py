@@ -14,6 +14,7 @@ import signal
 import sys
 
 from . import persist
+from . import journal as journal_mod
 from .utils import metrics
 from .cluster import Cluster
 from .models import database as database_mod
@@ -36,12 +37,14 @@ class Dispose:
         cluster: Cluster,
         snapshot_path: str = "",
         log=None,
+        journal=None,
     ):
         self._database = database
         self._server = server
         self._cluster = cluster
         self._snapshot_path = snapshot_path
         self._log = log
+        self._journal = journal
         self._disposing = False
         self._shutdown_task: asyncio.Task | None = None
         self.snapshot_task: asyncio.Task | None = None  # online snapshot loop
@@ -99,6 +102,14 @@ class Dispose:
                             self._database,
                             self._snapshot_path,
                         )
+                    if self._journal is not None:
+                        # the shutdown snapshot (final flush included)
+                        # supersedes the whole journal: retire it so the
+                        # next boot replays nothing. On snapshot failure
+                        # we skip this and the journal stays — it is then
+                        # the only copy of the unsnapshotted deltas.
+                        await asyncio.to_thread(self._journal.rotate_begin)
+                        await asyncio.to_thread(self._journal.rotate_commit)
                 except Exception as e:
                     if self._log is not None:
                         self._log.err() and self._log.e(f"snapshot failed: {e}")
@@ -110,6 +121,8 @@ class Dispose:
                 )
             metrics.stop_profiling()
         finally:
+            if self._journal is not None:
+                self._journal.close()  # final flush+fsync; appends stop
             self._cluster.dispose()
             await self._server.dispose()
             self.done.set()
@@ -124,6 +137,7 @@ async def run(argv: list[str] | None = None) -> None:
     log = config.log
 
     snapshot_path = ""
+    journal = None
     if config.data_dir:
         os.makedirs(config.data_dir, exist_ok=True)
         snapshot_path = os.path.join(config.data_dir, "snapshot.jylis")
@@ -142,19 +156,35 @@ async def run(argv: list[str] | None = None) -> None:
                     log.err() and log.e(f"moved aside to {aside}")
                 except OSError:
                     pass
+        if config.journal:
+            # recovery ordering: snapshot first, then the journal tail —
+            # though lattice join makes the order a formality (overlap
+            # between snapshot and journal converges to the same state)
+            journal_path = os.path.join(config.data_dir, "journal.jylis")
+            n = journal_mod.recover(database, journal_path, log)
+            if n:
+                log.info() and log.i(f"journal replayed ({n} delta batches)")
+            journal = journal_mod.Journal(
+                journal_path,
+                fsync=config.journal_fsync,
+                fsync_interval=config.journal_fsync_interval,
+                max_bytes=config.journal_max_bytes,
+            )
+            journal.open()
+            database.set_journal(journal)
 
     server = Server(config, database)
     cluster = Cluster(config, database)
     await server.start()
     await cluster.start()
-    dispose = Dispose(database, server, cluster, snapshot_path, log)
+    dispose = Dispose(database, server, cluster, snapshot_path, log, journal)
     dispose.on_signal()
 
-    if snapshot_path and config.snapshot_interval > 0:
+    if snapshot_path and (config.snapshot_interval > 0 or journal is not None):
         dispose.snapshot_task = asyncio.create_task(
             _snapshot_loop(
                 database, snapshot_path, config.snapshot_interval, log,
-                dispose.snapshot_inflight,
+                dispose.snapshot_inflight, journal,
             )
         )
 
@@ -169,7 +199,7 @@ async def run(argv: list[str] | None = None) -> None:
 
 
 async def _snapshot_loop(
-    database, path: str, interval: float, log, inflight: dict
+    database, path: str, interval: float, log, inflight: dict, journal=None
 ) -> None:
     """Online snapshots while serving (extension over shutdown-only
     persistence — a crash otherwise loses everything since boot). Each
@@ -179,13 +209,49 @@ async def _snapshot_loop(
     restore is lattice convergence. The write is atomic, so a crash
     mid-snapshot keeps the previous file.
 
+    With a journal attached, this loop is also the compaction driver:
+    it wakes EARLY when the journal crosses its size threshold (the
+    rotate_notify hook), rotates the active segment aside FIRST — so
+    every delta flushed after the cut lands in the fresh segment and the
+    snapshot dumped below covers everything before it — and retires the
+    old segment only after the snapshot write succeeds. A failure or
+    crash anywhere in between leaves the ``.retiring`` segment for boot
+    recovery; the next rotation folds the segments together. With
+    ``--snapshot-interval 0`` (and a journal), snapshots happen ONLY on
+    size-triggered compaction.
+
     The write future is published through ``inflight["write"]`` until it
     completes: if this task is cancelled mid-write, the worker thread
     runs on, and Dispose awaits the future before the shutdown snapshot
     touches the same tmp file."""
+    rotate_event = asyncio.Event()
+    if journal is not None:
+        loop = asyncio.get_running_loop()
+        # appends can come from the loop or (in direct drives) elsewhere;
+        # call_soon_threadsafe is correct from both
+        journal.rotate_notify = lambda: loop.call_soon_threadsafe(
+            rotate_event.set
+        )
+        # a segment already oversized at boot (a crash beat the previous
+        # compaction) — or one that crossed the threshold before this
+        # hook existed — never re-asks: check once at install time
+        if journal.needs_rotation():
+            rotate_event.set()
     while True:
-        await asyncio.sleep(interval)
+        if journal is None:
+            await asyncio.sleep(interval)
+        else:
+            try:
+                await asyncio.wait_for(
+                    rotate_event.wait(),
+                    timeout=interval if interval > 0 else None,
+                )
+            except asyncio.TimeoutError:
+                pass
+            rotate_event.clear()
         try:
+            if journal is not None:
+                await asyncio.to_thread(journal.rotate_begin)
             batches = await database.dump_state_async()
             fut = asyncio.ensure_future(
                 asyncio.to_thread(persist.write_snapshot, batches, path)
@@ -197,6 +263,8 @@ async def _snapshot_loop(
                 else None
             )
             await asyncio.shield(fut)
+            if journal is not None:
+                await asyncio.to_thread(journal.rotate_commit)
             log.debug() and log.d(f"online snapshot written: {path}")
         except asyncio.CancelledError:
             raise

@@ -118,6 +118,13 @@ class Database:
             b"".join(await self.sync_type_digests_async())
         ).digest()
 
+    def set_journal(self, journal) -> None:
+        """Attach the delta write-ahead journal (journal/): every repo's
+        flushed delta batches append to it before reaching the network
+        sink (manager._emit). Pass None to detach."""
+        for mgr in self._map.values():
+            mgr.journal = journal
+
     def manager(self, name: str) -> RepoManager:
         return self._map[name.encode()]
 

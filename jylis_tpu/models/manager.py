@@ -78,6 +78,14 @@ class RepoManager:
         self._last_proactive = None
         self._shutdown = False
         self._lock = asyncio.Lock()
+        # delta write-ahead journal (journal/journal.py), attached via
+        # Database.set_journal: every flushed batch is handed to the
+        # journal's writer thread before it reaches the network sink —
+        # the hand-off itself runs under the same per-repo serialisation
+        # the flush runs under (flush paths execute on the event loop
+        # even when the apply was threaded), so journal order per repo
+        # matches flush order
+        self.journal = None
 
     def apply(self, resp, cmd: list[bytes]) -> None:
         """cmd includes the routing word (cmd[0] == data type name).
@@ -198,13 +206,23 @@ class RepoManager:
 
     def _flush(self) -> None:
         # unconditional, like the reference's proactive path (:81)
-        self._deltas_fn((self.name, self.repo.flush_deltas()))
+        self._emit(self.repo.flush_deltas())
 
     def flush_deltas(self, fn) -> None:
         """Heartbeat entry point: registers the sink, drains if non-empty."""
         self._deltas_fn = fn
         if self.repo.deltas_size() > 0:
-            self._deltas_fn((self.name, self.repo.flush_deltas()))
+            self._emit(self.repo.flush_deltas())
+
+    def _emit(self, batch) -> None:
+        """Every flushed batch leaves through here: journal first (a
+        batch that reached peers' lattices but not our disk is exactly
+        the crash-loss gap the journal closes), then the network sink.
+        The journal append only enqueues — encode/write/fsync happen on
+        the journal's writer thread, off the serving path."""
+        if self.journal is not None:
+            self.journal.append(self.name, batch)
+        self._deltas_fn((self.name, batch))
 
     def converge_deltas(self, batch) -> None:
         for key, delta in batch:

@@ -204,7 +204,11 @@ class RepoTLOG:
         """The drained part of a row, (ts, value) desc — the render cache.
         A miss serves from the table's carried base when it is valid (the
         common case: the drain kept the exact row content host-side); only
-        a base-invalid row pays the ONE device row gather."""
+        a base-invalid row pays the ONE device row gather — and then
+        REPAIRS the table's base from it (ADVICE round 5): without the
+        repair a quiescent row whose drain landed while the merged memo
+        was stale would serve correctly but never settle natively again,
+        paying the FFI stop + Python dispatch on every later GET."""
         ents = self._render.get(row)
         if ents is None:
             length = self._tbl.len_cache(row)
@@ -224,16 +228,19 @@ class RepoTLOG:
                     ]
                     ents.sort(reverse=True)
             self._render[row] = ents
+        if not self._tbl.base_valid(row):
+            self._tbl.set_base(row, ents)
         return ents
 
     def _size_nonquiescent(self, row: int) -> int:
         """Merged-view size with the drained-base handshake: the table
         serves it host-side unless its base is unknown (a drain landed
         while the merged memo was stale), in which case ONE device row
-        gather rebuilds it."""
+        gather rebuilds it (_drained_entries also writes it back as the
+        table's base)."""
         n = self._tbl.size(row)
         if n < 0:
-            self._tbl.set_base(row, self._drained_entries(row))
+            self._drained_entries(row)
             n = self._tbl.size(row)
         return n
 

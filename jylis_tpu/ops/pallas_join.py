@@ -3,15 +3,17 @@ default, with the numbers to show why.
 
 Round-1 review hypothesised a hand-written Pallas merge kernel (stream
 each state block once, input/output aliasing) would beat the XLA
-gather→max→scatter composite ~3×. The real win turned out to be
-algorithmic: routing full-sweep batches through the DENSE elementwise
-join (`pncount.join` under `jit` with donation) lets XLA emit a single
-fused streaming loop that measures ~167M merges/sec/chip on the 1M×64
-north star — ~500+ GB/s of HBM traffic, near the v5e roofline.
+gather→max→scatter composite ~3× (a hypothesis, never measured). The
+real win turned out to be algorithmic: routing full-sweep batches
+through the DENSE elementwise join (`pncount.join` under `jit` with
+donation) lets XLA emit a single fused streaming loop that measures
+162.5M merges/sec/chip recorded on the 1M×64 north star
+(BENCH_full.json `north-star`) — near the v5e HBM roofline.
 
 This module is the Pallas version of that dense join, kept for three
 reasons: (a) it proves the claim with a measurement instead of a guess —
-same workload, 48M merges/sec (the (K,64)→(N/128,128) relayout XLA
+same workload, 47.2M merges/sec recorded (BENCH_full.json
+`pallas-join`; the (K,64)→(N/128,128) relayout XLA
 inserts around the custom call costs more than the kernel saves, and the
 kernel itself cannot beat a bandwidth bound XLA already hits); (b) it is
 the template for future ops that genuinely need manual scheduling
@@ -58,6 +60,14 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+# jax.enable_x64 is the public spelling on newer releases; older
+# toolchains (e.g. 0.4.37, the container's pin) ship the same context
+# manager as jax.experimental.enable_x64
+if hasattr(jax, "enable_x64"):
+    enable_x64 = jax.enable_x64
+else:  # pragma: no cover - exercised only on older jax pins
+    from jax.experimental import enable_x64
+
 from . import pncount
 
 LANES = 128
@@ -100,7 +110,7 @@ def join_fused(
     rows = (k * r) // LANES
     planes = [x.reshape(rows, LANES) for x in (*state, *deltas)]
     spec = pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0))
-    with jax.enable_x64(False):
+    with enable_x64(False):
         out = pl.pallas_call(
             _join_kernel,
             grid=(rows // BLOCK_ROWS,),

@@ -202,11 +202,17 @@ class UJSON:
 
     def _remove_dots(self, dots, delta: "UJSON | None") -> None:
         """Observed-remove: drop entries and record their dots in our context
-        and in the delta's context (no delta entries -> receiver removes)."""
+        and in the delta's context (no delta entries -> receiver removes).
+        A dot the SAME delta window added must also drop out of the
+        delta's entries: an entry whose dot its own context covers reads
+        as LIVE to any converger, so leaving it would resurrect the
+        removed value on every receiver that had not yet seen the add
+        (same-window SET+RM over anti-entropy, journal replay)."""
         for d in dots:
             self.entries.pop(d, None)
             self.ctx.add(d)
             if delta is not None:
+                delta.entries.pop(d, None)
                 delta.ctx.add(d)
 
     def _add_leaf(self, replica: int, path: Path, token: str, delta) -> None:

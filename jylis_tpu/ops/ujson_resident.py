@@ -21,8 +21,10 @@ The reference's converge loop (repo_ujson.pony:96-110) walks the full
 document once per delta; here the full document is never re-touched by
 the host at all — steady-state host cost per drain is the delta encode.
 
-Two properties keep a STREAM of drains fast on real hardware (measured
-on the tunneled v5e: a recompile costs ~25s, a device round trip ~100ms):
+Two properties keep a STREAM of drains fast on real hardware (round-3
+environment numbers from the tunneled v5e, stamped here as historical
+context rather than derived from BENCH_full.json: a recompile costs
+~25s, a device round trip ~100ms):
 
 * **No syncs, stable shapes.** A join's natural output width is the sum
   of its input widths, which would change the jitted shape EVERY drain.

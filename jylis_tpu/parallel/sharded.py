@@ -39,6 +39,14 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+# jax.shard_map is the public spelling on newer releases; older
+# toolchains (e.g. 0.4.37, the container's pin) still ship it as
+# jax.experimental.shard_map.shard_map with the same signature
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # pragma: no cover - exercised only on older jax pins
+    from jax.experimental.shard_map import shard_map
+
 from ..utils.batching import bucket, pad_rows
 from ..ops import planes, treg
 
@@ -148,7 +156,7 @@ def _local_converge(hi_blk, lo_blk, rows_blk, dhi_blk, dlo_blk):
 # jit(shard_map) wrapper per call would retrace and recompile every merge
 @partial(jax.jit, static_argnames=("mesh",), donate_argnums=(1, 2))
 def _converge_sharded(mesh, hi, lo, local_rows, d_hi, d_lo):
-    return jax.shard_map(
+    return shard_map(
         _local_converge,
         mesh=mesh,
         in_specs=(
@@ -170,7 +178,7 @@ def converge_sharded(mesh, hi, lo, local_rows, d_hi, d_lo):
 
 @partial(jax.jit, static_argnames=("mesh",))
 def _read_all_sharded(mesh, hi, lo):
-    return jax.shard_map(
+    return shard_map(
         planes.rowsum64,
         mesh=mesh,
         in_specs=(P("keys", None), P("keys", None)),
@@ -204,7 +212,7 @@ def _local_drain_g(hi_blk, lo_blk, rows_blk, dhi_blk, dlo_blk):
 def drain_sharded_g(mesh, hi, lo, local_rows, d_hi, d_lo):
     """GCOUNT sharded drain: join the routed batch into each device's key
     block and return (hi, lo, per-slot u64 row sums)."""
-    return jax.shard_map(
+    return shard_map(
         _local_drain_g,
         mesh=mesh,
         in_specs=(
@@ -237,7 +245,7 @@ def _local_drain_pn(p_hi, p_lo, n_hi, n_lo, rows_blk, dhi_blk, dlo_blk):
 def drain_sharded_pn(mesh, p_hi, p_lo, n_hi, n_lo, local_rows, d_hi, d_lo):
     """PNCOUNT sharded drain: both polarities join in one launch; returns
     (state planes..., per-slot i64 net values)."""
-    return jax.shard_map(
+    return shard_map(
         _local_drain_pn,
         mesh=mesh,
         in_specs=(
@@ -288,7 +296,7 @@ def drain_sharded_treg(mesh, ts_hi, ts_lo, rk_hi, rk_lo, vid, local_rows, d_hi, 
     """TREG sharded drain: LWW-join the routed batch into each device's
     key block; returns (5 state planes, per-slot tie flags, per-slot
     ts_hi/ts_lo/vid read-back)."""
-    return jax.shard_map(
+    return shard_map(
         _local_drain_treg,
         mesh=mesh,
         in_specs=(
@@ -322,7 +330,7 @@ def _local_patch_treg(vid, rows_blk, patch_vid):
 @partial(jax.jit, static_argnames=("mesh",), donate_argnums=(1,))
 def patch_sharded_treg(mesh, vid, local_rows, patch_vid):
     """Host-resolved prefix-rank ties scatter their winning vids back."""
-    return jax.shard_map(
+    return shard_map(
         _local_patch_treg,
         mesh=mesh,
         in_specs=(P("keys"), P("keys"), P("keys")),
@@ -360,7 +368,7 @@ def drain_sharded_tlog(mesh, nth, ntl, nv, length, cutoff, local_rows, payload, 
     """TLOG sharded drain (+ fused optional per-row trim) over the wide
     3-plane layout; returns (5 state tensors, per-slot overflow flags,
     per-slot lengths, per-slot cutoffs)."""
-    return jax.shard_map(
+    return shard_map(
         partial(_local_drain_tlog, ld=ld),
         mesh=mesh,
         in_specs=(
@@ -416,7 +424,7 @@ def _local_then_pmax(hi_blk, lo_blk):
 
 @partial(jax.jit, static_argnames=("mesh",))
 def _pmax_join(mesh, hi, lo):
-    return jax.shard_map(
+    return shard_map(
         _local_then_pmax,
         mesh=mesh,
         in_specs=(P("rep", "keys"), P("rep", "keys")),

@@ -32,6 +32,12 @@ class Config:
     system_log_trim: int = 200
     data_dir: str = ""  # extension: snapshot/restore (persist.py)
     snapshot_interval: float = 0.0  # extension: online snapshot cadence
+    # extension: delta write-ahead journal (journal/journal.py) — on by
+    # default whenever data_dir is set; the flags below tune it
+    journal: bool = True
+    journal_fsync: str = "interval"
+    journal_fsync_interval: float = 0.2
+    journal_max_bytes: int = 64 << 20
     log: Log = field(default_factory=Log.create_none)
 
     def normalize(self) -> None:
@@ -80,6 +86,31 @@ def config_from_cli(argv: list[str] | None = None, log_out=None) -> Config:
         "under its own lock, so serving never pauses globally).",
     )
     parser.add_argument(
+        "--no-journal", action="store_true",
+        help="Disable the delta write-ahead journal. With --data-dir the "
+        "journal is ON by default: every flushed delta batch appends to "
+        "DIR/journal.jylis and is converged back on boot, closing the "
+        "crash-loss window between snapshots (docs/durability.md).",
+    )
+    parser.add_argument(
+        "--journal-fsync", choices=("always", "interval", "off"),
+        default="interval",
+        help="Journal fsync policy: 'always' fsyncs every append, "
+        "'interval' fsyncs at most once per --journal-fsync-interval "
+        "seconds (bounded power-loss window; a plain process crash loses "
+        "nothing under any policy), 'off' leaves syncing to the OS.",
+    )
+    parser.add_argument(
+        "--journal-fsync-interval", type=float, default=0.2,
+        help="Seconds between journal fsyncs under --journal-fsync "
+        "interval (the power-loss data-at-risk window).",
+    )
+    parser.add_argument(
+        "--journal-max-bytes", type=int, default=64 << 20,
+        help="Journal size that triggers compaction: a fresh snapshot is "
+        "cut and the old journal segment retired (docs/durability.md).",
+    )
+    parser.add_argument(
         "-L", "--log-level", default="info",
         help="Maximum level of detail for logging (error, warn, info, or debug).",
     )
@@ -102,6 +133,10 @@ def config_from_cli(argv: list[str] | None = None, log_out=None) -> Config:
     config.system_log_trim = args.system_log_trim
     config.data_dir = args.data_dir
     config.snapshot_interval = args.snapshot_interval
+    config.journal = not args.no_journal
+    config.journal_fsync = args.journal_fsync
+    config.journal_fsync_interval = args.journal_fsync_interval
+    config.journal_max_bytes = args.journal_max_bytes
 
     level = {"error": "err", "warn": "warn", "info": "info", "debug": "debug"}.get(
         args.log_level

@@ -44,6 +44,20 @@ counters: dict[str, dict[str, float]] = defaultdict(
     lambda: {"batches": 0, "keys": 0, "seconds": 0.0}
 )
 
+# delta write-ahead journal counters (journal/journal.py): appends /
+# bytes / fsyncs accrue on the flush path, replayed_batches on boot
+# recovery, errors on ANY writer-side encode/write/fsync failure — the
+# one signal that durability silently degraded (full disk), so it must
+# be visible in SYSTEM METRICS, not just a stashed exception.
+# Process-global like the drain counters above (and with the same
+# caveat: multiple journaling Databases in one process share them).
+_JOURNAL_KEYS = ("appends", "bytes", "fsyncs", "replayed_batches", "errors")
+journal_counters: dict[str, int] = dict.fromkeys(_JOURNAL_KEYS, 0)
+
+
+def note_journal(counter: str, n: int = 1) -> None:
+    journal_counters[counter] += n
+
 
 def note_drain(name: str, n_keys: int, seconds: float) -> None:
     c = counters[name]
@@ -113,6 +127,11 @@ def metric_lines(served: dict[str, int] | None = None) -> list[str]:
         lines.append(f"{name} drains {drains}")
         lines.append(f"{name} keys {keys}")
         lines.append(f"{name} device_ms {ms:.1f}")
+    if any(journal_counters.values()):
+        # all four lines once journaling is live, so dashboards see
+        # explicit zeros (e.g. fsyncs under --journal-fsync off)
+        for k in _JOURNAL_KEYS:
+            lines.append(f"JOURNAL {k} {journal_counters[k]}")
     return lines
 
 

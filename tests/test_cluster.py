@@ -485,6 +485,81 @@ def test_backpressured_connection_dropped_on_broadcast():
     assert cl._held == []  # delivery succeeded, nothing held
 
 
+def test_mid_heal_serve_defer_streak_is_per_peer():
+    """ADVICE round 5: three concurrently-rejoining peers request sync in
+    a stable order through a SUSTAINED mid-heal window (the aligned-
+    heartbeat phase-lock regime the defer cap exists for). The cap must
+    bind per requester — with a single global streak the serve slot
+    (streak==2, reset to 0) lands on the same peer every period and the
+    others' refusal chains grow without bound."""
+    from jylis_tpu.cluster.cluster import SYNC_PERIOD_TICKS, _Conn
+    from jylis_tpu.cluster.msg import MsgSyncRequest
+
+    class FakeTransport:
+        def is_closing(self):
+            return False
+
+        def get_write_buffer_size(self):
+            return 0
+
+    class FakeWriter:
+        def __init__(self):
+            self.transport = FakeTransport()
+
+        def write(self, data):
+            pass
+
+        async def drain(self):
+            pass
+
+        def close(self):
+            pass
+
+    async def main():
+        node = Node("server", grab_ports(1)[0])
+        cl = node.cluster
+        conns = [_Conn(FakeWriter(), None) for _ in range(3)]
+        for conn in conns:
+            conn.established = True
+            cl._passives.add(conn)
+        # a digest that can never match: the server must stream real dumps
+        req = MsgSyncRequest((b"x" * 32,) * 5)
+        first_serve: dict[int, int] = {}
+        for period in range(4):
+            cl._tick += SYNC_PERIOD_TICKS
+            cl._sync_rx_tick = cl._tick  # the heal stream keeps flowing
+            for i, conn in enumerate(conns):  # stable arrival order
+                before = conn.sync_served_tick
+                await cl._passive_msg(conn, req)
+                if conn.sync_served_tick != before:
+                    first_serve.setdefault(i, period)
+            if cl._flush_tasks:  # let the dump task drain the waiters
+                await asyncio.gather(*list(cl._flush_tasks))
+        # EVERY peer's refusal chain is finite: served by its 3rd request
+        # (two capped defers), not just whichever peer the slot lands on
+        assert first_serve == {0: 2, 1: 2, 2: 2}, first_serve
+
+        # and a requester whose CONNECTION churns every period (fresh
+        # _Conn, fresh per-conn allowance) is still served in bounded
+        # time: the aggregate consecutive-defer cap binds instead
+        served_after = None
+        for attempt in range(10):
+            cl._tick += SYNC_PERIOD_TICKS
+            cl._sync_rx_tick = cl._tick
+            fresh = _Conn(FakeWriter(), None)
+            fresh.established = True
+            cl._passives.add(fresh)
+            await cl._passive_msg(fresh, req)
+            if fresh.sync_served_tick is not None:
+                served_after = attempt
+                break
+            if cl._flush_tasks:
+                await asyncio.gather(*list(cl._flush_tasks))
+        assert served_after is not None and served_after <= 7, served_after
+
+    asyncio.run(main())
+
+
 def test_node_restart_from_snapshot_rejoins_and_converges(tmp_path):
     """Failure recovery end to end (SURVEY §5.3/§5.4): a node snapshots,
     dies, restarts from disk on the SAME advertised identity, rejoins the

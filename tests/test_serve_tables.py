@@ -386,6 +386,13 @@ def test_scan_apply_tlog_get_defers_when_base_unknown():
     assert _oracle_reply(native, [b"GET", b"k"]) == (
         b"*1\r\n*2\r\n$1\r\nv\r\n:7\r\n"
     )
+    # and REPAIRS the drained base while at it (ADVICE round 5): the next
+    # GET settles natively again instead of deferring forever
+    rc, _, replies, unhandled, _ = native.engine.scan_apply(
+        bytearray(b"TLOG GET k\r\n")
+    )
+    assert rc == 0 and unhandled is None
+    assert replies == b"*1\r\n*2\r\n$1\r\nv\r\n:7\r\n"
 
 
 def test_scan_apply_tlog_get_big_reply_flushes_then_defers():

@@ -71,6 +71,7 @@ def _read(port: int, *args):
 
 
 @pytest.mark.soak
+@pytest.mark.slow  # nightly (`make soak`), not per-commit
 def test_scale_100k_keys_churn_and_resync(tmp_path):
     rng = random.Random(7)
     ports = [free_port() for _ in range(3)]
