@@ -3,17 +3,24 @@
 
 PY ?= python
 
-.PHONY: test soak bench bench-all bench-full native run clean check-graft ci \
-        check-prose image compose-smoke smoke3 release
+.PHONY: test soak bench bench-all bench-full bench-smoke native run clean \
+        check-graft ci check-prose image compose-smoke smoke3 release
 
 # what CI runs per commit (.github/workflows/ci.yml): hermetic on any host.
 # `test` includes the journal suite (tests/test_journal.py — append/replay,
 # corruption classes, rotation, and a real SIGKILL/restart boot).
-ci: native test check-graft check-prose
+ci: native test check-graft check-prose bench-smoke
 
 # every README headline number must match the committed BENCH_full.json
 check-prose:
 	$(PY) scripts/check_prose.py
+
+# tiny-iteration pass over the serving-bench harness (the RESP reply
+# counter, fallback accounting, demotion path, latency loop) so the
+# plumbing behind the recorded numbers can't rot between re-records;
+# pinned to CPU — it checks the harness, not the hardware
+bench-smoke:
+	JAX_PLATFORMS=cpu $(PY) bench.py --smoke
 
 test:
 	$(PY) -m pytest tests/ -x -q

@@ -42,6 +42,7 @@ class Database:
         # Database instances in tests/benches)
         self._served_py: dict[str, int] = {}
         self.system.served_fn = self._served_totals
+        self.system.serving_fn = self.serving_totals
         for repo in (
             RepoTREG(identity, engine=self.native_engine),
             RepoTLOG(identity, engine=self.native_engine),
@@ -74,6 +75,23 @@ class Database:
                 if n:
                     totals[name] = totals.get(name, 0) + n
         return totals
+
+    def serving_totals(self) -> dict[str, int]:
+        """The native-vs-demoted serving split (SYSTEM METRICS SERVING
+        lines, and the bench's recorded fallback_frac): commands the
+        engine settled in C++ vs commands that went through the Python
+        dispatch path (engine defers, demoted connections, and direct
+        applies), plus whole-connection demotion events."""
+        from ..utils import metrics
+
+        native = 0
+        if self.native_engine is not None:
+            native = sum(self.native_engine.served_counts().values())
+        return {
+            "native_cmds": native,
+            "demoted_cmds": sum(self._served_py.values()),
+            "demotions": metrics.serving_counters["demotions"],
+        }
 
     def _sync_update_repo(self, name: str, repo) -> None:
         """Fold the repo's dirty keys into its digest accumulator (worker

@@ -43,6 +43,9 @@ class RepoSYSTEM:
         # Database wires this to its per-instance commands-served totals
         # (Python dispatch + native engine) for METRICS' "cmds" lines
         self.served_fn = None
+        # ... and this to the native-vs-demoted serving split for the
+        # SERVING native_cmds/demoted_cmds/demotions/fallback_frac lines
+        self.serving_fn = None
 
     def apply(self, resp, args: list[bytes]) -> bool:
         op = need(args, 0)
@@ -65,7 +68,8 @@ class RepoSYSTEM:
             from ..utils.metrics import metric_lines
 
             lines = metric_lines(
-                self.served_fn() if self.served_fn else None
+                self.served_fn() if self.served_fn else None,
+                self.serving_fn() if self.serving_fn else None,
             )
             resp.array_start(len(lines))
             for line in lines:

@@ -82,9 +82,12 @@ class RepoUJSON:
         from ..parallel import serving_mesh
 
         self._identity = identity
-        # native serving engine (server/serve_engine.cpp): validated INS
-        # commands bank in its write queue; _flush_queue applies them (in
-        # arrival order) before any other UJSON work reads or writes
+        # native serving engine (native/serve_engine.cpp): validated
+        # INS/SET/RM/CLR commands bank in its write queue (_flush_queue
+        # applies them, in arrival order, before any other UJSON work
+        # reads or writes), and GET replies this repo rendered are
+        # memoised per (key, path) so repeat reads settle natively —
+        # every write here invalidates the overlapping memos
         self.engine = engine
         # mesh mode: the resident store's row axis shards over the
         # serving mesh and drains use the row-aligned fold — SPMD with
@@ -168,24 +171,52 @@ class RepoUJSON:
         return args[1], _decode_path(args[2:-1]), args[-1].decode("utf-8", "replace")
 
     def _flush_queue(self) -> None:
-        """Apply every INS the native engine banked (in arrival order).
-        Runs before any other UJSON work so the queue is invisible to
-        reads, flushes, drains and snapshots; the engine pre-validated
-        each value token, so the applies cannot fail (the +OK replies are
-        already on the wire)."""
+        """Apply every write the native engine banked (in arrival order):
+        INS, SET, RM and CLR, exactly the sequences their apply() branches
+        run — observed-remove ops observe (drain) first. Runs before any
+        other UJSON work so the queue is invisible to reads, flushes,
+        drains and snapshots; the engine pre-validated each value token
+        (engine.h ujson_prim_ok / ujson_doc_ok), so the applies cannot
+        fail (the +OK replies are already on the wire)."""
         if self.engine is None or not self.engine.uq_count():
             return
         for args in self.engine.uq_drain():
+            op = args[0]
+            if op == b"CLR":
+                key = args[1]
+                self._drain_key(key)  # observed-remove: observe first
+                self._demote(key)
+                doc = self._data.get(key)
+                if doc is not None:
+                    doc.clr(
+                        self._identity, _decode_path(args[2:]),
+                        self._delta_for(key),
+                    )
+                self._sync_dirty.add(key)
+                continue
             key, path, value = self._path_and_value(args)
-            self._demote(key)
-            self._data_for(key).ins(
-                self._identity, path, value, self._delta_for(key)
-            )
+            if op == b"SET":
+                self._drain_key(key)  # SET clears OBSERVED dots
+                self._demote(key)
+                self._data_for(key).set_doc(
+                    self._identity, path, value, self._delta_for(key)
+                )
+            elif op == b"RM":
+                self._drain_key(key)  # observed-remove: observe first
+                self._demote(key)
+                doc = self._data.get(key)
+                if doc is not None:
+                    doc.rm(self._identity, path, value, self._delta_for(key))
+            else:  # INS
+                self._demote(key)
+                self._data_for(key).ins(
+                    self._identity, path, value, self._delta_for(key)
+                )
             self._sync_dirty.add(key)
 
     def prepare_flush(self) -> None:
         """Manager hook (flush_async): drain the write queue in a worker
-        thread before the loop-side delta flush — a queued INS on a
+        thread before the loop-side delta flush — a queued write on a
         resident key demotes, which can decode (a blocking device pull)."""
         self._flush_queue()
 
@@ -194,12 +225,32 @@ class RepoUJSON:
         op = need(args, 0)
         if op in (b"SET", b"CLR", b"INS", b"RM") and len(args) >= 2:
             self._sync_dirty.add(args[1])
+            if self.engine is not None:
+                # a write applied on THIS path (deferred by the engine, or
+                # a direct apply) must drop the overlapping render memos,
+                # exactly as a natively banked one does at bank time
+                self.engine.uj_invalidate(
+                    args[1],
+                    args[2:] if op == b"CLR" else args[2:-1],
+                    subtree=op in (b"SET", b"CLR"),
+                )
         if op == b"GET":
             key = need(args, 1)
             self._drain_key(key)
             path = _decode_path(args[2:])
             doc = self._view(key)
-            resp.string(doc.render(path) if doc is not None else "")
+            text = doc.render(path) if doc is not None else ""
+            resp.string(text)
+            if self.engine is not None and doc is not None:
+                body = text.encode()
+                # memo repair (the TLOG base-repair shape): the next GET
+                # of this (key, path) settles natively on these bytes.
+                # Keys with no document never memoise — a read-only scan
+                # over absent keys must not grow engine rows without
+                # bound (rows are bounded by the written keyspace)
+                self.engine.uj_memo_put(
+                    key, args[2:], b"$%d\r\n%s\r\n" % (len(body), body)
+                )
             return False
         if op == b"SET":
             key, path, value = self._path_and_value(args)
@@ -258,6 +309,10 @@ class RepoUJSON:
         lst.append(delta)
         self._pend_total += 1
         self._sync_dirty.add(key)
+        if self.engine is not None:
+            # a remote delta can change any subtree: drop every render
+            # memo for the key (path () with subtree=True covers all)
+            self.engine.uj_invalidate(key, (), subtree=True)
         if len(lst) >= DEVICE_FANIN_MIN:
             self._overdue = True
 
@@ -273,6 +328,10 @@ class RepoUJSON:
     # event loop
     may_drain_OPS = (b"GET", b"SET", b"CLR", b"RM", b"INS")
 
+    # banked native-queue commands above which even a host-only flush
+    # offloads to a thread (a bounded event-loop stall beats none)
+    UQ_INLINE_MAX = 1024
+
     def may_drain(self, args: list[bytes]) -> bool:
         """Commands that will touch the device get offloaded to a thread
         (manager.apply_async): a device-sized pending fan-in, a resident
@@ -280,9 +339,23 @@ class RepoUJSON:
         device), or a resident read/demotion that must decode (cache
         miss). A trickle on a warm cache stays on the loop — the drain
         serves it host-side in microseconds. A non-empty native write
-        queue always offloads: the flush may demote resident keys."""
+        queue offloads only when its flush can actually touch the device
+        (a resident store exists, a fan-in reached device size, or the
+        queue is large): a small host-only flush runs inline, so the one
+        deferred command that flushes it never opens a lock window that
+        routes every OTHER connection's burst off the native path
+        (server/server.py _native_busy — the round-5 shape threaded
+        every flush and turned each UJSON defer into a whole-node
+        demotion storm under concurrency)."""
         if self.engine is not None and self.engine.uq_count():
-            return True
+            if (
+                self._res is not None
+                or self._overdue
+                or self._pend_total >= PENDING_TOTAL_MAX
+                or self.engine.uq_count() > self.UQ_INLINE_MAX
+            ):
+                return True
+            # host-only flush: fall through to this command's own checks
         if len(args) < 2 or args[0] not in self.may_drain_OPS:
             return False
         key = args[1]
@@ -439,10 +512,10 @@ class RepoUJSON:
 
     def deltas_size(self) -> int:
         # the banked queue is NOT drained here: this runs on the event
-        # loop (proactive flush), and a queued INS on a resident key
+        # loop (proactive flush), and a queued write on a resident key
         # demotes with a blocking device decode. prepare_flush (threaded,
         # manager.flush_async / clean_shutdown) drains it; deltas from
-        # still-banked INSes simply ship on the next heartbeat flush.
+        # still-banked writes simply ship on the next heartbeat flush.
         return len(self._deltas)
 
     def flush_deltas(self):

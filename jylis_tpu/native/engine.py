@@ -3,8 +3,9 @@ counter_engine.cpp + serve_engine.cpp).
 
 `ServeEngine` owns the host state every command touches — the
 GCOUNT/PNCOUNT counter tables, the TREG winner/pending/delta registers,
-the TLOG pending/merged-view/delta logs and the UJSON write queue — and
-applies whole pipelined command bursts per FFI call. The Python dict
+the TLOG pending/merged-view/delta logs, the validated UJSON write
+queue and the UJSON per-(key, path) render memo — and applies whole
+pipelined command bursts per FFI call. The Python dict
 backends (models/counter_table.py, models/treg_table.py,
 models/tlog_table.py) remain the semantic oracles and the fallback when
 no toolchain is available; differential tests pin the equivalence.
@@ -125,11 +126,15 @@ def _declare(c: ctypes.CDLL) -> None:
         "jy_tlog_delta_raise_cutoff": (None, [vp, i64, u64]),
         "jy_tlog_clear_deltas": (None, [vp]),
         "jy_eng_served": (None, [vp, vp]),
-        # UJSON queue
+        # UJSON queue + render memo
         "jy_uq_count": (i64, [vp]),
         "jy_uq_bytes": (i64, [vp]),
         "jy_uq_data": (i64, [vp, vp, i64]),
         "jy_uq_clear": (None, [vp]),
+        "jy_uj_upsert": (i64, [vp, u8p, i64]),
+        "jy_uj_memo_put": (None, [vp, i64, u8p, i64, u8p, i64]),
+        "jy_uj_invalidate": (None, [vp, u8p, i64, u8p, i64, i32]),
+        "jy_uj_memo_len": (i64, [vp, u8p, i64]),
         # batch applier
         "jy_eng_scan_apply2": (
             i32,
@@ -640,14 +645,57 @@ class ServeEngine:
         self._lib.jy_eng_served(self._h, out.ctypes.data)
         return dict(zip(self.TYPE_ORDER, out.tolist()))
 
+    # ---- UJSON render memo -------------------------------------------------
+
+    @staticmethod
+    def _uj_path_blob(path_args) -> bytes:
+        """Path argument vector as the memo's length-prefixed blob key
+        (binary-safe, and component-prefix == byte-prefix — engine.h).
+        Components are CANONICALISED to the UTF-8 encoding of the
+        errors="replace" decode the oracle applies (repo_ujson
+        _decode_path): byte-distinct spellings that alias in the
+        document alias in the memo too, so invalidation through one
+        spelling can never leave another's render stale. The engine's
+        bank-time invalidation uses raw bytes, which equal this
+        canonical form exactly for valid UTF-8 — and it defers any
+        write whose path is not valid UTF-8 (engine.h utf8_valid)."""
+        return b"".join(
+            struct.pack("<I", len(c)) + c
+            for c in (
+                bytes(p).decode("utf-8", "replace").encode()
+                for p in path_args
+            )
+        )
+
+    def uj_memo_put(self, key: bytes, path_args, reply: bytes) -> None:
+        """Install the oracle-rendered GET reply for (key, path)."""
+        row = self._lib.jy_uj_upsert(self._h, key, len(key))
+        blob = self._uj_path_blob(path_args)
+        self._lib.jy_uj_memo_put(
+            self._h, row, blob, len(blob), reply, len(reply)
+        )
+
+    def uj_invalidate(self, key: bytes, path_args, subtree: bool) -> None:
+        """Drop the renders a write at path can change: INS/RM
+        (subtree=False) touch only renders at prefix paths; SET/CLR
+        (subtree=True) rewrite the subtree, so both prefix directions."""
+        blob = self._uj_path_blob(path_args)
+        self._lib.jy_uj_invalidate(
+            self._h, key, len(key), blob, len(blob), 1 if subtree else 0
+        )
+
+    def uj_memo_len(self, key: bytes) -> int:
+        return self._lib.jy_uj_memo_len(self._h, key, len(key))
+
     # ---- UJSON queue -------------------------------------------------------
 
     def uq_count(self) -> int:
         return self._lib.jy_uq_count(self._h)
 
     def uq_drain(self) -> list[list[bytes]]:
-        """Pop every banked UJSON INS as its raw argument list (without
-        the leading type word), in arrival order."""
+        """Pop every banked UJSON write (INS/SET/RM/CLR) as its raw
+        argument list (without the leading type word), in arrival
+        order."""
         nbytes = self._lib.jy_uq_bytes(self._h)
         if nbytes == 0:
             return []

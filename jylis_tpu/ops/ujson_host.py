@@ -141,11 +141,55 @@ class UJSON:
     flush window coalesce by join.
     """
 
-    __slots__ = ("entries", "ctx")
+    __slots__ = ("entries", "ctx", "_by_path", "_idx_of")
 
     def __init__(self):
         self.entries: dict[Dot, tuple[Path, str]] = {}
         self.ctx = CausalContext()
+        self._by_path: dict[Path, set[Dot]] | None = None
+        self._idx_of: dict | None = None
+
+    # -- per-path index over the dot-store ----------------------------------
+    #
+    # set_doc/rm/clr observe (then remove) the dots at or under a path;
+    # scanning every entry per write made write-hot documents quadratic —
+    # the measured floor of the all-commands serving mix (bench.py
+    # `concurrent`, where 95% of mix time was this scan). The index maps
+    # path -> dots, built lazily at the first observe and maintained by
+    # the internal mutators; it is keyed on the entries dict's IDENTITY,
+    # so consumers that install a fresh entries dict wholesale
+    # (LazyWireUJSON._materialize, test fixtures) invalidate it by
+    # construction. Code outside this class must never mutate an
+    # existing entries dict in place after the doc has served a write —
+    # decode paths populate entries only at construction, before any
+    # index exists.
+
+    def _index(self) -> dict[Path, set[Dot]]:
+        if getattr(self, "_idx_of", None) is not self.entries:
+            idx: dict[Path, set[Dot]] = {}
+            for d, (p, _) in self.entries.items():
+                s = idx.get(p)
+                if s is None:
+                    s = idx[p] = set()
+                s.add(d)
+            self._by_path = idx
+            self._idx_of = self.entries
+        return self._by_path
+
+    def _idx_add(self, dot: Dot, path: Path) -> None:
+        if getattr(self, "_idx_of", None) is self.entries:
+            s = self._by_path.get(path)
+            if s is None:
+                s = self._by_path[path] = set()
+            s.add(dot)
+
+    def _idx_drop(self, dot: Dot, path: Path) -> None:
+        if getattr(self, "_idx_of", None) is self.entries:
+            s = self._by_path.get(path)
+            if s is not None:
+                s.discard(dot)
+                if not s:
+                    del self._by_path[path]
 
     def __eq__(self, other) -> bool:
         """Representational equality (see CausalContext.__eq__): used by
@@ -162,9 +206,11 @@ class UJSON:
 
     def _under(self, path: Path) -> list[Dot]:
         n = len(path)
-        return [
-            d for d, (p, _) in self.entries.items() if p[:n] == path
-        ]
+        out: list[Dot] = []
+        for p, dots in self._index().items():
+            if p[:n] == path:
+                out.extend(dots)
+        return out
 
     def is_empty(self) -> bool:
         return not self.entries
@@ -209,17 +255,23 @@ class UJSON:
         removed value on every receiver that had not yet seen the add
         (same-window SET+RM over anti-entropy, journal replay)."""
         for d in dots:
-            self.entries.pop(d, None)
+            pv = self.entries.pop(d, None)
+            if pv is not None:
+                self._idx_drop(d, pv[0])
             self.ctx.add(d)
             if delta is not None:
-                delta.entries.pop(d, None)
+                dpv = delta.entries.pop(d, None)
+                if dpv is not None:
+                    delta._idx_drop(d, dpv[0])
                 delta.ctx.add(d)
 
     def _add_leaf(self, replica: int, path: Path, token: str, delta) -> None:
         dot = self.ctx.next_dot(replica)
         self.entries[dot] = (path, token)
+        self._idx_add(dot, path)
         if delta is not None:
             delta.entries[dot] = (path, token)
+            delta._idx_add(dot, path)
             delta.ctx.add(dot)
 
     def set_doc(self, replica: int, path: Path, doc: str, delta=None) -> None:
@@ -239,7 +291,9 @@ class UJSON:
         (ujson.md:91-103)."""
         token = parse_value(value)
         dots = [
-            d for d, (p, t) in self.entries.items() if p == path and t == token
+            d
+            for d in self._index().get(path, ())
+            if self.entries[d][1] == token
         ]
         self._remove_dots(dots, delta)
 
@@ -255,12 +309,14 @@ class UJSON:
         # entries present only here, observed (covered) by other -> removed
         for d in list(self.entries):
             if d not in other.entries and other.ctx.contains(d):
-                del self.entries[d]
+                pv = self.entries.pop(d)
+                self._idx_drop(d, pv[0])
                 changed = True
         # entries present only there, not covered by us -> added
         for d, pv in other.entries.items():
             if d not in self.entries and not self.ctx.contains(d):
                 self.entries[d] = pv
+                self._idx_add(d, pv[0])
                 changed = True
         before = (dict(self.ctx.vv), set(self.ctx.cloud))
         self.ctx.join(other.ctx)

@@ -59,6 +59,20 @@ def note_journal(counter: str, n: int = 1) -> None:
     journal_counters[counter] += n
 
 
+# serving-path split counters: connection demotions off the native engine
+# (server/server.py demote() — the whole connection moves to the Python
+# dispatch path for its remaining lifetime). Process-global like the
+# drain counters; the per-command native/demoted tallies live per
+# Database (engine served counts vs the managers' Python-path tally) and
+# merge with this in SYSTEM METRICS' SERVING lines, so fallback_frac is
+# observable live, not just in the bench record.
+serving_counters: dict[str, int] = {"demotions": 0}
+
+
+def note_serving(counter: str, n: int = 1) -> None:
+    serving_counters[counter] += n
+
+
 def note_drain(name: str, n_keys: int, seconds: float) -> None:
     c = counters[name]
     c["batches"] += 1
@@ -113,16 +127,29 @@ def _type_stats():
             yield name, int(c["batches"]), int(c["keys"]), c["seconds"] * 1e3
 
 
-def metric_lines(served: dict[str, int] | None = None) -> list[str]:
+def metric_lines(
+    served: dict[str, int] | None = None,
+    serving: dict[str, int] | None = None,
+) -> list[str]:
     """Flat `type counter value` lines — the SYSTEM METRICS reply body.
     ``served`` is the serving node's per-type commands-served totals
     (Database merges its Python-path tally with its engine's native
     counters and wires the result through RepoSYSTEM — per instance,
     unlike the process-global drain counters, so test/bench Databases
-    in one process cannot cross-talk)."""
+    in one process cannot cross-talk). ``serving`` is the native-vs-
+    demoted split (native_cmds / demoted_cmds / demotions), emitted with
+    the live fallback_frac so the bench record's headline condition is
+    checkable on a running node."""
     lines = [
         f"{name} cmds {n}" for name, n in sorted((served or {}).items()) if n
     ]
+    if serving and any(serving.values()):
+        for k in ("native_cmds", "demoted_cmds", "demotions"):
+            lines.append(f"SERVING {k} {serving.get(k, 0)}")
+        total = serving.get("native_cmds", 0) + serving.get("demoted_cmds", 0)
+        if total:
+            frac = serving.get("demoted_cmds", 0) / total
+            lines.append(f"SERVING fallback_frac {frac:.4f}")
     for name, drains, keys, ms in _type_stats():
         lines.append(f"{name} drains {drains}")
         lines.append(f"{name} keys {keys}")

@@ -657,14 +657,302 @@ struct TlogTable {
     }
 };
 
+// ---- UJSON serving memo ----------------------------------------------------
+//
+// The ORSWOT document lattice stays in Python (host docs) or on the
+// device (resident rows) — the engine never owns it. What it owns is the
+// RENDER memo: per key, the exact reply bytes the Python oracle produced
+// for `UJSON GET key [path...]`, keyed by the path argument vector. The
+// Python GET path installs an entry after serving (repo_ujson.py), and
+// every write invalidates the overlapping entries — natively at
+// queue-bank time, from Python on converge/apply. This mirrors the TLOG
+// merged-view memo contract: the memo is only ever a cache of what the
+// oracle already rendered, a miss defers to Python (which repairs the
+// memo while serving), and staleness is impossible because invalidation
+// happens under the same repo-lock boundary as the write itself.
+//
+// Path keys are length-prefixed blobs (u32 len + bytes per component),
+// which makes component-prefix exactly byte-prefix — so the precise
+// invalidation rules are cheap:
+//   * INS/RM at path p change only renders at paths q ⊆ p (q a prefix
+//     of p): deeper disjoint subtrees keep serving natively;
+//   * SET/CLR at p rewrite the subtree: q ⊆ p or p ⊆ q invalidates.
+
+struct UjsonTable {
+    KeyIndex idx;
+    // row -> path-blob -> full reply payload ($len\r\nrender\r\n)
+    std::vector<std::unordered_map<std::string, std::string>> memo;
+
+    // renders cached per key; above this the row's map resets (GET paths
+    // per key are few in practice — the cap only bounds pathology)
+    static constexpr size_t MEMO_PER_KEY = 8;
+
+    int64_t upsert(const uint8_t* k, int64_t n) {
+        auto [row, fresh] = idx.upsert(k, n);
+        if (fresh) memo.emplace_back();
+        return row;
+    }
+
+    void put(int64_t row, std::string path, std::string reply) {
+        auto& m = memo[row];
+        if (m.size() >= MEMO_PER_KEY && m.find(path) == m.end()) m.clear();
+        m[std::move(path)] = std::move(reply);
+    }
+
+    const std::string* get(int64_t row, const std::string& path) const {
+        const auto& m = memo[row];
+        auto it = m.find(path);
+        return it == m.end() ? nullptr : &it->second;
+    }
+
+    static bool is_prefix(const std::string& a, const std::string& b) {
+        return a.size() <= b.size() &&
+               memcmp(a.data(), b.data(), a.size()) == 0;
+    }
+
+    // invalidate the renders a write at `path` can change; subtree=true
+    // for SET/CLR (both prefix directions), false for INS/RM
+    void invalidate(int64_t row, const std::string& path, bool subtree) {
+        auto& m = memo[row];
+        for (auto it = m.begin(); it != m.end();) {
+            bool hit = is_prefix(it->first, path) ||
+                       (subtree && is_prefix(path, it->first));
+            it = hit ? m.erase(it) : std::next(it);
+        }
+    }
+};
+
+// ---- UJSON value validators ------------------------------------------------
+//
+// A natively banked write replies +OK immediately, so the one thing the
+// engine must prove is that the oracle's later apply CANNOT raise — i.e.
+// the value arg parses as Python's json.loads would parse it
+// (ops/ujson_host.py parse_value/parse_doc; the token actually stored is
+// the oracle's own canonical dumps, so no round-trip identity is needed
+// for equivalence). These validators accept exactly Python's strict JSON
+// grammar: escape-bearing and \uXXXX strings, raw UTF-8 (the oracle
+// decodes argument bytes with errors="replace", so any byte >= 0x20 is
+// parseable), full int/frac/exp numbers, and the NaN/Infinity literals
+// json.loads allows by default. Raw control bytes inside strings, bad
+// escapes, leading zeros, lone '-', and trailing garbage all bounce.
+
+inline bool json_ws(uint8_t c) {
+    return c == ' ' || c == '\t' || c == '\n' || c == '\r';
+}
+
+// returns index past the closing quote, or -1
+inline int64_t scan_json_string(const uint8_t* p, int64_t n, int64_t i) {
+    i++;  // opening quote
+    while (i < n) {
+        uint8_t c = p[i];
+        if (c == '"') return i + 1;
+        if (c < 0x20) return -1;  // strict mode rejects raw controls
+        if (c == '\\') {
+            if (i + 1 >= n) return -1;
+            uint8_t e = p[i + 1];
+            if (e == 'u') {
+                if (i + 5 >= n) return -1;
+                for (int64_t j = i + 2; j < i + 6; j++) {
+                    uint8_t h = p[j];
+                    if (!((h >= '0' && h <= '9') || (h >= 'a' && h <= 'f') ||
+                          (h >= 'A' && h <= 'F')))
+                        return -1;
+                }
+                i += 6;
+                continue;
+            }
+            if (e != '"' && e != '\\' && e != '/' && e != 'b' && e != 'f' &&
+                e != 'n' && e != 'r' && e != 't')
+                return -1;
+            i += 2;
+            continue;
+        }
+        i++;  // any other byte incl. raw UTF-8 (replace-decoded oracle-side)
+    }
+    return -1;
+}
+
+// Python json's number regex: -?(0|[1-9]\d*)(\.\d+)?([eE][+-]?\d+)?
+// int() refuses digit strings past sys.int_max_str_digits (4300 by
+// default), so an INTEGER token that long makes json.loads raise — stay
+// comfortably below so a banked +OK can never turn into a late crash at
+// queue-flush time (floats parse via float(), which has no such limit)
+constexpr int64_t JSON_INT_DIGITS_MAX = 4000;
+
+inline int64_t scan_json_number(const uint8_t* p, int64_t n, int64_t i) {
+    if (i < n && p[i] == '-') i++;
+    if (i >= n) return -1;
+    int64_t int_start = i;
+    if (p[i] == '0') {
+        i++;
+    } else if (p[i] >= '1' && p[i] <= '9') {
+        while (i < n && p[i] >= '0' && p[i] <= '9') i++;
+    } else {
+        return -1;
+    }
+    if ((i >= n || (p[i] != '.' && p[i] != 'e' && p[i] != 'E')) &&
+        i - int_start > JSON_INT_DIGITS_MAX)
+        return -1;  // integer token: Python's int() conversion would raise
+    if (i < n && p[i] == '.') {
+        i++;
+        if (i >= n || p[i] < '0' || p[i] > '9') return -1;
+        while (i < n && p[i] >= '0' && p[i] <= '9') i++;
+    }
+    if (i < n && (p[i] == 'e' || p[i] == 'E')) {
+        i++;
+        if (i < n && (p[i] == '+' || p[i] == '-')) i++;
+        if (i >= n || p[i] < '0' || p[i] > '9') return -1;
+        while (i < n && p[i] >= '0' && p[i] <= '9') i++;
+    }
+    return i;
+}
+
+inline bool word_at(const uint8_t* p, int64_t n, int64_t i, const char* w) {
+    int64_t wn = static_cast<int64_t>(strlen(w));
+    return i + wn <= n && memcmp(p + i, w, static_cast<size_t>(wn)) == 0;
+}
+
+// literal constants json.loads accepts (allow_nan default); returns end
+// index or -1
+inline int64_t scan_json_literal(const uint8_t* p, int64_t n, int64_t i) {
+    for (const char* w : {"true", "false", "null", "NaN", "Infinity",
+                          "-Infinity"})
+        if (word_at(p, n, i, w)) return i + static_cast<int64_t>(strlen(w));
+    return -1;
+}
+
+// full JSON value (objects/arrays too), depth-capped so a pathologically
+// nested doc defers to Python instead of recursing here; returns end or -1
+inline int64_t scan_json_value(const uint8_t* p, int64_t n, int64_t i,
+                               int depth) {
+    if (depth <= 0) return -1;
+    while (i < n && json_ws(p[i])) i++;
+    if (i >= n) return -1;
+    uint8_t c = p[i];
+    if (c == '"') return scan_json_string(p, n, i);
+    if (c == '{') {
+        i++;
+        while (i < n && json_ws(p[i])) i++;
+        if (i < n && p[i] == '}') return i + 1;
+        while (true) {
+            while (i < n && json_ws(p[i])) i++;
+            if (i >= n || p[i] != '"') return -1;
+            i = scan_json_string(p, n, i);
+            if (i < 0) return -1;
+            while (i < n && json_ws(p[i])) i++;
+            if (i >= n || p[i] != ':') return -1;
+            i = scan_json_value(p, n, i + 1, depth - 1);
+            if (i < 0) return -1;
+            while (i < n && json_ws(p[i])) i++;
+            if (i < n && p[i] == ',') {
+                i++;
+                continue;
+            }
+            if (i < n && p[i] == '}') return i + 1;
+            return -1;
+        }
+    }
+    if (c == '[') {
+        i++;
+        while (i < n && json_ws(p[i])) i++;
+        if (i < n && p[i] == ']') return i + 1;
+        while (true) {
+            i = scan_json_value(p, n, i, depth - 1);
+            if (i < 0) return -1;
+            while (i < n && json_ws(p[i])) i++;
+            if (i < n && p[i] == ',') {
+                i++;
+                continue;
+            }
+            if (i < n && p[i] == ']') return i + 1;
+            return -1;
+        }
+    }
+    {
+        int64_t e = scan_json_literal(p, n, i);
+        if (e >= 0) return e;
+    }
+    if (c == '-' || (c >= '0' && c <= '9')) return scan_json_number(p, n, i);
+    return -1;
+}
+
+// strict UTF-8 validity (no overlongs, no surrogates, max U+10FFFF).
+// The render memo is keyed on CANONICAL path bytes — the UTF-8 encoding
+// of the errors="replace" decode the oracle applies — and valid UTF-8
+// is exactly the class where raw bytes == canonical bytes. Writes whose
+// path components fail this defer to Python, whose invalidation
+// canonicalises (native/engine.py uj_invalidate), so byte-distinct
+// paths that decode identically can never leave a stale memo behind.
+inline bool utf8_valid(const uint8_t* p, int64_t n) {
+    int64_t i = 0;
+    while (i < n) {
+        uint8_t c = p[i];
+        if (c < 0x80) {
+            i++;
+            continue;
+        }
+        int len;
+        uint32_t cp;
+        if ((c & 0xE0) == 0xC0) {
+            len = 2;
+            cp = c & 0x1F;
+        } else if ((c & 0xF0) == 0xE0) {
+            len = 3;
+            cp = c & 0x0F;
+        } else if ((c & 0xF8) == 0xF0) {
+            len = 4;
+            cp = c & 0x07;
+        } else {
+            return false;
+        }
+        if (i + len > n) return false;
+        for (int j = 1; j < len; j++) {
+            if ((p[i + j] & 0xC0) != 0x80) return false;
+            cp = (cp << 6) | (p[i + j] & 0x3F);
+        }
+        if (len == 2 && cp < 0x80) return false;          // overlong
+        if (len == 3 && cp < 0x800) return false;         // overlong
+        if (len == 4 && cp < 0x10000) return false;       // overlong
+        if (cp >= 0xD800 && cp <= 0xDFFF) return false;   // surrogate
+        if (cp > 0x10FFFF) return false;
+        i += len;
+    }
+    return true;
+}
+
+// INS/RM value: a JSON *primitive* (parse_value raises on containers)
+inline bool ujson_prim_ok(const uint8_t* p, int64_t n) {
+    int64_t i = 0;
+    while (i < n && json_ws(p[i])) i++;
+    if (i >= n) return false;
+    int64_t e;
+    if (p[i] == '"') {
+        e = scan_json_string(p, n, i);
+    } else if ((e = scan_json_literal(p, n, i)) < 0) {
+        e = scan_json_number(p, n, i);
+    }
+    if (e < 0) return false;
+    while (e < n && json_ws(p[e])) e++;
+    return e == n;
+}
+
+// SET value: any JSON document (parse_doc takes containers too)
+inline bool ujson_doc_ok(const uint8_t* p, int64_t n) {
+    int64_t e = scan_json_value(p, n, 0, 64);
+    if (e < 0) return false;
+    while (e < n && json_ws(p[e])) e++;
+    return e == n;
+}
+
 // ---- UJSON write queue -----------------------------------------------------
 //
-// UJSON INS is a pure ORSWOT add (repo_ujson.pony:96-110): the engine
-// validates the value token against the classes whose Python
-// parse_value round-trip is the identity, banks the raw argument slices,
-// and replies +OK; Python drains the queue (in arrival order) before any
-// other UJSON work, so per-connection ordering and the delta/lattice
-// semantics are exactly the oracle's.
+// UJSON INS/SET/RM/CLR are applied by the ORACLE at queue-flush time
+// (repo_ujson.py _flush_queue, which runs before any other UJSON work in
+// arrival order — per-connection ordering and the observe-first
+// delta/lattice semantics are exactly the reference's). The engine's job
+// is validate-and-bank: prove the later apply cannot raise (the value
+// validators above), record the raw argument slices, invalidate the
+// overlapping render memos, and reply +OK.
 
 struct UjsonQueue {
     // blob layout per command: u32 argc, then per arg u32 len + bytes
@@ -705,6 +993,7 @@ struct Engine {
     TregTable treg;
     TlogTable tlog;
     UjsonQueue uq;
+    UjsonTable uj;
     // commands settled natively, per type (G, PN, TREG, TLOG, UJSON) —
     // reads included; deferred commands count on the Python side instead
     // (models/manager.py _apply_core's per-Database tally). SYSTEM

@@ -2,8 +2,9 @@
 //
 // Extends the counter engine (counter_engine.cpp) to the full command
 // mix the reference serves from compiled actors on every core
-// (jylis/server_notify.pony:8-36): TREG SET/GET, TLOG INS/SIZE/GET/CUTOFF
-// and the UJSON INS write queue settle here, so a pipelined burst of
+// (jylis/server_notify.pony:8-36): TREG SET/GET, TLOG INS/SIZE/GET/CUTOFF,
+// UJSON GET (from the per-key render memo) and the validated UJSON
+// INS/SET/RM/CLR write queue settle here, so a pipelined burst of
 // mixed traffic makes ONE FFI call instead of one interpreter dispatch
 // per command. TLOG TRIM/TRIMAT/CLR stay with Python: they dispatch a
 // device drain. Table semantics live in engine.h; models/treg_table.py
@@ -15,33 +16,6 @@
 using namespace jy;
 
 namespace {
-
-// UJSON INS value classes whose Python parse_value round-trip is the
-// identity (ops/ujson_host.py:120-126): canonical integers, the three
-// literals, and strings of plain printable ASCII with no escapes.
-// json.loads tolerates surrounding whitespace and non-canonical number
-// spellings — those (and floats, whose dumps normalisation is Python's)
-// bounce to the oracle.
-bool ujson_token_ok(const uint8_t* p, int64_t n) {
-    if (n <= 0) return false;
-    if (word_is(p, 0, n, "true") || word_is(p, 0, n, "false") ||
-        word_is(p, 0, n, "null"))
-        return true;
-    if (p[0] == '"') {
-        if (n < 2 || p[n - 1] != '"') return false;
-        for (int64_t i = 1; i < n - 1; i++)
-            if (p[i] < 0x20 || p[i] > 0x7E || p[i] == '"' || p[i] == '\\')
-                return false;
-        return true;
-    }
-    int64_t i = 0;
-    if (p[0] == '-') i = 1;
-    if (i >= n) return false;
-    if (p[i] == '0') return n == i + 1;  // lone 0 / -0; no leading zeros
-    for (; i < n; i++)
-        if (p[i] < '0' || p[i] > '9') return false;
-    return true;
-}
 
 // pending-rows thresholds past which writes bounce so the Python repo
 // runs its device drain (must match repo_treg.py PENDING_DRAIN_THRESHOLD
@@ -574,6 +548,38 @@ int64_t jy_uq_data(void* e, uint8_t* out, int64_t cap) {
 
 void jy_uq_clear(void* e) { static_cast<Engine*>(e)->uq.clear(); }
 
+// ---- UJSON render memo (engine.h UjsonTable) -------------------------------
+
+int64_t jy_uj_upsert(void* e, const uint8_t* k, int64_t n) {
+    return static_cast<Engine*>(e)->uj.upsert(k, n);
+}
+
+void jy_uj_memo_put(void* e, int64_t row, const uint8_t* path, int64_t pn,
+                    const uint8_t* reply, int64_t rn) {
+    static_cast<Engine*>(e)->uj.put(
+        row, std::string(reinterpret_cast<const char*>(path),
+                         static_cast<size_t>(pn)),
+        std::string(reinterpret_cast<const char*>(reply),
+                    static_cast<size_t>(rn)));
+}
+
+void jy_uj_invalidate(void* e, const uint8_t* k, int64_t n,
+                      const uint8_t* path, int64_t pn, int32_t subtree) {
+    UjsonTable& u = static_cast<Engine*>(e)->uj;
+    int64_t row = u.idx.find(k, n);
+    if (row >= 0)
+        u.invalidate(row,
+                     std::string(reinterpret_cast<const char*>(path),
+                                 static_cast<size_t>(pn)),
+                     subtree != 0);
+}
+
+int64_t jy_uj_memo_len(void* e, const uint8_t* k, int64_t n) {
+    UjsonTable& u = static_cast<Engine*>(e)->uj;
+    int64_t row = u.idx.find(k, n);
+    return row < 0 ? 0 : static_cast<int64_t>(u.memo[row].size());
+}
+
 // ---- the batch applier -----------------------------------------------------
 //
 // Returns:
@@ -825,11 +831,66 @@ int32_t jy_eng_scan_apply2(void* ev, const uint8_t* buf, int64_t len,
 
         // ---- UJSON --------------------------------------------------------
         if (argc >= 1 && word_is(buf, offs[0], lens[0], "UJSON")) {
-            // INS key [path...] value with a value token whose Python
-            // parse is guaranteed to succeed and round-trip: bank it
-            if (argc >= 4 && word_is(buf, offs[1], lens[1], "INS") &&
-                !eng->uq.full() &&
-                ujson_token_ok(buf + offs[argc - 1], lens[argc - 1])) {
+            UjsonTable& u = eng->uj;
+            // path args [lo, hi) as the memo's length-prefixed blob key
+            auto path_blob = [&](int32_t lo, int32_t hi) {
+                std::string b;
+                for (int32_t i = lo; i < hi; i++) {
+                    uint32_t ln = static_cast<uint32_t>(lens[i]);
+                    b.append(reinterpret_cast<const char*>(&ln), 4);
+                    b.append(reinterpret_cast<const char*>(buf + offs[i]),
+                             static_cast<size_t>(lens[i]));
+                }
+                return b;
+            };
+            // GET key [path...]: the oracle-rendered reply, memoised per
+            // (key, path) and invalidated by every overlapping write — a
+            // miss (or a never-rendered key) defers, and the Python GET
+            // repairs the memo while serving (the TLOG base-repair shape)
+            if (argc >= 3 && word_is(buf, offs[1], lens[1], "GET")) {
+                int64_t row = u.idx.find(buf + offs[2], lens[2]);
+                const std::string* reply =
+                    row < 0 ? nullptr : u.get(row, path_blob(3, argc));
+                if (reply == nullptr) return defer();
+                int64_t need = static_cast<int64_t>(reply->size());
+                if (out_cap - *out_len < need) {
+                    if (*out_len > 0) return 2;  // flush replies, re-enter
+                    return defer();  // reply alone outgrows the buffer
+                }
+                memcpy(out + *out_len, reply->data(), reply->size());
+                *out_len += need;
+                eng->served[4]++;
+                *consumed += sub_consumed;
+                continue;
+            }
+            // INS/SET/RM/CLR key [path...] [value]: validate that the
+            // oracle's apply cannot raise, invalidate the overlapping
+            // render memos, bank the raw slices, reply +OK (the oracle
+            // applies the queue, in arrival order, before any other
+            // UJSON work — repo_ujson.py _flush_queue)
+            bool is_ins = argc >= 4 && word_is(buf, offs[1], lens[1], "INS");
+            bool is_set = argc >= 4 && word_is(buf, offs[1], lens[1], "SET");
+            bool is_rm = argc >= 4 && word_is(buf, offs[1], lens[1], "RM");
+            bool is_clr = argc >= 3 && word_is(buf, offs[1], lens[1], "CLR");
+            bool ok = is_clr;
+            if (is_ins || is_rm)
+                ok = ujson_prim_ok(buf + offs[argc - 1], lens[argc - 1]);
+            else if (is_set)
+                ok = ujson_doc_ok(buf + offs[argc - 1], lens[argc - 1]);
+            // path components must be valid UTF-8 so the raw bytes ARE
+            // the memo's canonical key (engine.h utf8_valid) — an
+            // invalid component defers to Python, whose invalidation
+            // canonicalises the path the same way the oracle decodes it
+            if (ok) {
+                int32_t path_end = is_clr ? argc : argc - 1;
+                for (int32_t i = 3; ok && i < path_end; i++)
+                    ok = utf8_valid(buf + offs[i], lens[i]);
+            }
+            if (ok && !eng->uq.full()) {
+                int64_t row = u.idx.find(buf + offs[2], lens[2]);
+                if (row >= 0)
+                    u.invalidate(row, path_blob(3, is_clr ? argc : argc - 1),
+                                 is_set || is_clr);
                 eng->uq.push(buf, offs + 1, lens + 1, argc - 1);
                 changed[4]++;
                 eng->served[4]++;

@@ -30,6 +30,22 @@ def fmt_percent(v: float) -> str:
     return f"{v * 100:.0f}%"
 
 
+def fmt_frac(v: float) -> str:
+    return f"{v:.4f}"
+
+
+def fmt_us(v: float) -> str:
+    return f"{v:.0f} µs"
+
+
+def fmt_ms(v: float) -> str:
+    return f"{v / 1e3:.1f} ms"
+
+
+def fmt_thousands(v: float) -> str:
+    return f"{v / 1e3:.0f}k"
+
+
 # (file, config, record field, formatter, anchor template, human label):
 # the formatted token substitutes into the template, and THAT phrase must
 # appear verbatim in its file. Templates anchor each claim to its own
@@ -56,10 +72,24 @@ CLAIMS = [
      "`engine_only` = {}", "gcount-smoke engine-only rate"),
     ("README.md", "gcount-smoke", "socket_cost_frac", fmt_percent,
      "`socket_cost_frac` = {}", "gcount-smoke socket cost"),
-    ("README.md", "concurrent", "value", fmt_millions,
+    ("README.md", "concurrent", "value", fmt_thousands,
      "**{} commands/sec**", "concurrent commands/sec"),
     ("README.md", "concurrent", "vs_baseline", fmt_ratio,
      "recorded, {} the bare", "concurrent ratio"),
+    ("README.md", "concurrent", "fallback_frac", fmt_frac,
+     "`fallback_frac` = {}", "concurrent fallback fraction"),
+    ("README.md", "serving-demotion", "vs_baseline", fmt_ratio,
+     "demotion cliff of **{}**", "demotion cliff ratio"),
+    ("README.md", "serving-latency", "p99_us_treg_get_64", fmt_ms,
+     "p99 {} at 64", "latency p99 TREG GET 64 conns"),
+    ("docs/operations.md", "serving-demotion", "vs_baseline", fmt_ratio,
+     "measured cliff of {}", "operations doc demotion cliff"),
+    ("docs/operations.md", "serving-latency", "p99_us_treg_get_64", fmt_ms,
+     "costs {} at p99", "operations doc latency p99 (64 conns)"),
+    ("docs/operations.md", "serving-latency", "p99_us_treg_get_1", fmt_us,
+     "vs {} at one connection", "operations doc latency p99 (1 conn)"),
+    ("docs/types/ujson.md", "serving-demotion", "vs_baseline", fmt_ratio,
+     "demotion cliff of {} in", "ujson doc demotion cliff"),
     # type docs that cite BENCH_full.json by name carry the same duty
     ("docs/types/pncount.md", "north-star", "value", fmt_millions,
      "{} key-merges/sec recorded", "pncount doc merges/sec"),

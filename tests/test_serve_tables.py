@@ -412,30 +412,42 @@ def test_scan_apply_tlog_get_big_reply_flushes_then_defers():
     assert replies == b"" and consumed == len(b"TLOG GET k\r\n")
 
 
-# ---- UJSON queue -----------------------------------------------------------
+# ---- UJSON queue + render memo ---------------------------------------------
 
 
 def test_ujson_queue_flush_order_and_replies():
     eng = make_engine()
     native = RepoUJSON(identity=1, engine=eng)
     oracle = RepoUJSON(identity=1)
-    # bank INSes through the engine exactly as the server would
+    # bank the full write surface through the engine exactly as the
+    # server would: INS (escapes, UTF-8 \u, floats included), SET (full
+    # JSON documents), RM and CLR
     wire = bytearray(
         b'UJSON INS u roles "admin"\r\n'
         b"UJSON INS u nums 3\r\n"
-        b"UJSON INS u nums -17\r\n"
+        b"UJSON INS u nums 1.5\r\n"
+        b'UJSON INS u note "a\\nb"\r\n'
+        b'UJSON INS u note "caf\\u00e9"\r\n'
         b"UJSON INS u deep er tags true\r\n"
+        b'UJSON SET u cfg {"mode":"fast","n":[1,2]}\r\n'
+        b'UJSON RM u nums 1.5\r\n'
+        b"UJSON CLR u deep\r\n"
     )
     rc, consumed, replies, unhandled, changed = eng.scan_apply(wire)
     assert rc == 0 and consumed == len(wire)
-    assert replies == b"+OK\r\n" * 4
-    assert changed == (0, 0, 0, 0, 4)
-    assert eng.uq_count() == 4
+    assert replies == b"+OK\r\n" * 9
+    assert changed == (0, 0, 0, 0, 9)
+    assert eng.uq_count() == 9
     for args in (
         [b"INS", b"u", b"roles", b'"admin"'],
         [b"INS", b"u", b"nums", b"3"],
-        [b"INS", b"u", b"nums", b"-17"],
+        [b"INS", b"u", b"nums", b"1.5"],
+        [b"INS", b"u", b"note", b'"a\\nb"'],
+        [b"INS", b"u", b"note", b'"caf\\u00e9"'],
         [b"INS", b"u", b"deep", b"er", b"tags", b"true"],
+        [b"SET", b"u", b"cfg", b'{"mode":"fast","n":[1,2]}'],
+        [b"RM", b"u", b"nums", b"1.5"],
+        [b"CLR", b"u", b"deep"],
     ):
         oracle.apply(R(), args)
     # any read path flushes the queue first
@@ -447,21 +459,243 @@ def test_ujson_queue_flush_order_and_replies():
     assert native.flush_deltas() == oracle.flush_deltas()
 
 
+def _resp_array(parts: list[bytes]) -> bytearray:
+    return bytearray(
+        b"*%d\r\n" % len(parts)
+        + b"".join(b"$%d\r\n%s\r\n" % (len(p), p) for p in parts)
+    )
+
+
 def test_ujson_engine_bounces_unsafe_values():
-    """Tokens whose parse_value round-trip is not the identity (floats,
-    escapes, whitespace, leading zeros) must bounce to Python."""
+    """Values whose Python parse can fail must bounce (containers for
+    INS/RM, malformed JSON, raw control bytes, leading zeros) — the +OK
+    a banked command already shipped could otherwise be a lie. Classes
+    that round 5 bounced but Python parses fine (floats, escapes, \\u,
+    raw UTF-8, surrounding whitespace) now settle natively."""
     eng = make_engine()
-    for bad in (b"1.5", b'"a\\nb"', b" 5", b"05", b"{}", b"[1]", b"nan", b""):
+    for bad in (
+        b"{}", b"[1]", b"nan", b"", b'"a', b'"a\nb"', b"05", b"1.",
+        b"+5", b'"bad\\x"', b"--5", b"1.5.5", b"tru",
+    ):
         # RESP array framing: exact tokens (inline would split/eat spaces)
         parts = [b"UJSON", b"INS", b"u", b"p", bad]
-        wire = bytearray(
-            b"*%d\r\n" % len(parts)
-            + b"".join(b"$%d\r\n%s\r\n" % (len(p), p) for p in parts)
-        )
+        wire = _resp_array(parts)
         rc, _consumed, replies, unhandled, _ch = eng.scan_apply(wire)
         assert rc == 1 and replies == b"", bad
         assert unhandled[0] == b"UJSON"
     assert eng.uq_count() == 0
+    # SET takes containers — but still bounces malformed ones
+    good = 0
+    for doc, ok in (
+        (b"{}", True), (b'{"a":[1,{"b":null}]}', True), (b"[1,2]", True),
+        (b'{"a":}', False), (b"[1,", False), (b'{"a" 1}', False),
+    ):
+        parts = [b"UJSON", b"SET", b"u", b"p", doc]
+        wire = _resp_array(parts)
+        rc, _c, replies, _u, _ch = eng.scan_apply(wire)
+        if ok:
+            good += 1
+            assert rc == 0 and replies == b"+OK\r\n", doc
+        else:
+            assert rc == 1 and replies == b"", doc
+    assert eng.uq_count() == good
+
+
+def test_ujson_engine_bounces_huge_ints_and_bad_utf8_paths():
+    """Two +OK-could-be-a-lie edges: an integer token past Python's
+    int() digit limit makes json.loads raise at flush time, and a write
+    whose path is not valid UTF-8 aliases (via errors='replace') with a
+    byte-distinct memoised path — both must defer to Python, which
+    renders the help / canonicalises the invalidation."""
+    eng = make_engine()
+    native = RepoUJSON(identity=1, engine=eng)
+    big = b"1" * 5000
+    rc, _, replies, unh, _ = eng.scan_apply(
+        _resp_array([b"UJSON", b"INS", b"u", b"p", big])
+    )
+    assert rc == 1 and replies == b""  # bounced: the apply would raise
+    # both stacks turn the oversized int into ParseError (-> help reply
+    # via the manager), not an unhandled crash mid-flush
+    from jylis_tpu.models.base import ParseError
+
+    oracle = RepoUJSON(identity=1)
+    for repo in (native, oracle):
+        with pytest.raises(ParseError):
+            repo.apply(R(), [b"INS", b"u", b"p", big])
+    # a float with as many digits parses fine (no int() limit): banks
+    rc, _, replies, _, _ = eng.scan_apply(
+        _resp_array([b"UJSON", b"INS", b"u", b"p", b"1." + b"1" * 5000])
+    )
+    assert rc == 0 and replies == b"+OK\r\n"
+    # invalid-UTF-8 path component: b"\xff" decodes to U+FFFD, the SAME
+    # doc path as the valid encoding b"\xef\xbf\xbd" — the engine must
+    # not bank it (its raw-byte invalidation would miss the memo key)
+    native.apply(R(), [b"INS", b"u2", b"\xef\xbf\xbd", b"1"])
+    before = _oracle_reply(native, [b"GET", b"u2", b"\xef\xbf\xbd"])
+    rc, _, replies, unh, _ = eng.scan_apply(
+        _resp_array([b"UJSON", b"INS", b"u2", b"\xff", b"2"])
+    )
+    assert rc == 1 and replies == b""  # bank refused: path not UTF-8
+    native.apply(R(), unh[1:])  # the deferred apply canonicalises
+    after = _oracle_reply(native, [b"GET", b"u2", b"\xef\xbf\xbd"])
+    assert after != before
+    rc, _, replies, _, _ = eng.scan_apply(
+        bytearray(b"UJSON GET u2 \xef\xbf\xbd\r\n")
+    )
+    assert rc == 0 and replies == after  # fresh render, not a stale memo
+
+
+def test_ujson_native_get_serves_memo_and_invalidates_precisely():
+    """UJSON GET settles natively from the render memo the Python GET
+    installed, byte-identically; a write invalidates exactly the
+    overlapping paths (INS/RM by prefix, SET/CLR by subtree), so reads
+    of disjoint subtrees keep settling across writes."""
+    eng = make_engine()
+    native = RepoUJSON(identity=1, engine=eng)
+    for args in (
+        [b"INS", b"u", b"profile", b'"p1"'],
+        [b"INS", b"u", b"tags", b"1"],
+    ):
+        native.apply(R(), args)
+    # never rendered: the native GET defers
+    rc, _, replies, unhandled, _ = eng.scan_apply(bytearray(b"UJSON GET u profile\r\n"))
+    assert rc == 1 and unhandled == [b"UJSON", b"GET", b"u", b"profile"]
+    # Python renders (and repairs the memo)...
+    want = _oracle_reply(native, [b"GET", b"u", b"profile"])
+    want_root = _oracle_reply(native, [b"GET", b"u"])
+    # ...and the same GETs now settle natively on those exact bytes
+    rc, _, replies, _, _ = eng.scan_apply(
+        bytearray(b"UJSON GET u profile\r\nUJSON GET u\r\n")
+    )
+    assert rc == 0 and replies == want + want_root
+    served = eng.served_counts()["UJSON"]
+    # a write at a DISJOINT path keeps the profile memo (still native)
+    # but drops the root render (() is a prefix of every write path)
+    rc, _, replies, unhandled, _ = eng.scan_apply(
+        bytearray(b"UJSON INS u tags 2\r\nUJSON GET u profile\r\n")
+    )
+    assert rc == 0 and replies == b"+OK\r\n" + want
+    rc, _, _, unhandled, _ = eng.scan_apply(bytearray(b"UJSON GET u\r\n"))
+    assert rc == 1 and unhandled == [b"UJSON", b"GET", b"u"]
+    # a write AT the memoised path invalidates it
+    rc, _, replies, unhandled, _ = eng.scan_apply(
+        bytearray(b'UJSON RM u profile "p1"\r\nUJSON GET u profile\r\n')
+    )
+    assert rc == 1 and replies == b"+OK\r\n"
+    assert unhandled == [b"UJSON", b"GET", b"u", b"profile"]
+    # the Python path re-serves it correctly (queue flushed first: the
+    # banked INS+RM are visible) and repairs the memo again
+    after = _oracle_reply(native, [b"GET", b"u", b"profile"])
+    assert after == b"$0\r\n\r\n"  # p1 removed
+    rc, _, replies, _, _ = eng.scan_apply(bytearray(b"UJSON GET u profile\r\n"))
+    assert rc == 0 and replies == after
+    assert eng.served_counts()["UJSON"] > served
+    # absent keys defer and NEVER memoise (a read-only scan over
+    # missing keys must not grow engine rows without bound)
+    rc, _, _, unhandled, _ = eng.scan_apply(bytearray(b"UJSON GET nope\r\n"))
+    assert rc == 1 and unhandled == [b"UJSON", b"GET", b"nope"]
+    assert _oracle_reply(native, [b"GET", b"nope"]) == b"$0\r\n\r\n"
+    rc, _, _, unhandled, _ = eng.scan_apply(bytearray(b"UJSON GET nope\r\n"))
+    assert rc == 1 and unhandled == [b"UJSON", b"GET", b"nope"]
+    assert eng.uj_memo_len(b"nope") == 0
+
+
+def test_ujson_memo_invalidated_by_cluster_converge():
+    """A remote delta can change any subtree: converge drops every
+    render memo for the key, and the next GET re-renders through Python
+    (the TLOG base-repair shape)."""
+    from jylis_tpu.ops.ujson_host import UJSON
+
+    eng = make_engine()
+    native = RepoUJSON(identity=1, engine=eng)
+    native.apply(R(), [b"INS", b"u", b"tags", b"1"])
+    before = _oracle_reply(native, [b"GET", b"u", b"tags"])
+    rc, _, replies, _, _ = eng.scan_apply(bytearray(b"UJSON GET u tags\r\n"))
+    assert rc == 0 and replies == before
+    remote = UJSON()
+    d = UJSON()
+    remote.ins(7, ("tags",), "2", delta=d)
+    native.converge(b"u", d)
+    rc, _, _, unhandled, _ = eng.scan_apply(bytearray(b"UJSON GET u tags\r\n"))
+    assert rc == 1 and unhandled == [b"UJSON", b"GET", b"u", b"tags"]
+    after = _oracle_reply(native, [b"GET", b"u", b"tags"])
+    assert after != before
+    rc, _, replies, _, _ = eng.scan_apply(bytearray(b"UJSON GET u tags\r\n"))
+    assert rc == 0 and replies == after
+
+
+def _native_serve(native, eng, args) -> bytes:
+    """Apply one UJSON command exactly as the server would: settle it in
+    scan_apply when the engine can, route the deferred command through
+    the repo (which repairs the memo) otherwise. Returns reply bytes."""
+    parts = [b"UJSON", *args]
+    wire = _resp_array(parts)
+    rc, consumed, replies, unhandled, _ = eng.scan_apply(wire)
+    assert consumed == len(wire)
+    if rc == 1:
+        return replies + _oracle_reply(native, unhandled[1:])
+    assert rc == 0
+    return replies
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_ujson_scan_apply_differential_random_workload(seed):
+    """Randomized socket-shaped differential over the full UJSON command
+    surface: every command runs through the native engine (settle or
+    defer-and-repair, exactly the server's loop) on one side and the
+    pure-Python repo on the other — reply BYTES, flushed deltas and
+    snapshots must all match, with escape/UTF-8/float INS values, SET
+    documents, RM, CLR and cluster converge in the mix."""
+    from jylis_tpu.ops.ujson_host import UJSON
+
+    rng = np.random.default_rng(seed)
+    eng = make_engine()
+    native = RepoUJSON(identity=3, engine=eng)
+    oracle = RepoUJSON(identity=3)
+    keys = [b"u%d" % i for i in range(4)]
+    paths = ([], [b"tags"], [b"deep", b"er"], [b"meta"])
+    values = [
+        b"3", b"-17", b"1.5", b"1e10", b'"a\\nb"', b'"caf\\u00e9"',
+        b'"\xc3\xa9"', b"true", b"null", b'"plain"', b"0.25",
+    ]
+    docs = values + [b'{"a":1,"b":[1,2]}', b"[1,2]", b"{}"]
+    for step in range(300):
+        k = keys[rng.integers(len(keys))]
+        path = list(paths[rng.integers(len(paths))])
+        roll = rng.integers(10)
+        if roll < 3:
+            cmd = [b"INS", k, *path, values[rng.integers(len(values))]]
+        elif roll < 5:
+            cmd = [b"GET", k, *path]
+        elif roll == 5:
+            cmd = [b"SET", k, *path, docs[rng.integers(len(docs))]]
+        elif roll == 6:
+            cmd = [b"RM", k, *path, values[rng.integers(len(values))]]
+        elif roll == 7:
+            cmd = [b"CLR", k, *path]
+        elif roll == 8:
+            # cluster converge of the same remote delta into both
+            remote = UJSON()
+            d = UJSON()
+            remote.ins(9, ("tags",), str(rng.integers(5)), delta=d)
+            native.converge(k, d)
+            oracle.converge(k, d)
+            continue
+        else:
+            # banked writes ship their deltas after prepare_flush (the
+            # manager's threaded flush hook) — then both sides agree
+            native.prepare_flush()
+            assert native.deltas_size() == oracle.deltas_size()
+            assert native.flush_deltas() == oracle.flush_deltas(), step
+            continue
+        assert _native_serve(native, eng, cmd) == _oracle_reply(
+            oracle, cmd
+        ), (step, cmd)
+    for k in keys:
+        for path in paths:
+            cmd = [b"GET", k, *path]
+            assert _native_serve(native, eng, cmd) == _oracle_reply(oracle, cmd)
+    assert native.dump_state() == oracle.dump_state()
 
 
 # ---- server-level all-types differential -----------------------------------
@@ -494,7 +728,7 @@ def test_server_all_types_stream_differential(seed):
     cmds = []
     for _ in range(400):
         k = keys[rng.integers(len(keys))]
-        roll = rng.integers(18)
+        roll = rng.integers(21)
         if roll < 2:
             cmds.append(b"GCOUNT INC %s %d" % (k, rng.integers(0, 1000)))
         elif roll < 4:
@@ -535,9 +769,27 @@ def test_server_all_types_stream_differential(seed):
             else:
                 cmds.append(b"TLOG TRIM %s %d" % (k, rng.integers(0, 5)))
         elif roll == 16:
-            cmds.append(b"UJSON INS %s tags %d" % (k, rng.integers(20)))
+            vals = (
+                b"%d" % rng.integers(20), b"1.5", b"-0.25", b"1e3",
+                b'"a\\nb"', b'"caf\\u00e9"', b'"\xc3\xa9"', b"true",
+            )
+            cmds.append(
+                b"UJSON INS %s tags %s" % (k, vals[rng.integers(len(vals))])
+            )
+        elif roll == 17:
+            paths = (b"", b" tags", b" meta", b" deep er")
+            cmds.append(
+                b"UJSON GET %s%s" % (k, paths[rng.integers(len(paths))])
+            )
+        elif roll == 18:
+            docs = (b"7", b'"x"', b'{"a":1,"b":[1,2]}', b"[3,4]")
+            cmds.append(
+                b"UJSON SET %s meta %s" % (k, docs[rng.integers(len(docs))])
+            )
+        elif roll == 19:
+            cmds.append(b"UJSON RM %s tags %d" % (k, rng.integers(20)))
         else:
-            cmds.append(b"UJSON GET %s tags" % k)
+            cmds.append(b"UJSON CLR %s deep" % k)
     wire = b"".join(c + b"\r\n" for c in cmds)
     cuts = sorted(rng.integers(1, len(wire), size=10).tolist())
     packets = [wire[a:b] for a, b in zip([0] + cuts, cuts + [len(wire)])]
@@ -580,6 +832,82 @@ def test_server_all_types_stream_differential(seed):
     a = asyncio.run(run_one(False))
     b = asyncio.run(run_one(True))
     assert a == b
+
+
+def test_server_demote_then_recover_ordering_and_counters():
+    """A >max-args command demotes its connection off the native engine
+    mid-burst (server/server.py demote()): replies before, at and after
+    the demotion point must stay in order and byte-match the pure-Python
+    server; a FRESH connection settles natively again; and the SERVING
+    metrics lines expose the native/demoted split plus the demotion
+    event."""
+    demoter = b"GCOUNT GET k " + b" ".join([b"x"] * 1100)
+    cmds = (
+        [b"GCOUNT INC k 5", b"GCOUNT GET k", b"TREG SET t v 3", b"TREG GET t"]
+        + [demoter]
+        + [b"GCOUNT INC k 2", b"GCOUNT GET k", b"TLOG INS l x 1",
+           b"TLOG GET l", b"UJSON INS u tags 1", b"UJSON GET u tags"]
+    )
+    wire = b"".join(c + b"\r\n" for c in cmds)
+
+    async def run_one(force_python: bool):
+        from jylis_tpu.models.database import Database
+        from jylis_tpu.server.server import Server
+        from jylis_tpu.utils.config import Config
+        from jylis_tpu.utils.log import Log
+
+        cfg = Config()
+        cfg.port = "0"
+        cfg.log = Log.create_none()
+        db = Database(identity=1, engine="python" if force_python else "auto")
+        server = Server(cfg, db)
+        await server.start()
+        try:
+            out = await _send_recv_all(server.port, wire)
+            # a fresh connection is un-demoted: the engine serves it
+            out2 = await _send_recv_all(server.port, b"GCOUNT GET k\r\n")
+            metrics = await _send_recv_all(server.port, b"SYSTEM METRICS\r\n")
+            return out, out2, metrics, db.serving_totals()
+        finally:
+            await server.dispose()
+
+    na, na2, nm, totals = asyncio.run(run_one(False))
+    pa, pa2, _pm, _pt = asyncio.run(run_one(True))
+    assert na == pa  # in-order, byte-identical across the demotion point
+    assert na2 == pa2 == b":7\r\n"
+    # the fresh connection settled natively (GCOUNT count grew), the
+    # demoted tail counted as Python-path commands, and the demotion
+    # event itself is visible
+    assert totals["native_cmds"] >= 5
+    assert totals["demoted_cmds"] >= 6
+    assert totals["demotions"] >= 1
+    assert b"SERVING native_cmds" in nm and b"SERVING fallback_frac" in nm
+
+
+def test_bench_resp_reply_counter():
+    """The bench harness's reply parser (the thing that makes the
+    re-recorded `concurrent` honest) counts structured replies once,
+    across arbitrary chunk splits."""
+    import bench
+
+    stream = (
+        b"+OK\r\n"
+        b":42\r\n"
+        b"$-1\r\n"
+        b"$5\r\nhe\r\no\r\n"  # bulk with embedded CRLF: one reply
+        b"*0\r\n"
+        b"*2\r\n$1\r\nv\r\n:7\r\n"  # TREG GET shape
+        b"*2\r\n*2\r\n$1\r\na\r\n:2\r\n*2\r\n$1\r\nb\r\n:1\r\n"  # TLOG GET
+        b"-ERR nope\r\n"
+    )
+    c = bench.RespReplyCounter()
+    assert c.feed(stream) == 8
+    # byte-at-a-time: same count, no double-count at chunk boundaries
+    c = bench.RespReplyCounter()
+    got = 0
+    for i in range(len(stream)):
+        got = c.feed(stream[i : i + 1])
+    assert got == 8
 
 
 def assert_size(repo, expect: int) -> None:
