@@ -4,12 +4,41 @@
 PY ?= python
 
 .PHONY: test soak bench bench-all bench-full bench-smoke native run clean \
-        check-graft ci check-prose image compose-smoke smoke3 release
+        check-graft ci check-prose image compose-smoke smoke3 release \
+        lint sanitize
 
-# what CI runs per commit (.github/workflows/ci.yml): hermetic on any host.
-# `test` includes the journal suite (tests/test_journal.py — append/replay,
-# corruption classes, rotation, and a real SIGKILL/restart boot).
-ci: native test check-graft check-prose bench-smoke
+# what CI runs per commit (.github/workflows/ci.yml + .circleci/config.yml):
+# hermetic on any host. `test` includes the journal suite
+# (tests/test_journal.py — append/replay, corruption classes, rotation, and
+# a real SIGKILL/restart boot); `lint` is the repo-native static analyzer
+# (scripts/jlint — async/thread safety, JAX trace discipline, native/Python
+# RESP surface parity); `sanitize` rebuilds the native engine under
+# ASAN+UBSAN with -Werror and re-runs the jax-free native test subset.
+ci: native lint test check-graft check-prose bench-smoke sanitize
+
+# the three jlint passes + the broad-except rule, against the committed
+# baseline (scripts/jlint/baseline.json — every entry justified in-line,
+# stale entries fail). The parity check re-extracts the native and Python
+# command surfaces on every run and fails on uncommitted drift against
+# scripts/jlint/parity_manifest.json; regenerate with
+# `$(PY) -m scripts.jlint --write-manifest` and commit the diff.
+lint:
+	$(PY) -m scripts.jlint
+
+# ASAN+UBSAN build of the native engine (-Werror, no recovery) + the
+# jax-free native test subset under the sanitizer runtime. jax stays
+# un-imported (JYLIS_SANITIZE gates tests/conftest.py): jaxlib's pybind11
+# C++ exceptions abort under the preloaded ASAN interceptor.
+sanitize:
+	g++ -O1 -g -std=c++17 -shared -fPIC -fsanitize=address,undefined \
+	  -fno-sanitize-recover=all -Wall -Wextra -Werror \
+	  -o native/libjylis_native_san.so native/*.cpp
+	JYLIS_SANITIZE=1 JYLIS_NATIVE_SO=$(abspath native/libjylis_native_san.so) \
+	  LD_PRELOAD=$$(g++ -print-file-name=libasan.so) \
+	  ASAN_OPTIONS=detect_leaks=0 \
+	  UBSAN_OPTIONS=print_stacktrace=1,halt_on_error=1 \
+	  $(PY) -m pytest tests/test_native_resp.py tests/test_native_drive.py \
+	  -q -p no:cacheprovider
 
 # every README headline number must match the committed BENCH_full.json
 check-prose:
@@ -86,6 +115,7 @@ smoke3:
 	$(PY) scripts/smoke3.py --spawn
 
 clean:
-	rm -f native/libjylis_native.so jylis_tpu/native/libjylis_native.so
+	rm -f native/libjylis_native.so jylis_tpu/native/libjylis_native.so \
+	  native/libjylis_native_san.so
 	rm -rf build dist
 	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -26,7 +26,7 @@ class Heart:
                 await asyncio.sleep(self._interval)
                 try:
                     self._target._heartbeat()
-                except Exception as e:  # noqa: BLE001
+                except Exception as e:  # noqa: BLE001  jlint: broad-ok
                     # a transient tick failure must not kill the heart: a
                     # dead heart means no dialing, no eviction, and no
                     # anti-entropy while the node keeps serving clients

@@ -111,6 +111,7 @@ class Journal:
         self._cv = threading.Condition()
         self._q: deque = deque()
         self._busy = False  # writer mid-encode/mid-write
+        self._paused = False  # rotation owns the file; writer sleeps
         self._stop = False
         self._worker: threading.Thread | None = None
         self._f = None
@@ -142,6 +143,7 @@ class Journal:
                 os.path.exists(self._path)
                 and os.path.getsize(self._path) >= HEADER_LEN
             ):
+                # boot: no writer thread, no serving loop — jlint: lockio-ok
                 self._f = open(self._path, "ab")
                 self._size = os.path.getsize(self._path)
             else:
@@ -153,16 +155,38 @@ class Journal:
                 )
                 self._worker.start()
 
+    def _open_fresh_file(self):
+        """Open a fresh segment and write its header; touches NO shared
+        state, so both the boot path (under ``_cv``) and rotation (under
+        the ``_paused`` hand-off, outside the lock) share it — the one
+        place the header bytes are spelled. Returns ``(file, synced_at)``
+        where ``synced_at`` is the fsync clock stamp or None."""
+        f = open(self._path, "wb")
+        try:
+            f.write(MAGIC + codec.delta_signature())
+            f.flush()
+            synced_at = None
+            if self._fsync != FSYNC_OFF:
+                os.fsync(f.fileno())
+                synced_at = self._clock()
+        except OSError:
+            # a failed header write (ENOSPC) must not leak the fd: the
+            # rotation retry path re-opens per attempt, and leaking one
+            # per retry would turn a full disk into EMFILE
+            f.close()
+            raise
+        return f, synced_at
+
     def _open_fresh_locked(self) -> None:
-        self._f = open(self._path, "wb")
-        self._f.write(MAGIC + codec.delta_signature())
-        self._f.flush()
-        if self._fsync != FSYNC_OFF:
-            os.fsync(self._f.fileno())
-            self._last_sync = self._clock()
-        self._size = HEADER_LEN
-        self._dirty = False
-        self._rotation_asked = False
+        # boot path: the caller (open) holds _cv and the writer thread
+        # does not exist yet, so these stores are serialised. jlint:
+        # shared-ok (caller holds _cv)
+        self._f, synced_at = self._open_fresh_file()
+        if synced_at is not None:
+            self._last_sync = synced_at  # jlint: shared-ok (under _cv)
+        self._size = HEADER_LEN  # jlint: shared-ok (under _cv)
+        self._dirty = False  # jlint: shared-ok (under _cv)
+        self._rotation_asked = False  # jlint: shared-ok (under _cv)
 
     def close(self) -> None:
         """Drain the queue, stop the writer, fsync, close."""
@@ -177,6 +201,8 @@ class Journal:
                 return
             self._f.flush()
             if self._fsync != FSYNC_OFF:
+                # terminal: the writer is already joined and appends are
+                # rejected, nothing contends for _cv — jlint: lockio-ok
                 os.fsync(self._f.fileno())
             self._f.close()
             self._f = None
@@ -213,7 +239,12 @@ class Journal:
             self._cv.notify_all()
 
     def _drain_locked(self) -> None:
-        while self._q or self._busy:
+        # _paused too: "drained" must mean on THIS segment's disk, and —
+        # since rotate_begin drains first — it is also what serialises
+        # two rotations against each other (shutdown's final rotation
+        # can overlap the compaction loop's in-flight one: cancelling
+        # the loop task cannot stop its to_thread worker)
+        while self._q or self._busy or self._paused:
             self._cv.wait()
 
     # ---- the writer thread -------------------------------------------------
@@ -228,7 +259,12 @@ class Journal:
             item = None
             idle_sync = False
             with self._cv:
-                while not self._q and not self._stop:
+                while self._paused or (not self._q and not self._stop):
+                    if self._paused:
+                        # rotation owns the file: sleep until it installs
+                        # the fresh segment (appends keep enqueueing)
+                        self._cv.wait()
+                        continue
                     # under the interval policy an unsynced tail must
                     # NOT wait for the next append (the CLI promises a
                     # bounded power-loss window): when idle with dirty
@@ -274,9 +310,29 @@ class Journal:
                     data = frame(
                         struct.pack(">I", zlib.crc32(payload)) + payload
                     )
-                except Exception as e:  # an encode bug must not kill the writer
-                    self.last_error = e
+                except Exception as e:  # jlint: broad-ok — an encode bug
+                    # must not kill the writer thread (a dead writer
+                    # silently ends durability); recorded via last_error
+                    # and the JOURNAL errors counter
+                    self.last_error = e  # jlint: shared-ok (atomic diagnostic ref)
                     metrics.note_journal("errors")
+                if data is not None and f is None:
+                    # no active segment (a failed rotation): the batch
+                    # cannot be made durable — count the drop instead of
+                    # losing it silently (peers/snapshots still hold it),
+                    # and re-ask for rotation: it is what re-opens the
+                    # segment, and in size-triggered-only mode
+                    # (--snapshot-interval 0) nothing else ever would.
+                    # Paced to append cadence, so a dead disk retries
+                    # per flush, not in a hot loop.
+                    metrics.note_journal("errors")
+                    with self._cv:
+                        if (
+                            not self._rotation_asked
+                            and self.rotate_notify is not None
+                        ):
+                            self._rotation_asked = True
+                            ask = True
                 if data is not None and f is not None:
                     try:
                         f.write(data)
@@ -285,6 +341,9 @@ class Journal:
                         # parked in Python's file buffer
                         f.flush()
                         wrote = len(data)
+                        # _busy protocol: while set, the writer owns _f
+                        # and the fsync bookkeeping — rotation and close
+                        # wait the flag out. jlint: shared-ok
                         self._dirty = True
                         if self._fsync == FSYNC_ALWAYS or (
                             self._fsync == FSYNC_INTERVAL
@@ -296,7 +355,7 @@ class Journal:
                         ):
                             synced = self._sync_file(f)
                     except OSError as e:  # full disk etc: keep the writer
-                        self.last_error = e
+                        self.last_error = e  # jlint: shared-ok (atomic diagnostic ref)
                         metrics.note_journal("errors")
                 with self._cv:
                     if wrote:
@@ -335,11 +394,13 @@ class Journal:
         try:
             os.fsync(f.fileno())
         except OSError as e:
-            self.last_error = e
+            self.last_error = e  # jlint: shared-ok (atomic diagnostic ref)
             metrics.note_journal("errors")
             return False
+        # writer-owns-file protocol (see _run): only the writer (or a
+        # drain-holding caller) reaches here. jlint: shared-ok
         self._last_sync = self._clock()
-        self._dirty = False
+        self._dirty = False  # jlint: shared-ok (writer owns bookkeeping)
         return True
 
     # ---- rotation (size-triggered compaction) ------------------------------
@@ -349,42 +410,89 @@ class Journal:
         then cuts a snapshot (persist.write_snapshot) and, on success,
         calls ``rotate_commit``; on failure the retired segment simply
         stays — recovery replays snapshot + retiring + active, and the
-        next rotation folds the segments together."""
+        next rotation folds the segments together.
+
+        All disk I/O here runs OUTSIDE the condition variable, under the
+        ``_paused`` hand-off: the writer sleeps, ``_f`` is detached, and
+        serving-loop ``append()`` calls keep enqueueing at memory speed
+        for the whole fsync + fold + rename (jlint JL104 caught the
+        previous version holding ``_cv`` across all of it — every
+        append, and with it the event loop, stalled behind the disk for
+        up to a full 64 MB segment fold)."""
         with self._cv:
             self._drain_locked()  # queued batches belong to the OLD cut
-            if self._f is not None:
-                self._f.flush()
-                os.fsync(self._f.fileno())  # rename only what is durable
-                self._f.close()
-                self._f = None
+            self._paused = True  # writer sleeps; appends only enqueue
+            f = self._f
+            self._f = None
+        fresh = None
+        synced_at = None
+        try:
+            if f is not None:
+                try:
+                    f.flush()
+                    os.fsync(f.fileno())  # rename only what is durable
+                finally:
+                    f.close()  # even when the fsync fails: no fd leak
+                    # per retry — the segment itself stays on disk for
+                    # the next attempt either way
             retiring = self.retiring_path()
-            if os.path.exists(retiring):
-                # the previous rotation's snapshot never landed: fold the
-                # just-closed segment into the retiring one (both are
-                # valid framed streams with identical headers, so frames
-                # concatenate into a valid stream — join order is free)
-                with open(self._path, "rb") as src, open(retiring, "ab") as dst:
-                    src.seek(HEADER_LEN)
-                    while True:
-                        chunk = src.read(1 << 20)
-                        if not chunk:
-                            break
-                        dst.write(chunk)
-                    dst.flush()
-                    os.fsync(dst.fileno())
-                os.remove(self._path)
-            else:
-                os.replace(self._path, retiring)
-            self._open_fresh_locked()
+            # guard on the active segment existing: a prior failed
+            # rotation may have renamed it aside and then died before
+            # opening the fresh one — the retry must not wedge on the
+            # missing file, just re-open and carry on
+            if os.path.exists(self._path):
+                if os.path.exists(retiring):
+                    # the previous rotation's snapshot never landed: fold
+                    # the just-closed segment into the retiring one (both
+                    # are valid framed streams with identical headers, so
+                    # frames concatenate into a valid stream — join order
+                    # is free)
+                    with open(self._path, "rb") as src, \
+                            open(retiring, "ab") as dst:
+                        src.seek(HEADER_LEN)
+                        while True:
+                            chunk = src.read(1 << 20)
+                            if not chunk:
+                                break
+                            dst.write(chunk)
+                        dst.flush()
+                        os.fsync(dst.fileno())
+                    os.remove(self._path)
+                else:
+                    os.replace(self._path, retiring)
+            fresh, synced_at = self._open_fresh_file()
+        except OSError as e:
+            # a failed rotation must never leave the writer paused
+            # forever: record, resume on whatever file state we reached.
+            # ``_f`` may stay None — batches then drain undurable (each
+            # counted as a JOURNAL error) until the next successful
+            # rotation re-opens the segment; the snapshot loop keeps
+            # retrying on its interval
+            self.last_error = e  # jlint: shared-ok (atomic diagnostic ref)
+            metrics.note_journal("errors")
+        finally:
+            with self._cv:
+                self._f = fresh
+                if fresh is not None:
+                    self._size = HEADER_LEN
+                    self._dirty = False
+                    if synced_at is not None:
+                        self._last_sync = synced_at
+                # unlatch even on failure: the writer re-asks on its
+                # next undurable drop, which is the retry path that
+                # eventually re-opens the segment
+                self._rotation_asked = False
+                self._paused = False
+                self._cv.notify_all()
 
     def rotate_commit(self) -> None:
         """The snapshot superseding the retired segment is durable:
-        delete it."""
-        with self._cv:
-            try:
-                os.remove(self.retiring_path())
-            except FileNotFoundError:
-                pass
+        delete it. A plain unlink that touches no shared state — taking
+        ``_cv`` here would only serialise appends behind the disk."""
+        try:
+            os.remove(self.retiring_path())
+        except FileNotFoundError:
+            pass
 
 
 # ---- replay / recovery ------------------------------------------------------

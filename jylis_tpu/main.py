@@ -110,7 +110,12 @@ class Dispose:
                         # the only copy of the unsnapshotted deltas.
                         await asyncio.to_thread(self._journal.rotate_begin)
                         await asyncio.to_thread(self._journal.rotate_commit)
-                except Exception as e:
+                except Exception as e:  # jlint: broad-ok — the shutdown
+                    # snapshot dumps every repo through device drains,
+                    # which can raise anything from OSError to XLA
+                    # runtime errors; whatever it was, it is logged and
+                    # the listeners below must still stop (a second
+                    # SIGINT no-ops, so failing here would hang the node)
                     if self._log is not None:
                         self._log.err() and self._log.e(f"snapshot failed: {e}")
             # after the final drains (snapshot dump included) so the report
@@ -122,7 +127,20 @@ class Dispose:
             metrics.stop_profiling()
         finally:
             if self._journal is not None:
-                self._journal.close()  # final flush+fsync; appends stop
+                # close() joins the writer thread and fsyncs — blocking
+                # work (jlint JL101): run it off the loop so the server/
+                # cluster dispose below (and any last client goodbyes)
+                # are not held behind the disk. Its final flush/fsync can
+                # raise (full disk at shutdown); the listeners below must
+                # still stop and `done` must still be set, or the node
+                # hangs until SIGKILL.
+                try:
+                    await asyncio.to_thread(self._journal.close)
+                except OSError as e:
+                    if self._log is not None:
+                        self._log.err() and self._log.e(
+                            f"journal close failed: {e}"
+                        )
             self._cluster.dispose()
             await self._server.dispose()
             self.done.set()
@@ -138,8 +156,12 @@ async def run(argv: list[str] | None = None) -> None:
 
     snapshot_path = ""
     journal = None
+    # boot-path disk I/O below (makedirs / snapshot move-aside / journal
+    # open) runs before the server or cluster listeners exist: the loop
+    # has no clients to stall, and sequencing recovery before serving is
+    # the point. jlint: blocking-ok
     if config.data_dir:
-        os.makedirs(config.data_dir, exist_ok=True)
+        os.makedirs(config.data_dir, exist_ok=True)  # jlint: blocking-ok
         snapshot_path = os.path.join(config.data_dir, "snapshot.jylis")
         if os.path.exists(snapshot_path):
             try:
@@ -152,7 +174,7 @@ async def run(argv: list[str] | None = None) -> None:
                 # of un-restored data would destroy it
                 aside = snapshot_path + ".unreadable"
                 try:
-                    os.replace(snapshot_path, aside)
+                    os.replace(snapshot_path, aside)  # jlint: blocking-ok
                     log.err() and log.e(f"moved aside to {aside}")
                 except OSError:
                     pass
@@ -170,7 +192,7 @@ async def run(argv: list[str] | None = None) -> None:
                 fsync_interval=config.journal_fsync_interval,
                 max_bytes=config.journal_max_bytes,
             )
-            journal.open()
+            journal.open()  # jlint: blocking-ok (pre-serving boot)
             database.set_journal(journal)
 
     server = Server(config, database)
@@ -268,7 +290,10 @@ async def _snapshot_loop(
             log.debug() and log.d(f"online snapshot written: {path}")
         except asyncio.CancelledError:
             raise
-        except Exception as e:
+        except Exception as e:  # jlint: broad-ok — one failed online
+            # snapshot (full disk, a device drain raising mid-dump) must
+            # not kill the loop that would take the NEXT one; logged, and
+            # the journal keeps the unsnapshotted deltas either way
             log.err() and log.e(f"online snapshot failed: {e}")
 
 

@@ -155,8 +155,8 @@ def metric_lines(
         lines.append(f"{name} keys {keys}")
         lines.append(f"{name} device_ms {ms:.1f}")
     if any(journal_counters.values()):
-        # all four lines once journaling is live, so dashboards see
-        # explicit zeros (e.g. fsyncs under --journal-fsync off)
+        # every _JOURNAL_KEYS line once journaling is live, so dashboards
+        # see explicit zeros (e.g. fsyncs under --journal-fsync off)
         for k in _JOURNAL_KEYS:
             lines.append(f"JOURNAL {k} {journal_counters[k]}")
     return lines

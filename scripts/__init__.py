@@ -1,0 +1,2 @@
+# makes scripts/ importable so `python -m scripts.jlint` (and the jlint
+# self-tests) resolve the analyzer as a package from the repo root

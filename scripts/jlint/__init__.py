@@ -1,0 +1,225 @@
+"""jlint: the repo-native static analyzer (`make lint`, part of `make ci`).
+
+The repo spans three domains where bugs are silent until they cost a
+re-record, and each gets a dedicated analysis pass:
+
+* **Pass 1 — async/thread safety** (`pass_async`, rules JL1xx): the
+  asyncio serving loop shares state with the journal writer thread and
+  with `asyncio.to_thread` drains. Blocking calls on the loop, shared
+  attributes mutated from both sides without a declared guard,
+  read-modify-write sequences spanning an ``await``, and blocking disk
+  I/O performed while holding a thread lock are all flagged.
+* **Pass 2 — JAX trace discipline** (`pass_jax`, rules JL2xx) over
+  ``jylis_tpu/ops/``: host syncs reachable from ``@jax.jit`` functions,
+  data-dependent Python branching on traced values, dtype-implicit
+  array constructors outside the documented x64 guards, and jit
+  construction inside hot functions (per-call recompilation).
+* **Pass 3 — RESP surface parity** (`pass_parity`, rules JL3xx): the
+  native engine's command dispatch (``native/serve_engine.cpp``) is
+  extracted alongside the Python oracle dispatch (``models/repo_*.py``)
+  into a committed parity manifest; a command served natively without a
+  Python oracle path fails, and any drift between the sources and the
+  committed manifest fails — PR 2's hand-checked parity as a mechanical
+  invariant.
+
+Plus one hygiene rule, JL001: ``except Exception`` / bare ``except``
+without an explicit justification, so hot-path errors can't be silently
+swallowed.
+
+Suppression works at two levels, both requiring a human-readable reason:
+
+* inline: a ``# jlint: <slug>`` comment on the flagged line or the line
+  above (slugs per rule in ``RULES``; e.g. ``# jlint: shared-ok —
+  writer-owns-file protocol``);
+* the committed baseline (``scripts/jlint/baseline.json``): entries of
+  ``{"rule", "file", "match", "reason"}`` where ``match`` must appear in
+  the flagged source line. A baseline entry that no longer matches any
+  finding is STALE and fails the run, so suppressions can't outlive the
+  code they excuse.
+
+Run ``python -m scripts.jlint`` from the repo root (what ``make lint``
+does); ``--write-manifest`` regenerates the pass-3 parity manifest.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import tokenize
+from dataclasses import dataclass, field
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "baseline.json")
+MANIFEST_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "parity_manifest.json"
+)
+
+# rule id -> (inline suppression slug, one-line description)
+RULES = {
+    "JL001": ("broad-ok", "broad `except Exception`/bare except without justification"),
+    "JL101": ("blocking-ok", "known-blocking call inside `async def` without executor dispatch"),
+    "JL102": ("shared-ok", "attribute mutated from both a worker thread and the event loop without a declared guard"),
+    "JL103": ("rmw-ok", "read-modify-write of a shared attribute spanning an `await`"),
+    "JL104": ("lockio-ok", "blocking disk I/O while holding a thread lock/condition"),
+    "JL201": ("hostsync-ok", "host sync (.item()/float()/np.asarray) reachable from a @jax.jit function"),
+    "JL202": ("branch-ok", "data-dependent Python branch on a traced value inside a jit function"),
+    "JL203": ("dtype-ok", "dtype-implicit array constructor in jit code outside an x64 guard"),
+    "JL204": ("jit-ok", "jax.jit constructed inside a function body (per-call recompilation)"),
+    "JL301": (None, "command served natively without a Python oracle path (or vice versa, unlisted)"),
+    "JL302": (None, "parity manifest drift: committed manifest != extracted surfaces"),
+    "JL900": (None, "stale or malformed baseline suppression entry"),
+}
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str  # repo-relative
+    line: int
+    msg: str
+    src: str = ""  # stripped source line, what baseline `match` runs against
+    suppressed: bool = False
+    baseline: bool = False
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.msg}"
+
+
+@dataclass
+class Source:
+    """One parsed Python file plus the comment map suppressions need."""
+
+    path: str  # absolute
+    rel: str  # repo-relative
+    text: str
+    tree: ast.AST
+    lines: list[str] = field(default_factory=list)
+    comments: dict[int, str] = field(default_factory=dict)  # line -> comment text
+
+    @classmethod
+    def load(cls, path: str, root: str = ROOT) -> "Source":
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        tree = ast.parse(text, filename=path)
+        src = cls(
+            path=path,
+            rel=os.path.relpath(path, root),
+            text=text,
+            tree=tree,
+            lines=text.splitlines(),
+        )
+        # tokenize for comments: `# jlint: slug` anywhere in a comment
+        try:
+            for tok in tokenize.generate_tokens(iter(text.splitlines(True)).__next__):
+                if tok.type == tokenize.COMMENT:
+                    src.comments[tok.start[0]] = tok.string
+        except tokenize.TokenError:
+            pass
+        return src
+
+    def line_src(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def has_suppression(self, lineno: int, slug: str) -> bool:
+        """`# jlint: <slug>` on the line, or on the line above it."""
+        for ln in (lineno, lineno - 1):
+            c = self.comments.get(ln, "")
+            if "jlint:" in c and slug in c.split("jlint:", 1)[1]:
+                return True
+        return False
+
+
+def parent_map(tree: ast.AST) -> dict[ast.AST, ast.AST]:
+    parents: dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def dotted_name(node: ast.AST) -> str:
+    """'os.fsync' for Attribute chains, 'open' for Names, '' otherwise."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif parts:
+        parts.append("?")  # call on a computed receiver: keep the tail
+    return ".".join(reversed(parts))
+
+
+def iter_py_files(root: str, subdirs: tuple[str, ...]) -> list[str]:
+    out = []
+    for sub in subdirs:
+        base = os.path.join(root, sub)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for f in sorted(filenames):
+                if f.endswith(".py"):
+                    out.append(os.path.join(dirpath, f))
+    return sorted(out)
+
+
+def apply_suppressions(findings: list[Finding], sources: dict[str, "Source"]) -> None:
+    """Mark findings carrying a matching inline `# jlint: <slug>` comment."""
+    for f in findings:
+        slug = RULES[f.rule][0]
+        src = sources.get(f.path)
+        if slug and src is not None and src.has_suppression(f.line, slug):
+            f.suppressed = True
+
+
+def load_baseline(path: str = BASELINE_PATH) -> list[dict]:
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def apply_baseline(
+    findings: list[Finding], baseline: list[dict]
+) -> list[Finding]:
+    """Suppress findings matched by baseline entries; return JL900
+    findings for entries that are malformed or match nothing (stale)."""
+    problems: list[Finding] = []
+    for i, entry in enumerate(baseline):
+        rule = entry.get("rule", "")
+        file_ = entry.get("file", "")
+        match = entry.get("match", "")
+        reason = entry.get("reason", "")
+        if not (rule and file_ and match) or not reason.strip():
+            problems.append(
+                Finding(
+                    "JL900", BASELINE_PATH_REL, i + 1,
+                    f"baseline entry {i} malformed or missing a reason: {entry!r}",
+                )
+            )
+            continue
+        hit = False
+        for f in findings:
+            if (
+                f.rule == rule
+                and f.path == file_
+                and match in f.src
+                and not f.suppressed
+            ):
+                f.suppressed = True
+                f.baseline = True
+                hit = True
+        if not hit:
+            problems.append(
+                Finding(
+                    "JL900", BASELINE_PATH_REL, i + 1,
+                    f"stale baseline entry {i}: no current {rule} finding in "
+                    f"{file_} matches {match!r} — delete the entry",
+                )
+            )
+    return problems
+
+
+BASELINE_PATH_REL = os.path.relpath(BASELINE_PATH, ROOT)

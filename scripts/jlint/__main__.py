@@ -1,0 +1,90 @@
+"""jlint CLI: `python -m scripts.jlint` (what `make lint` runs).
+
+Exit 0 only when every pass is clean: no unsuppressed finding, no stale
+baseline entry, no parity drift. `--write-manifest` regenerates the
+pass-3 parity manifest in place and exits (commit the diff).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from . import (
+    ROOT,
+    Source,
+    apply_baseline,
+    apply_suppressions,
+    iter_py_files,
+    load_baseline,
+)
+from . import pass_async, pass_jax, pass_parity
+
+# pass 1 + JL001 cover the product and its scripts; tests are excluded
+# (fixtures deliberately violate the rules), and jlint's own fixtures
+# live inside string literals so the package itself stays in scope
+ASYNC_SCOPE = ("jylis_tpu", "scripts")
+JAX_SCOPE = ("jylis_tpu/ops",)
+
+
+def collect_sources(subdirs) -> list[Source]:
+    out = []
+    for path in iter_py_files(ROOT, subdirs):
+        try:
+            out.append(Source.load(path))
+        except SyntaxError as e:
+            print(f"jlint: cannot parse {path}: {e}", file=sys.stderr)
+            raise SystemExit(2)
+    return out
+
+
+def run_all(root: str = ROOT, verbose: bool = False) -> int:
+    async_sources = collect_sources(ASYNC_SCOPE)
+    jax_sources = [
+        s for s in async_sources
+        if s.rel.startswith(JAX_SCOPE[0].replace("/", os.sep))
+    ]
+    findings = pass_async.run(async_sources)
+    findings += pass_jax.run(jax_sources)
+    by_rel = {s.rel: s for s in async_sources}
+    apply_suppressions(findings, by_rel)
+    problems = apply_baseline(findings, load_baseline())
+    findings += pass_parity.check()
+    findings += problems
+
+    bad = [f for f in findings if not f.suppressed]
+    shown = findings if verbose else bad
+    for f in sorted(shown, key=lambda f: (f.path, f.line, f.rule)):
+        tag = " (suppressed)" if f.suppressed else ""
+        print(f.render() + tag)
+    n_sup = sum(1 for f in findings if f.suppressed)
+    print(
+        f"jlint: {len(bad)} finding(s), {n_sup} suppressed "
+        f"({len(async_sources)} files, 3 passes)"
+    )
+    return 1 if bad else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="jlint")
+    ap.add_argument(
+        "--write-manifest", action="store_true",
+        help="regenerate scripts/jlint/parity_manifest.json and exit",
+    )
+    ap.add_argument(
+        "-v", "--verbose", action="store_true",
+        help="also print suppressed findings",
+    )
+    args = ap.parse_args(argv)
+    if args.write_manifest:
+        manifest = pass_parity.write_manifest()
+        n = sum(len(v) for v in manifest["native"].values())
+        p = sum(len(v) for v in manifest["python"].values())
+        print(f"parity manifest written: {n} native, {p} python commands")
+        return 0
+    return run_all(verbose=args.verbose)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

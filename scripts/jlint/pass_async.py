@@ -1,0 +1,401 @@
+"""Pass 1 — async/thread safety (rules JL001, JL101–JL104).
+
+The serving stack is one asyncio event loop sharing state with the
+journal writer thread (`jylis_tpu/journal/journal.py`) and with
+`asyncio.to_thread` drain workers (`jylis_tpu/models/manager.py`). The
+failure modes this pass mechanises were all found (or nearly shipped)
+by hand:
+
+* JL101 — a known-blocking call (`os.fsync`, `time.sleep`, socket
+  connect, journal lifecycle methods, engine FFI entry points) executed
+  directly inside an ``async def``: every client on the loop stalls for
+  its duration. Dispatch through ``asyncio.to_thread`` /
+  ``run_in_executor`` instead (passing the function, not calling it,
+  which is why wrapped call sites don't trigger).
+* JL102 — an attribute mutated both from a thread-entry method
+  (a ``threading.Thread`` target or an ``asyncio.to_thread`` callee,
+  transitively) and from loop-side methods, where some mutation site is
+  not under a ``with <lock/cv>`` block. Declare the guard or annotate
+  the ownership protocol with ``# jlint: shared-ok``.
+* JL103 — read-modify-write of a ``self.`` attribute spanning an
+  ``await``: the loop can interleave another coroutine between the read
+  and the write, losing one side's update.
+* JL104 — blocking disk I/O (fsync/rename/open/…) performed while
+  holding a thread lock or condition variable: any other thread —
+  including the event loop calling a brief enqueue — blocks behind the
+  disk for the duration.
+* JL001 — ``except Exception`` / bare ``except`` without an explicit
+  justification (``# jlint: broad-ok``): swallowing everything hides
+  hot-path bugs until they cost a re-record.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from . import Finding, Source, dotted_name, parent_map
+
+# fully-dotted calls that block the calling thread
+BLOCKING_CALLS = {
+    "time.sleep",
+    "os.fsync",
+    "os.fdatasync",
+    "os.replace",
+    "os.rename",
+    "os.remove",
+    "os.truncate",
+    "os.makedirs",
+    "socket.create_connection",
+    "subprocess.run",
+    "subprocess.check_output",
+    "subprocess.check_call",
+}
+# method names that block regardless of receiver
+BLOCKING_METHOD_NAMES = {"fsync", "fdatasync", "scan_apply"}
+# method names that block when the receiver looks like the journal (its
+# lifecycle methods join the writer thread and/or fsync)
+JOURNAL_METHODS = {"open", "close", "flush", "rotate_begin", "rotate_commit"}
+# builtins that block (open hits the filesystem)
+BLOCKING_BUILTINS = {"open"}
+
+LOCKISH = ("lock", "_cv", "cond", "mutex")
+# disk-touching calls that must not run under a held thread lock
+LOCK_IO_CALLS = {
+    "os.fsync",
+    "os.fdatasync",
+    "os.replace",
+    "os.rename",
+    "os.remove",
+    "os.truncate",
+}
+LOCK_IO_METHOD_NAMES = {"fsync", "fdatasync"}
+
+
+def _is_lockish(expr_src: str) -> bool:
+    low = expr_src.lower()
+    return any(tok in low for tok in LOCKISH)
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _enclosing_function(node: ast.AST, parents) -> ast.AST | None:
+    while node in parents:
+        node = parents[node]
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return node
+    return None
+
+
+def _under_lock_with(node: ast.AST, parents) -> bool:
+    """True when an ancestor sync `with` statement's context expression
+    names a lock/condition (the asyncio `async with` case is the loop's
+    own serialisation, JL101's domain, not a thread mutex)."""
+    while node in parents:
+        node = parents[node]
+        if isinstance(node, ast.With):
+            for item in node.items:
+                if _is_lockish(ast.unparse(item.context_expr)):
+                    return True
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+    return False
+
+
+def _blocking_call_name(call: ast.Call) -> str | None:
+    name = dotted_name(call.func)
+    if name in BLOCKING_CALLS:
+        return name
+    if name in BLOCKING_BUILTINS:
+        return name
+    if isinstance(call.func, ast.Attribute):
+        meth = call.func.attr
+        if meth in BLOCKING_METHOD_NAMES:
+            return name or meth
+        recv = dotted_name(call.func.value).lower()
+        if meth in JOURNAL_METHODS and "journal" in recv:
+            return name or meth
+    return None
+
+
+# ---- JL101: blocking calls inside async def ---------------------------------
+
+
+def _check_blocking_in_async(src: Source, out: list[Finding]) -> None:
+    for fn in ast.walk(src.tree):
+        if not isinstance(fn, ast.AsyncFunctionDef):
+            continue
+        # walk the async body but stay out of nested function bodies:
+        # a nested sync def runs only when called, and a nested async
+        # def gets its own visit
+        stack: list[ast.AST] = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            if isinstance(node, ast.Call):
+                name = _blocking_call_name(node)
+                if name:
+                    out.append(
+                        Finding(
+                            "JL101", src.rel, node.lineno,
+                            f"blocking call `{name}` inside `async def "
+                            f"{fn.name}` — the event loop stalls for its "
+                            "duration; dispatch via asyncio.to_thread",
+                            src.line_src(node.lineno),
+                        )
+                    )
+            stack.extend(ast.iter_child_nodes(node))
+
+
+# ---- JL102: shared attributes without a declared guard ----------------------
+
+
+def _thread_entry_names(cls: ast.ClassDef) -> set[str]:
+    """Methods handed to threading.Thread(target=self.X) or
+    asyncio.to_thread(self.X, ...) / run_in_executor(None, self.X)."""
+    entries: set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        cands: list[ast.AST] = []
+        if name.endswith("Thread"):
+            cands += [kw.value for kw in node.keywords if kw.arg == "target"]
+        elif name.endswith("to_thread"):
+            cands += node.args[:1]
+        elif name.endswith("run_in_executor"):
+            cands += node.args[1:2]
+        for c in cands:
+            attr = _self_attr(c)
+            if attr:
+                entries.add(attr)
+    return entries
+
+
+def _method_calls(fn: ast.AST) -> set[str]:
+    calls = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            attr = _self_attr(node.func)
+            if attr:
+                calls.add(attr)
+    return calls
+
+
+def _check_shared_attrs(src: Source, out: list[Finding]) -> None:
+    parents = parent_map(src.tree)
+    for cls in ast.walk(src.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        methods = {
+            m.name: m
+            for m in cls.body
+            if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        roots = _thread_entry_names(cls) & set(methods)
+        if not roots:
+            continue
+        # close thread-entry methods over their self-method call graph
+        threaded = set(roots)
+        frontier = list(roots)
+        while frontier:
+            m = frontier.pop()
+            for callee in _method_calls(methods[m]) & set(methods):
+                if callee not in threaded:
+                    threaded.add(callee)
+                    frontier.append(callee)
+        loop_side = set(methods) - threaded - {"__init__"}
+
+        # attr -> {method: [store nodes]}
+        stores: dict[str, dict[str, list[ast.AST]]] = {}
+        for mname, m in methods.items():
+            for node in ast.walk(m):
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [node.target]
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr:
+                        stores.setdefault(attr, {}).setdefault(mname, []).append(node)
+
+        for attr, per_method in stores.items():
+            in_thread = [m for m in per_method if m in threaded]
+            in_loop = [m for m in per_method if m in loop_side]
+            if not in_thread or not in_loop:
+                continue
+            for mname in in_thread + in_loop:
+                for node in per_method[mname]:
+                    if _under_lock_with(node, parents):
+                        continue
+                    out.append(
+                        Finding(
+                            "JL102", src.rel, node.lineno,
+                            f"`self.{attr}` is mutated from thread method(s) "
+                            f"{sorted(in_thread)} AND loop-side method(s) "
+                            f"{sorted(in_loop)}; this store in `{mname}` has "
+                            "no lock/Condition guard — guard it or declare "
+                            "the ownership protocol with `# jlint: shared-ok`",
+                            src.line_src(node.lineno),
+                        )
+                    )
+
+
+# ---- JL103: read-modify-write spanning an await -----------------------------
+
+
+def _ordered_nodes(fn: ast.AST) -> list[ast.AST]:
+    nodes = [
+        n for n in ast.walk(fn)
+        if hasattr(n, "lineno") and not isinstance(n, (ast.FunctionDef, ast.Lambda))
+    ]
+    nodes.sort(key=lambda n: (n.lineno, n.col_offset))
+    return nodes
+
+
+def _check_rmw_across_await(src: Source, out: list[Finding]) -> None:
+    for fn in ast.walk(src.tree):
+        if not isinstance(fn, ast.AsyncFunctionDef):
+            continue
+        await_lines = sorted(
+            n.lineno for n in ast.walk(fn) if isinstance(n, ast.Await)
+        )
+        if not await_lines:
+            continue
+        # (a) one statement both reads and writes self.X around an await:
+        #     `self.x += await f()` / `self.x = self.x + await f()`
+        for node in ast.walk(fn):
+            is_aug = isinstance(node, ast.AugAssign) and _self_attr(node.target)
+            reads_self = False
+            attr = None
+            if is_aug:
+                attr = _self_attr(node.target)
+                reads_self = True
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    a = _self_attr(t)
+                    if a and any(
+                        _self_attr(v) == a for v in ast.walk(node.value)
+                    ):
+                        attr = a
+                        reads_self = True
+            if not reads_self:
+                continue
+            if any(isinstance(v, ast.Await) for v in ast.walk(node.value)):
+                out.append(
+                    Finding(
+                        "JL103", src.rel, node.lineno,
+                        f"read-modify-write of `self.{attr}` spans the "
+                        "`await` inside its own right-hand side — another "
+                        "coroutine can interleave between the read and "
+                        "the store",
+                        src.line_src(node.lineno),
+                    )
+                )
+        # (b) tmp = self.x ... await ... self.x = f(tmp)
+        bindings: dict[str, tuple[str, int]] = {}  # var -> (attr, lineno)
+        for node in _ordered_nodes(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                if isinstance(t, ast.Name):
+                    read = _self_attr(node.value)
+                    if read:
+                        bindings[t.id] = (read, node.lineno)
+                    else:
+                        bindings.pop(t.id, None)
+                attr = _self_attr(t)
+                if attr:
+                    used = {
+                        v.id for v in ast.walk(node.value)
+                        if isinstance(v, ast.Name)
+                    }
+                    for var in used:
+                        if var not in bindings:
+                            continue
+                        bound_attr, bound_line = bindings[var]
+                        if bound_attr != attr:
+                            continue
+                        if any(
+                            bound_line < aw <= node.lineno
+                            for aw in await_lines
+                        ):
+                            out.append(
+                                Finding(
+                                    "JL103", src.rel, node.lineno,
+                                    f"`self.{attr}` was read into `{var}` at "
+                                    f"line {bound_line}, an `await` ran, and "
+                                    "this store writes a value derived from "
+                                    "the stale read",
+                                    src.line_src(node.lineno),
+                                )
+                            )
+
+
+# ---- JL104: blocking I/O while holding a thread lock ------------------------
+
+
+def _check_lock_io(src: Source, out: list[Finding]) -> None:
+    parents = parent_map(src.tree)
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        hit = name in LOCK_IO_CALLS or name in BLOCKING_BUILTINS
+        if not hit and isinstance(node.func, ast.Attribute):
+            hit = node.func.attr in LOCK_IO_METHOD_NAMES
+        if not hit:
+            continue
+        if _under_lock_with(node, parents):
+            out.append(
+                Finding(
+                    "JL104", src.rel, node.lineno,
+                    f"blocking disk I/O `{name or node.func.attr}` while "
+                    "holding a thread lock/condition — every other thread "
+                    "(the event loop included) blocks behind the disk; move "
+                    "the I/O outside the lock or declare the protocol",
+                    src.line_src(node.lineno),
+                )
+            )
+
+
+# ---- JL001: broad excepts ---------------------------------------------------
+
+
+def _check_broad_except(src: Source, out: list[Finding]) -> None:
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        broad = node.type is None or (
+            isinstance(node.type, ast.Name)
+            and node.type.id in ("Exception", "BaseException")
+        )
+        if broad:
+            what = "bare except" if node.type is None else f"except {node.type.id}"
+            out.append(
+                Finding(
+                    "JL001", src.rel, node.lineno,
+                    f"{what} — narrow to the concrete exception(s) or justify "
+                    "with `# jlint: broad-ok` (and log what was swallowed)",
+                    src.line_src(node.lineno),
+                )
+            )
+
+
+def run(sources: list[Source]) -> list[Finding]:
+    out: list[Finding] = []
+    for src in sources:
+        _check_blocking_in_async(src, out)
+        _check_shared_attrs(src, out)
+        _check_rmw_across_await(src, out)
+        _check_lock_io(src, out)
+        _check_broad_except(src, out)
+    return out

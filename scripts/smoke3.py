@@ -32,7 +32,7 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from jylis_tpu.client import Client  # noqa: E402
+from jylis_tpu.client import Client, ResponseError  # noqa: E402
 
 SPAWN = (
     "from jylis_tpu.utils.vcpu import force_virtual_cpu; force_virtual_cpu(8); "
@@ -52,7 +52,12 @@ def until(deadline: float, fn, what: str) -> None:
         try:
             if fn():
                 return
-        except Exception as e:  # noqa: BLE001 — retried until the deadline
+        except (OSError, RuntimeError, ResponseError, AssertionError) as e:
+            # exactly the transient classes a still-booting or busy node
+            # produces (connect refused/reset, mid-handshake close,
+            # SHUTDOWN-error replies, not-yet-converged assertions) —
+            # anything else is a bug in the smoke itself and must raise,
+            # not spin until the deadline
             last_err = e
         time.sleep(0.25)
     detail = f" (last error: {last_err!r})" if last_err else ""

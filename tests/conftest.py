@@ -5,8 +5,18 @@ the shared helper overrides via jax.config, which wins over the env var, so
 the suite runs hermetically on a virtual 8-device CPU mesh — mirroring how
 the driver's dryrun_multichip check runs. Real-TPU runs happen only in
 bench.py.
+
+Under `make sanitize` (JYLIS_SANITIZE=1) jax must NOT be imported at all:
+the ASAN runtime is LD_PRELOADed before jaxlib's pybind11 modules load,
+and its __cxa_throw interceptor aborts on their C++ exceptions. The
+sanitized subset (tests/test_native_resp.py, tests/test_native_drive.py)
+is deliberately jax-free, so the mesh setup is skipped rather than
+poisoning the run.
 """
 
-from jylis_tpu.utils.vcpu import force_virtual_cpu
+import os
 
-force_virtual_cpu(8)
+if not os.environ.get("JYLIS_SANITIZE"):
+    from jylis_tpu.utils.vcpu import force_virtual_cpu
+
+    force_virtual_cpu(8)

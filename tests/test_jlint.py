@@ -1,0 +1,516 @@
+"""Self-tests for the repo-native static analyzer (scripts/jlint).
+
+Every rule gets fixture snippets that MUST trigger and snippets that
+MUST NOT; the suppression machinery (inline slugs + the committed
+baseline, including stale-entry detection) and the pass-3 parity
+extraction are pinned; and the whole analyzer must run CLEAN on the
+repo itself — which is simultaneously the check that the committed
+baseline contains no stale entries (jlint fails on them)."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from scripts import jlint  # noqa: E402
+from scripts.jlint import pass_async, pass_jax, pass_parity  # noqa: E402
+
+
+def analyze(tmp_path, code: str, which=pass_async):
+    p = tmp_path / "snippet.py"
+    p.write_text(code)
+    src = jlint.Source.load(str(p), root=str(tmp_path))
+    findings = which.run([src])
+    jlint.apply_suppressions(findings, {src.rel: src})
+    return [f for f in findings if not f.suppressed], findings
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---- JL001 broad except -----------------------------------------------------
+
+
+def test_broad_except_triggers(tmp_path):
+    bad, _ = analyze(tmp_path, """
+try:
+    x = 1
+except Exception as e:
+    pass
+try:
+    y = 2
+except:
+    pass
+""")
+    assert [f.rule for f in bad] == ["JL001", "JL001"]
+
+
+def test_broad_except_not_triggered(tmp_path):
+    bad, _ = analyze(tmp_path, """
+try:
+    x = 1
+except (OSError, ValueError):
+    pass
+try:
+    y = 2
+except Exception:  # jlint: broad-ok — fixture justification
+    pass
+""")
+    assert not bad
+
+
+# ---- JL101 blocking in async ------------------------------------------------
+
+
+def test_blocking_in_async_triggers(tmp_path):
+    bad, _ = analyze(tmp_path, """
+import asyncio, os, time
+
+async def handler(self):
+    time.sleep(1)
+    os.fsync(3)
+    self._journal.close()
+    open("/tmp/x")
+""")
+    assert [f.rule for f in bad] == ["JL101"] * 4
+
+
+def test_blocking_in_async_not_triggered(tmp_path):
+    bad, _ = analyze(tmp_path, """
+import asyncio, os, time
+
+def sync_path():
+    time.sleep(1)  # sync function: fine
+    os.fsync(3)
+
+async def handler(self):
+    await asyncio.to_thread(self._journal.close)  # dispatched, not called
+    await asyncio.sleep(1)
+
+    def helper():
+        time.sleep(0.1)  # nested sync def: runs only when called
+""")
+    assert not bad
+
+
+# ---- JL102 shared attrs -----------------------------------------------------
+
+
+SHARED_BAD = """
+import threading
+
+class J:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.state = 0
+        self._t = threading.Thread(target=self._run)
+
+    def _run(self):
+        self.state = 1  # thread side, unguarded
+
+    def poke(self):
+        self.state = 2  # loop side, unguarded
+"""
+
+
+def test_shared_attr_triggers(tmp_path):
+    bad, _ = analyze(tmp_path, SHARED_BAD)
+    assert rules_of(bad) == ["JL102"]
+    assert len(bad) == 2  # both unguarded stores
+
+
+def test_shared_attr_not_triggered_with_guard_or_marker(tmp_path):
+    bad, _ = analyze(tmp_path, """
+import threading
+
+class J:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.state = 0
+        self.only_thread = 0
+        self._t = threading.Thread(target=self._run)
+
+    def _run(self):
+        with self._lock:
+            self.state = 1  # guarded
+        self.only_thread = 2  # single-side mutation: fine
+
+    def poke(self):
+        self.state = 2  # jlint: shared-ok — fixture protocol note
+""")
+    assert not bad
+
+
+def test_to_thread_counts_as_thread_entry(tmp_path):
+    bad, _ = analyze(tmp_path, """
+import asyncio
+
+class M:
+    async def go(self):
+        await asyncio.to_thread(self._work)
+
+    def _work(self):
+        self.n = 1
+
+    def reset(self):
+        self.n = 0
+""")
+    assert rules_of(bad) == ["JL102"]
+
+
+# ---- JL103 rmw across await -------------------------------------------------
+
+
+def test_rmw_across_await_triggers(tmp_path):
+    bad, _ = analyze(tmp_path, """
+class C:
+    async def a(self):
+        self.count += await self.fetch()
+
+    async def b(self):
+        n = self.count
+        await self.fetch()
+        self.count = n + 1
+""")
+    assert [f.rule for f in bad] == ["JL103", "JL103"]
+
+
+def test_rmw_across_await_not_triggered(tmp_path):
+    bad, _ = analyze(tmp_path, """
+class C:
+    async def a(self):
+        n = await self.fetch()
+        self.count = n  # plain store, no stale read
+
+    async def b(self):
+        n = self.count
+        self.count = n + 1  # no await in between
+        await self.fetch()
+""")
+    assert not bad
+
+
+# ---- JL104 blocking I/O under lock ------------------------------------------
+
+
+def test_lock_io_triggers(tmp_path):
+    bad, _ = analyze(tmp_path, """
+import os
+
+class J:
+    def rotate(self):
+        with self._cv:
+            os.fsync(3)
+            os.replace("a", "b")
+""")
+    assert [f.rule for f in bad] == ["JL104", "JL104"]
+
+
+def test_lock_io_not_triggered_outside_lock(tmp_path):
+    bad, _ = analyze(tmp_path, """
+import os
+
+class J:
+    def rotate(self):
+        with self._cv:
+            f = self._f
+            self._f = None
+        os.fsync(f.fileno())  # outside the lock: the fixed shape
+        with open("/tmp/x") as fh:  # plain context manager, not a lock
+            fh.read()
+""")
+    assert not bad
+
+
+# ---- JL201 host sync in jit -------------------------------------------------
+
+
+def test_host_sync_triggers(tmp_path):
+    bad, _ = analyze(tmp_path, """
+import jax
+import numpy as np
+
+@jax.jit
+def f(x):
+    return float(x) + x.item()
+
+@jax.jit
+def g(x):
+    return helper(x)
+
+def helper(x):
+    return np.asarray(x)  # reachable from g
+""", pass_jax)
+    assert [f.rule for f in bad] == ["JL201"] * 3
+
+
+def test_host_sync_not_triggered_outside_jit(tmp_path):
+    bad, _ = analyze(tmp_path, """
+import numpy as np
+
+def host_prep(x):
+    return np.asarray(x)  # host code: fine
+
+def also_host(x):
+    return float(x)
+""", pass_jax)
+    assert not bad
+
+
+# ---- JL202 data-dependent branch --------------------------------------------
+
+
+def test_traced_branch_triggers(tmp_path):
+    bad, _ = analyze(tmp_path, """
+import jax
+
+@jax.jit
+def f(x):
+    if x > 0:
+        return x
+    return -x
+""", pass_jax)
+    assert [f.rule for f in bad] == ["JL202"]
+
+
+def test_traced_branch_not_triggered_on_static(tmp_path):
+    bad, _ = analyze(tmp_path, """
+import jax
+from functools import partial
+
+@partial(jax.jit, static_argnames=("mode",))
+def f(x, mode):
+    if mode:  # static arg: fine
+        return x
+    while x.shape[0] > 1:  # shape: trace-time constant
+        x = x[:1]
+    if x is None:  # identity test: fine
+        return x
+    return x
+
+@jax.jit
+def g(plane, width):
+    w = plane.shape[-1]
+    if width == w:  # compared against shape-derived local: fine
+        return plane
+    return plane
+""", pass_jax)
+    assert not bad
+
+
+# ---- JL203 dtype-implicit constructors --------------------------------------
+
+
+def test_dtype_implicit_triggers(tmp_path):
+    bad, _ = analyze(tmp_path, """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def f(x):
+    return jnp.zeros((4,)) + x
+""", pass_jax)
+    assert [f.rule for f in bad] == ["JL203"]
+
+
+def test_dtype_explicit_or_guarded_not_triggered(tmp_path):
+    bad, _ = analyze(tmp_path, """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def f(x):
+    a = jnp.zeros((4,), dtype=jnp.uint32)
+    b = jnp.full((4,), 0, x.dtype)  # positional dtype
+    with enable_x64(False):
+        c = jnp.ones((4,))  # inside the documented guard
+    return a + b + c
+""", pass_jax)
+    assert not bad
+
+
+# ---- JL204 jit in hot path --------------------------------------------------
+
+
+def test_jit_in_function_body_triggers(tmp_path):
+    bad, _ = analyze(tmp_path, """
+import jax
+
+def serve(x):
+    fn = jax.jit(lambda y: y + 1)
+    return fn(x)
+""", pass_jax)
+    assert [f.rule for f in bad] == ["JL204"]
+
+
+def test_jit_at_module_or_setup_not_triggered(tmp_path):
+    bad, _ = analyze(tmp_path, """
+import jax
+from functools import partial
+
+@partial(jax.jit, static_argnames=("k",))
+def decorated(x, k):
+    return x
+
+hoisted = jax.jit(lambda y: y + 1)
+
+def make_kernel():
+    return jax.jit(lambda y: y * 2)  # setup-named function: fine
+""", pass_jax)
+    assert not bad
+
+
+# ---- suppression + baseline machinery ---------------------------------------
+
+
+def test_stale_baseline_entry_fails(tmp_path):
+    bad, _ = analyze(tmp_path, "try:\n    pass\nexcept Exception:\n    pass\n")
+    problems = jlint.apply_baseline(
+        bad,
+        [
+            {"rule": "JL001", "file": bad[0].path,
+             "match": "except Exception", "reason": "fixture"},
+            {"rule": "JL101", "file": "nope.py",
+             "match": "never-matches", "reason": "stale fixture"},
+        ],
+    )
+    assert all(f.suppressed for f in bad)  # first entry matched
+    assert len(problems) == 1 and problems[0].rule == "JL900"
+    assert "stale" in problems[0].msg
+
+
+def test_baseline_entry_without_reason_fails(tmp_path):
+    bad, _ = analyze(tmp_path, "try:\n    pass\nexcept Exception:\n    pass\n")
+    problems = jlint.apply_baseline(
+        bad,
+        [{"rule": "JL001", "file": bad[0].path,
+          "match": "except Exception", "reason": "  "}],
+    )
+    assert len(problems) == 1 and "reason" in problems[0].msg
+
+
+# ---- pass 3: parity extraction ----------------------------------------------
+
+
+FAKE_ENGINE = """
+int f() {
+    if (argc >= 1 && word_is(buf, offs[0], lens[0], "GCOUNT")) which = 0;
+    if (argc >= 1 && word_is(buf, offs[0], lens[0], "PNCOUNT")) which = 1;
+    if (which >= 0) {
+        if (argc >= 3 && word_is(buf, offs[1], lens[1], "GET")) { }
+        if (argc >= 4 && word_is(buf, offs[1], lens[1], "INC")) { }
+        if (which == 1 && argc >= 4 &&
+            word_is(buf, offs[1], lens[1], "DEC")) { }
+    }
+    if (argc >= 1 && word_is(buf, offs[0], lens[0], "TREG")) {
+        if (argc >= 3 && word_is(buf, offs[1], lens[1], "GET")) { }
+        if (argc >= 5 && word_is(buf, offs[1], lens[1], "SET")) { }
+    }
+}
+"""
+
+FAKE_REPO = '''
+class RepoTREG:
+    name = "TREG"
+
+    def apply(self, resp, args):
+        op = args[0]
+        if op == b"GET":
+            pass
+        if op in (b"SET", b"CAS"):
+            pass
+
+    def may_drain(self, args):
+        return args[0] == b"NOTACOMMAND"  # outside apply: ignored
+'''
+
+
+def test_native_extraction(tmp_path):
+    p = tmp_path / "serve_engine.cpp"
+    p.write_text(FAKE_ENGINE)
+    surface = pass_parity.extract_native(str(p))
+    assert surface == {
+        "GCOUNT": ["GET", "INC"],
+        "PNCOUNT": ["DEC", "GET", "INC"],
+        "TREG": ["GET", "SET"],
+    }
+
+
+def test_python_extraction(tmp_path):
+    d = tmp_path / "models"
+    d.mkdir()
+    (d / "repo_treg.py").write_text(FAKE_REPO)
+    surface = pass_parity.extract_python(str(d))
+    assert surface == {"TREG": ["CAS", "GET", "SET"]}
+
+
+def test_native_only_command_fails(tmp_path):
+    manifest = pass_parity.build_manifest(
+        native={"TREG": ["GET", "SET", "ZAP"]},
+        python={"TREG": ["GET", "SET"]},
+    )
+    (tmp_path / "m.json").write_text(json.dumps(manifest))
+    findings = pass_parity.check(
+        str(tmp_path / "m.json"),
+        native={"TREG": ["GET", "SET", "ZAP"]},
+        python={"TREG": ["GET", "SET"]},
+    )
+    assert any(f.rule == "JL301" and "ZAP" in f.msg for f in findings)
+
+
+def test_manifest_drift_fails(tmp_path):
+    stale = pass_parity.build_manifest(
+        native={"TREG": ["GET"]}, python={"TREG": ["GET"]}
+    )
+    (tmp_path / "m.json").write_text(json.dumps(stale))
+    findings = pass_parity.check(
+        str(tmp_path / "m.json"),
+        native={"TREG": ["GET", "SET"]},
+        python={"TREG": ["GET", "SET"]},
+    )
+    assert any(f.rule == "JL302" for f in findings)
+
+
+def test_missing_manifest_fails(tmp_path):
+    findings = pass_parity.check(
+        str(tmp_path / "nope.json"),
+        native={"TREG": ["GET"]}, python={"TREG": ["GET"]},
+    )
+    assert any(f.rule == "JL302" for f in findings)
+
+
+# ---- the real repo ----------------------------------------------------------
+
+
+def test_real_repo_manifest_matches_committed():
+    """The committed parity manifest equals what the sources extract to
+    RIGHT NOW — i.e. `make lint` would not fail on drift."""
+    assert pass_parity.check() == []
+
+
+def test_real_native_surface_is_python_subset():
+    native = pass_parity.extract_native()
+    python = pass_parity.extract_python()
+    for t, subs in native.items():
+        assert set(subs) <= set(python.get(t, [])), (t, subs)
+    # the oracle-only commands are exactly the declared deferrals
+    manifest = json.load(open(jlint.MANIFEST_PATH))
+    assert manifest["python_only"] == {
+        "SYSTEM": ["GETLOG", "METRICS", "VERSION"],
+        "TLOG": ["CLR", "TRIM", "TRIMAT"],
+    }
+
+
+def test_full_jlint_run_is_clean_including_baseline():
+    """The analyzer exits 0 on the repo: no unsuppressed findings, no
+    stale baseline entries (stale entries produce JL900 findings, which
+    fail the run), no parity drift."""
+    from scripts.jlint.__main__ import run_all
+
+    assert run_all() == 0

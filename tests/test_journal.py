@@ -391,3 +391,229 @@ def test_node_boot_recovers_from_journal_alone(tmp_path):
         )
     finally:
         stop_node(proc)
+
+
+def test_rotation_never_blocks_appends(tmp_path, monkeypatch):
+    """Pins the jlint JL104 fix: rotate_begin must do its fsync/fold/
+    rename disk I/O OUTSIDE the condition variable. With the old
+    cv-held-across-I/O rotation, the serving loop's append() blocked
+    behind the disk for the whole rotation (up to a 64 MB segment fold);
+    now appends enqueue at memory speed while the writer sleeps under
+    the _paused hand-off, and every batch appended mid-rotation lands in
+    the FRESH segment."""
+    import threading
+    import time as time_mod
+
+    j = Journal(str(tmp_path / "j.jylis"), fsync="always")
+    j.open()
+    j.append("GCOUNT", [(b"before", {1: 1})])
+    j.flush()
+
+    real_fsync = os.fsync
+    slow = threading.Event()
+
+    def slow_fsync(fd):
+        slow.set()
+        time_mod.sleep(0.5)  # a slow disk under rotation
+        real_fsync(fd)
+
+    monkeypatch.setattr(os, "fsync", slow_fsync)
+    rot = threading.Thread(target=j.rotate_begin)
+    rot.start()
+    assert slow.wait(10), "rotation never reached its fsync"
+    t0 = time_mod.monotonic()
+    j.append("GCOUNT", [(b"during", {1: 2})])
+    append_s = time_mod.monotonic() - t0
+    rot.join(timeout=30)
+    assert not rot.is_alive()
+    monkeypatch.setattr(os, "fsync", real_fsync)
+    assert append_s < 0.2, (
+        f"append blocked {append_s:.3f}s behind rotation disk I/O"
+    )
+    j.flush()
+    j.close()
+
+    # the mid-rotation batch landed in the FRESH segment (the retired
+    # one holds only the pre-rotation batch)
+    msgs, _, _ = journal_mod.journal.read_journal(j.path)
+    assert [m.batch[0][0] for m in msgs] == [b"during"]
+    msgs, _, _ = journal_mod.journal.read_journal(j.retiring_path())
+    assert [m.batch[0][0] for m in msgs] == [b"before"]
+
+
+def test_failed_rotation_resumes_writer_and_retries(tmp_path, monkeypatch):
+    """A rotation that dies on disk I/O must clear the writer pause and
+    the rotation latch, record the error, and RE-ASK for rotation when
+    the writer next drops an undurable batch — in size-triggered-only
+    mode (--snapshot-interval 0) that re-ask is the only thing that can
+    ever re-open the segment."""
+    asks = []
+    j = make_journal(tmp_path)
+    j.rotate_notify = lambda: asks.append(1)
+    j.append("GCOUNT", [(b"a", {1: 1})])
+    j.flush()
+
+    real_replace = os.replace
+
+    def boom(src, dst):
+        raise OSError("disk gone")
+
+    monkeypatch.setattr(os, "replace", boom)
+    j.rotate_begin()  # swallows the OSError, resumes unpaused
+    assert isinstance(j.last_error, OSError)
+    # _f is None: the next batch drains undurable — counted, and the
+    # writer re-asks for the rotation that would re-open the segment
+    j.append("GCOUNT", [(b"dropped", {1: 2})])
+    j.flush()
+    assert asks, "writer never re-asked for rotation after the failure"
+    # the disk "comes back": the retried rotation re-opens the segment
+    # and journaling resumes
+    monkeypatch.setattr(os, "replace", real_replace)
+    j.rotate_begin()
+    j.append("GCOUNT", [(b"recovered", {1: 3})])
+    j.flush()
+    j.close()
+    msgs, _, _ = journal_mod.journal.read_journal(j.path)
+    assert [m.batch[0][0] for m in msgs] == [b"recovered"]
+
+
+def test_rotation_failed_after_rename_still_recovers(tmp_path, monkeypatch):
+    """A rotation that renamed the active segment aside but died before
+    opening the fresh one must not wedge every retry on the missing
+    file: the retry re-opens a fresh segment and journaling resumes."""
+    j = make_journal(tmp_path)
+    j.append("GCOUNT", [(b"a", {1: 1})])
+    j.flush()
+
+    real_open_fresh = Journal._open_fresh_file
+
+    def boom(self):
+        raise OSError("EMFILE")
+
+    monkeypatch.setattr(Journal, "_open_fresh_file", boom)
+    j.rotate_begin()  # rename happened, fresh open failed
+    assert isinstance(j.last_error, OSError)
+    assert os.path.exists(j.retiring_path())
+    assert not os.path.exists(j.path)
+
+    monkeypatch.setattr(Journal, "_open_fresh_file", real_open_fresh)
+    j.rotate_begin()  # retry: no active segment to retire, just re-open
+    j.append("GCOUNT", [(b"recovered", {1: 2})])
+    j.flush()
+    j.close()
+    msgs, _, _ = journal_mod.journal.read_journal(j.path)
+    assert [m.batch[0][0] for m in msgs] == [b"recovered"]
+    # the pre-failure batch is still in the retired segment
+    msgs, _, _ = journal_mod.journal.read_journal(j.retiring_path())
+    assert [m.batch[0][0] for m in msgs] == [b"a"]
+
+
+def test_concurrent_rotations_serialise(tmp_path, monkeypatch):
+    """Shutdown's final rotation can overlap the compaction loop's
+    in-flight one (cancelling the loop task cannot stop its to_thread
+    worker): the _paused hand-off must serialise them — both complete,
+    the active segment stays valid, and nothing leaks a detached file."""
+    import threading
+    import time as time_mod
+
+    j = Journal(str(tmp_path / "j.jylis"), fsync="always")
+    j.open()
+    j.append("GCOUNT", [(b"a", {1: 1})])
+    j.flush()
+
+    real_fsync = os.fsync
+
+    def slow_fsync(fd):
+        time_mod.sleep(0.2)
+        real_fsync(fd)
+
+    monkeypatch.setattr(os, "fsync", slow_fsync)
+    threads = [threading.Thread(target=j.rotate_begin) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not any(t.is_alive() for t in threads)
+    monkeypatch.setattr(os, "fsync", real_fsync)
+    assert j.last_error is None, j.last_error
+    assert j._f is not None, "a rotation left the journal with no segment"
+    j.append("GCOUNT", [(b"b", {1: 2})])
+    j.flush()
+    j.close()
+    msgs, _, _ = journal_mod.journal.read_journal(j.path)
+    assert [m.batch[0][0] for m in msgs] == [b"b"]
+
+
+def test_shutdown_closes_journal_off_the_loop(tmp_path):
+    """Pins the jlint JL101 fix in main.Dispose._shutdown: journal.close
+    joins the writer thread and fsyncs, so it must run via
+    asyncio.to_thread, never on the event loop itself."""
+    import asyncio
+    import threading
+
+    from jylis_tpu.main import Dispose
+
+    closed_on: list = []
+
+    class _Journal:
+        def close(self):
+            closed_on.append(threading.current_thread())
+
+    class _Server:
+        async def dispose(self):
+            pass
+
+    class _Cluster:
+        def dispose(self):
+            pass
+
+    class _Db:
+        async def clean_shutdown_async(self):
+            pass
+
+    async def drive():
+        d = Dispose(_Db(), _Server(), _Cluster(), snapshot_path="",
+                    journal=_Journal())
+        await d._shutdown()
+        return threading.current_thread()
+
+    loop_thread = asyncio.run(drive())
+    assert closed_on and closed_on[0] is not loop_thread, (
+        "journal.close ran on the event-loop thread"
+    )
+
+
+def test_shutdown_survives_journal_close_failure(tmp_path):
+    """A journal whose final flush/fsync raises (full disk at shutdown)
+    must not abort _shutdown's finally block: the listeners still stop
+    and `done` is still set, or the node would hang until SIGKILL."""
+    import asyncio
+
+    from jylis_tpu.main import Dispose
+
+    disposed = []
+
+    class _Journal:
+        def close(self):
+            raise OSError("disk full")
+
+    class _Server:
+        async def dispose(self):
+            disposed.append("server")
+
+    class _Cluster:
+        def dispose(self):
+            disposed.append("cluster")
+
+    class _Db:
+        async def clean_shutdown_async(self):
+            pass
+
+    async def drive():
+        d = Dispose(_Db(), _Server(), _Cluster(), snapshot_path="",
+                    journal=_Journal())
+        await d._shutdown()
+        return d.done.is_set()
+
+    assert asyncio.run(drive()) is True
+    assert disposed == ["cluster", "server"]

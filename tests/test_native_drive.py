@@ -1,0 +1,272 @@
+"""Deliberately jax-free drive of the native serving engine — the
+`make sanitize` vehicle.
+
+The ASAN+UBSAN build (`make sanitize`) runs this module (plus the RESP
+scanner differentials in test_native_resp.py) with the sanitizer runtime
+LD_PRELOADed; jax cannot be imported there (jaxlib's pybind11 C++
+exceptions abort under the ASAN interceptor), so everything here drives
+``ServeEngine`` via ctypes only: full pipelined bursts through
+``scan_apply`` over all five types, the reply-buffer flush (rc 2) and
+defer (rc 1) boundaries, protocol errors, the UJSON render memo and
+write queue, TLOG interner compaction, and the bulk delta exports. In
+the regular suite it doubles as an engine integration test.
+
+Keep this module importable without jax: no jylis_tpu.models /
+jylis_tpu.ops imports.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from jylis_tpu.native import lib
+from jylis_tpu.native.engine import ServeEngine
+
+
+@pytest.fixture
+def eng() -> ServeEngine:
+    cdll = lib()
+    assert cdll is not None, "native library must build in this environment"
+    return ServeEngine(cdll)
+
+
+def resp(*args: bytes) -> bytes:
+    out = b"*%d\r\n" % len(args)
+    for a in args:
+        out += b"$%d\r\n%s\r\n" % (len(a), a)
+    return out
+
+
+def drain_native(eng, burst: bytes):
+    """Feed a whole burst; collect replies and deferred commands until
+    the engine stops (rc 0/-1/-2)."""
+    buf = bytearray(burst)
+    replies = b""
+    deferred = []
+    rc = 0
+    while True:
+        rc, consumed, out, unhandled, _changed = eng.scan_apply(buf)
+        replies += out
+        del buf[:consumed]
+        if rc == 1:
+            deferred.append(unhandled)
+            continue
+        if rc == 2:
+            continue
+        return rc, replies, deferred, bytes(buf)
+
+
+def test_counter_burst_and_reply_order(eng):
+    burst = (
+        resp(b"GCOUNT", b"INC", b"k", b"5")
+        + resp(b"GCOUNT", b"GET", b"k")
+        + resp(b"PNCOUNT", b"INC", b"k", b"9")
+        + resp(b"PNCOUNT", b"DEC", b"k", b"11")
+        + resp(b"PNCOUNT", b"GET", b"k")
+        + resp(b"GCOUNT", b"GET", b"nope")
+    )
+    rc, replies, deferred, rest = drain_native(eng, burst)
+    assert (rc, rest) == (0, b"")
+    assert not deferred
+    assert replies == b"+OK\r\n:5\r\n+OK\r\n+OK\r\n:-2\r\n:0\r\n"
+    served = eng.served_counts()
+    assert served["GCOUNT"] == 3 and served["PNCOUNT"] == 3
+
+
+def test_gcount_dec_is_not_native(eng):
+    """GCOUNT has no DEC: the command must defer to the Python oracle
+    (which renders the help text) — parity manifest territory."""
+    rc, replies, deferred, _ = drain_native(
+        eng, resp(b"GCOUNT", b"DEC", b"k", b"1")
+    )
+    assert rc == 0 and replies == b""
+    assert deferred == [[b"GCOUNT", b"DEC", b"k", b"1"]]
+
+
+def test_treg_set_get_and_big_value_rc2(eng):
+    rc, replies, deferred, _ = drain_native(
+        eng,
+        resp(b"TREG", b"SET", b"r", b"hello", b"7")
+        + resp(b"TREG", b"GET", b"r")
+        + resp(b"TREG", b"GET", b"missing"),
+    )
+    assert rc == 0 and not deferred
+    assert replies == b"+OK\r\n*2\r\n$5\r\nhello\r\n:7\r\n$-1\r\n"
+
+    # a value larger than the 64 KiB reply buffer: SET banks it fine,
+    # GET alone outgrows the buffer -> defers to Python (rc 1); with a
+    # small reply already buffered the engine first asks for a flush
+    # (rc 2) and THEN defers — both paths covered by drain_native
+    big = b"v" * (1 << 17)
+    rc, replies, deferred, _ = drain_native(
+        eng,
+        resp(b"TREG", b"SET", b"big", big, b"9")
+        + resp(b"GCOUNT", b"INC", b"pad", b"1")
+        + resp(b"TREG", b"GET", b"big"),
+    )
+    assert rc == 0
+    assert replies == b"+OK\r\n+OK\r\n"
+    assert deferred == [[b"TREG", b"GET", b"big"]]
+
+
+def test_treg_lww_winner_rule(eng):
+    burst = (
+        resp(b"TREG", b"SET", b"r", b"aa", b"5")
+        + resp(b"TREG", b"SET", b"r", b"zz", b"5")  # same ts: value wins
+        + resp(b"TREG", b"SET", b"r", b"old", b"4")  # older ts: loses
+        + resp(b"TREG", b"GET", b"r")
+    )
+    rc, replies, _, _ = drain_native(eng, burst)
+    assert rc == 0
+    assert replies.endswith(b"*2\r\n$2\r\nzz\r\n:5\r\n")
+
+
+def test_tlog_ins_size_get_cutoff(eng):
+    burst = (
+        resp(b"TLOG", b"INS", b"l", b"e1", b"10")
+        + resp(b"TLOG", b"INS", b"l", b"e2", b"20")
+        + resp(b"TLOG", b"INS", b"l", b"e2", b"20")  # dup: merged view dedups
+        + resp(b"TLOG", b"SIZE", b"l")
+        + resp(b"TLOG", b"GET", b"l")
+        + resp(b"TLOG", b"GET", b"l", b"1")
+        + resp(b"TLOG", b"CUTOFF", b"l")
+        + resp(b"TLOG", b"SIZE", b"missing")
+        + resp(b"TLOG", b"GET", b"missing")
+    )
+    rc, replies, deferred, _ = drain_native(eng, burst)
+    assert rc == 0 and not deferred
+    assert replies == (
+        b"+OK\r\n+OK\r\n+OK\r\n:2\r\n"
+        b"*2\r\n*2\r\n$2\r\ne2\r\n:20\r\n*2\r\n$2\r\ne1\r\n:10\r\n"
+        b"*1\r\n*2\r\n$2\r\ne2\r\n:20\r\n"
+        b":0\r\n:0\r\n*0\r\n"
+    )
+    # TRIM dispatches a device drain: never native
+    rc, _, deferred, _ = drain_native(eng, resp(b"TLOG", b"TRIM", b"l", b"1"))
+    assert deferred == [[b"TLOG", b"TRIM", b"l", b"1"]]
+
+
+def test_tlog_interner_compaction_remaps_live_vids(eng):
+    # intern far more values than the compaction floor, then converge
+    # the rows away so most become garbage
+    row = eng.tlog_upsert(b"l")
+    eng.tlog_ins(row, 1000, b"val-0")
+    # build the merged-view memo now (a SIZE does it); subsequent ins
+    # calls then maintain it, so the drain below carries a valid base
+    assert eng.tlog_size(row) == 1
+    for i in range(1, 9000):
+        eng.tlog_ins(row, 1000 + i, b"val-%d" % i)  # ts 1000..9999
+    eng.tlog_flush_deltas()  # drop the delta accumulator's references
+    # a drain that trimmed to cutoff 9998 keeps exactly ts 9998, 9999:
+    # the memo is current (ins maintains it), so the carried base is the
+    # filtered memo and stays valid
+    eng.tlog_finish_row(row, 2, 9998)
+    eng.tlog_finish_end()
+    assert eng.tlog_compact() in (True, False)
+    # force: repeat until the floor logic actually compacts or stabilises
+    for _ in range(3):
+        if eng.tlog_compact():
+            break
+    size = eng.tlog_size(row)
+    assert size == eng.tlog_len_cache(row)
+    # the carried base must still resolve through the remapped interner
+    ents = eng.tlog_merged_entries(row)
+    assert ents is not None and len(ents) == size
+    for ts, val in ents:
+        assert val.startswith(b"val-")
+
+
+def test_ujson_validate_bank_and_memo(eng):
+    # valid writes bank natively (+OK), invalid ones defer to the oracle
+    burst = (
+        resp(b"UJSON", b"INS", b"d", b"tags", b'"x"')
+        + resp(b"UJSON", b"SET", b"d", b"obj", b'{"a": [1, 2.5e3, null]}')
+        + resp(b"UJSON", b"RM", b"d", b"tags", b'"x"')
+        + resp(b"UJSON", b"CLR", b"d", b"obj")
+        + resp(b"UJSON", b"INS", b"d", b"bad", b"{not json}")
+        + resp(b"UJSON", b"INS", b"d", b"ctl", b'"a\x01b"')
+        + resp(b"UJSON", b"SET", b"d", b"deep", b"[" * 100 + b"]" * 100)
+    )
+    rc, replies, deferred, _ = drain_native(eng, burst)
+    assert rc == 0
+    assert replies == b"+OK\r\n" * 4
+    assert [d[1] for d in deferred] == [b"INS", b"INS", b"SET"]
+    banked = eng.uq_drain()
+    assert [b[0] for b in banked] == [b"INS", b"SET", b"RM", b"CLR"]
+    assert banked[0] == [b"INS", b"d", b"tags", b'"x"']
+    assert eng.uq_count() == 0
+
+    # GET misses defer; after the oracle installs a render, it serves
+    # natively; an overlapping write invalidates exactly the prefix
+    rc, _, deferred, _ = drain_native(eng, resp(b"UJSON", b"GET", b"d"))
+    assert deferred == [[b"UJSON", b"GET", b"d"]]
+    eng.uj_memo_put(b"d", [], b"$9\r\n{\"a\":123}\r\n")
+    eng.uj_memo_put(b"d", [b"a"], b"$3\r\n123\r\n")
+    rc, replies, deferred, _ = drain_native(
+        eng, resp(b"UJSON", b"GET", b"d") + resp(b"UJSON", b"GET", b"d", b"a")
+    )
+    assert rc == 0 and not deferred
+    assert replies == b"$9\r\n{\"a\":123}\r\n$3\r\n123\r\n"
+    assert eng.uj_memo_len(b"d") == 2
+    # INS under a.b invalidates the renders at prefixes "" and "a"
+    rc, replies, _, _ = drain_native(
+        eng, resp(b"UJSON", b"INS", b"d", b"a", b"b", b"1")
+    )
+    assert replies == b"+OK\r\n"
+    assert eng.uj_memo_len(b"d") == 0
+
+
+def test_ujson_utf8_path_gate(eng):
+    # invalid UTF-8 in a path component defers (the memo key must be
+    # canonical bytes); valid raw UTF-8 banks natively
+    rc, replies, deferred, _ = drain_native(
+        eng,
+        resp(b"UJSON", b"INS", b"d", b"\xff\xfe", b"1")
+        + resp(b"UJSON", b"INS", b"d", "café".encode(), b"2"),
+    )
+    assert rc == 0
+    assert replies == b"+OK\r\n"
+    assert deferred == [[b"UJSON", b"INS", b"d", b"\xff\xfe", b"1"]]
+
+
+def test_protocol_error_and_oversized_command(eng):
+    rc, replies, deferred, rest = drain_native(
+        eng, resp(b"GCOUNT", b"INC", b"k", b"1") + b"*1\r\n$bogus\r\n"
+    )
+    assert rc == -1
+    assert replies == b"+OK\r\n"
+    # an arg-count overflow reports rc -2 (caller grows and demotes)
+    many = resp(*([b"GCOUNT", b"GET"] + [b"k"] * 2000))
+    rc, _, _, _ = drain_native(eng, many)
+    assert rc == -2
+
+
+def test_split_burst_resumes_mid_command(eng):
+    whole = resp(b"GCOUNT", b"INC", b"k", b"3") + resp(b"GCOUNT", b"GET", b"k")
+    for cut in (1, 7, len(whole) // 2, len(whole) - 2):
+        e = ServeEngine(lib())
+        buf = bytearray(whole[:cut])
+        rc, consumed, out, _, _ = e.scan_apply(buf)
+        assert rc == 0
+        del buf[:consumed]
+        buf += whole[cut:]
+        rc, consumed, out2, _, _ = e.scan_apply(buf)
+        assert rc == 0
+        assert (out + out2) == b"+OK\r\n:3\r\n"
+
+
+def test_bulk_delta_exports(eng):
+    rc, _, _, _ = drain_native(
+        eng,
+        resp(b"TREG", b"SET", b"r1", b"v1", b"1")
+        + resp(b"TREG", b"SET", b"r2", b"v2", b"2")
+        + resp(b"TLOG", b"INS", b"l1", b"e", b"5"),
+    )
+    assert rc == 0
+    treg = eng.treg_flush_deltas()
+    assert treg == [(b"r1", (b"v1", 1)), (b"r2", (b"v2", 2))]
+    tlog = eng.tlog_flush_deltas()
+    assert tlog == [(b"l1", ([(b"e", 5)], 0))]
+    # cleared: a second flush exports nothing
+    assert eng.treg_flush_deltas() == []
+    assert eng.tlog_flush_deltas() == []
