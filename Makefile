@@ -5,16 +5,17 @@ PY ?= python
 
 .PHONY: test soak bench bench-all bench-full bench-smoke native run clean \
         check-graft ci check-prose image compose-smoke smoke3 release \
-        lint sanitize
+        lint sanitize chaos
 
 # what CI runs per commit (.github/workflows/ci.yml + .circleci/config.yml):
 # hermetic on any host. `test` includes the journal suite
 # (tests/test_journal.py — append/replay, corruption classes, rotation, and
 # a real SIGKILL/restart boot); `lint` is the repo-native static analyzer
 # (scripts/jlint — async/thread safety, JAX trace discipline, native/Python
-# RESP surface parity); `sanitize` rebuilds the native engine under
-# ASAN+UBSAN with -Werror and re-runs the jax-free native test subset.
-ci: native lint test check-graft check-prose bench-smoke sanitize
+# RESP surface parity, failpoint manifest parity); `sanitize` rebuilds the
+# native engine under ASAN+UBSAN with -Werror and re-runs the jax-free
+# native test subset; `chaos` is the tiny fault-injection drill smoke.
+ci: native lint test chaos check-graft check-prose bench-smoke sanitize
 
 # the three jlint passes + the broad-except rule, against the committed
 # baseline (scripts/jlint/baseline.json — every entry justified in-line,
@@ -54,8 +55,17 @@ bench-smoke:
 test:
 	$(PY) -m pytest tests/ -x -q
 
+# tiny fault-injection drill smoke (seconds): a curated subset of the
+# drill matrix — dial backoff/reset/timeout drills, an FFI fault served
+# via demotion, the CLUSTER metrics surface — per commit via `make ci`.
+# The FULL {error,sleep,corrupt,drop,crash} x {every registered
+# failpoint} matrix runs nightly behind `-m soak` (make soak).
+chaos:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_drill_matrix.py -m chaos -q
+
 # nightly CI: the long-running real-process churn/crash drills, including
-# the SIGKILL-mid-traffic journal recovery soak
+# the SIGKILL-mid-traffic journal recovery soak and the full
+# fault-injection drill matrix (tests/test_drill_matrix.py)
 soak:
 	$(PY) -m pytest tests/ -q -m soak
 

@@ -31,7 +31,10 @@ guarantee the actor gave).
 from __future__ import annotations
 
 import asyncio
+import struct
+import zlib
 
+from .. import faults
 from ..ops.p2set import P2Set
 from ..utils.address import Address
 from ..utils.net import ipv4_port
@@ -68,19 +71,101 @@ SYNC_CHUNK_KEYS = 2048
 # re-splits by key, so a few huge values (an untrimmed TLOG, a wide UJSON
 # doc) cannot produce one arbitrarily large frame / encode stall
 SYNC_CHUNK_BYTES = 4 << 20
+# dial state machine defaults (overridable via --dial-timeout /
+# --dial-backoff-cap): connect attempts are bounded by DIAL_TIMEOUT
+# seconds (a blackholed peer must not hold a placeholder conn for the
+# OS's minutes-long TCP timeout), and consecutive dial failures back
+# off exponentially in heartbeat ticks up to DIAL_BACKOFF_CAP (plus a
+# deterministic jitter of up to half the backoff, so a cluster-wide
+# restart does not thundering-herd one recovering peer in lockstep)
+DIAL_TIMEOUT = 5.0
+DIAL_BACKOFF_CAP = 32
+
+# cluster transport integrity: every frame body is prefixed with its
+# CRC32 (schema v5). TCP checksums are weak (16-bit, and they end at
+# the kernel boundary); without this, the drill matrix demonstrated
+# that a single bit flip inside a sync-dump or push frame can decode
+# as a VALID message with a mutated counter value — which then
+# converges cluster-wide as forged lattice state, digest-matched and
+# permanently undetectable. With the CRC the corruption is detected at
+# the receiver, the connection dropped (Drop.CRC), and the redial +
+# sync heal re-ships the true state. The on-disk formats are unchanged:
+# the journal has its own per-frame CRC, snapshots are
+# write-then-rename + full validation.
+_WIRE_CRC_LEN = 4
+
+
+def wire_frame(body: bytes) -> bytes:
+    """One cluster transport frame: framing header + crc32(body) + body."""
+    return frame(struct.pack(">I", zlib.crc32(body)) + body)
+
+
+def check_frame(raw: bytes) -> bytes | None:
+    """CRC-validate one received frame; the payload, or None if corrupt."""
+    if len(raw) < _WIRE_CRC_LEN:
+        return None
+    (crc,) = struct.unpack_from(">I", raw)
+    payload = raw[_WIRE_CRC_LEN:]
+    return payload if zlib.crc32(payload) == crc else None
+
+
+class Drop:
+    """Connection teardown reasons — stamped into every `_drop` log line
+    and counted per reason in the CLUSTER metrics section."""
+
+    IDLE = "idle"
+    EOF = "eof"
+    HANDSHAKE = "handshake_mismatch"
+    CODEC = "codec_error"
+    CRC = "crc_mismatch"
+    WRITE_FAILED = "write_failed"
+    UNEXPECTED = "unexpected_msg"
+    DISPOSED = "disposed"
+    BLACKLISTED = "blacklisted"
+
+
+# active-conn teardown reasons that mean the PEER (not the network)
+# misbehaved after the TCP connect succeeded: an incompatible build
+# (rolling upgrade across a schema bump), a corrupting link, a protocol
+# violation. These engage the same dial backoff as a connect failure —
+# without this, a persistently incompatible peer whose TCP connect
+# works is re-dialed every heartbeat forever, the exact churn the
+# backoff machinery exists to bound. Ordinary churn (eof, idle,
+# write_failed) keeps the next-tick redial the reference promises.
+_PEER_FAULT_DROPS = frozenset(
+    {Drop.HANDSHAKE, Drop.CODEC, Drop.CRC, Drop.UNEXPECTED}
+)
+
+
+class _PeerState:
+    """Per-address dial lifecycle: consecutive failures and the earliest
+    tick the next dial may happen (exponential backoff, reset to 0 by a
+    successful establishment or by inbound contact from that address)."""
+
+    __slots__ = ("fails", "next_dial_tick", "dials")
+
+    def __init__(self):
+        self.fails = 0
+        self.next_dial_tick = 0
+        self.dials = 0  # total attempts (the drill's bounded-rate check)
 
 
 class _Conn:
     """One cluster TCP connection (either role), with its read task."""
 
     __slots__ = (
-        "writer", "active_addr", "established", "task", "sync_served_tick",
+        "writer", "active_addr", "peer_addr", "established", "task",
+        "sync_served_tick",
         "sync_digests", "sync_defer_streak", "sync_defer_last_tick",
     )
 
     def __init__(self, writer, active_addr: Address | None):
         self.writer = writer
         self.active_addr = active_addr  # None for passive conns
+        # advertised identity of a PASSIVE peer, learned from the v5
+        # handshake's dialer-address suffix (teardown log identity +
+        # the inbound-contact backoff reset); None until handshake
+        self.peer_addr: Address | None = None
         self.established = False
         self.task: asyncio.Task | None = None
         # tick of the last sync served on this conn (rate limit: repeated
@@ -110,6 +195,13 @@ class _Conn:
         if self.writer.transport.get_write_buffer_size() > self.WRITE_BUFFER_LIMIT:
             return False  # backpressure: treat as dead, caller drops us
         try:
+            # cluster.write: error -> conn treated dead (FaultError is a
+            # ConnectionError, caught below); corrupt -> receiver's codec
+            # refuses and drops us; drop -> silent send loss, healed only
+            # by the periodic digest sync — the drill's loss-window case
+            data = faults.point("cluster.write", data)
+            if data is None:
+                return True  # injected send loss: pretend delivered
             self.writer.write(data)
             return True
         except (ConnectionError, RuntimeError):
@@ -134,6 +226,23 @@ class Cluster:
         self._actives: dict[Address, _Conn] = {}
         self._passives: set[_Conn] = set()
         self._last_activity: dict[_Conn, int] = {}
+        # per-address dial lifecycle (timeout + exponential backoff with
+        # deterministic jitter) — replaces the redial-every-tick loop: a
+        # dead peer is re-dialed at a rate bounded by the backoff cap,
+        # not once per heartbeat, and inbound contact from an address
+        # resets its state so a rebooted peer is re-dialed immediately
+        self._peers: dict[Address, _PeerState] = {}
+        self._dial_timeout = getattr(config, "dial_timeout", DIAL_TIMEOUT)
+        self._backoff_cap = getattr(config, "dial_backoff_cap", DIAL_BACKOFF_CAP)
+        # CLUSTER metrics (SYSTEM METRICS): lifecycle counters + teardown
+        # reasons; live peer-state counts are computed on demand
+        self._stats = {
+            "dials": 0, "dial_fails": 0,
+            "sync_served": 0, "sync_deferred": 0,
+            "held_drops": 0,
+        }
+        self._drop_counts: dict[str, int] = {}
+        self._held_drop_episode = False  # warn once per eviction episode
         self._tick = 0
         self._serial = codec.signature()
         self._server: asyncio.base_events.Server | None = None
@@ -167,6 +276,12 @@ class Cluster:
         self._sync_rx_tick: int | None = None
         self._sync_serve_defer_total = 0  # consecutive defers, any conn
         self._sync_defer_total_tick: int | None = None
+        # SYSTEM METRICS' CLUSTER section reads straight from this
+        # instance (wired here, not in main, so in-process test nodes
+        # get the same observability as spawned ones)
+        system = getattr(database, "system", None)
+        if system is not None:
+            system.cluster_fn = self.metrics_totals
 
     # ---- lifecycle --------------------------------------------------------
 
@@ -194,7 +309,7 @@ class Cluster:
         if self._server is not None:
             self._server.close()
         for conn in list(self._actives.values()) + list(self._passives):
-            self._drop(conn)
+            self._drop(conn, Drop.DISPOSED)
 
     # ---- heartbeat --------------------------------------------------------
 
@@ -236,6 +351,39 @@ class Cluster:
         task.add_done_callback(self._flush_task_done)
         self._sync_actives()
 
+    def metrics_totals(self) -> dict[str, int]:
+        """The SYSTEM METRICS `CLUSTER` section: live peer-state counts
+        plus lifecycle counters. Keys are documented in
+        docs/operations.md (failure envelope glossary)."""
+        connecting = sum(
+            1 for c in self._actives.values() if not c.established
+        )
+        backoff = sum(
+            1
+            for a, st in self._peers.items()
+            if a not in self._actives
+            and a != self._addr
+            and a in self._known_addrs
+            and self._tick < st.next_dial_tick
+        )
+        out = {
+            "peers_known": max(len(self._known_addrs) - 1, 0),
+            "peers_established": len(self._actives) - connecting,
+            "peers_connecting": connecting,
+            "peers_backoff": backoff,
+            "passives": len(self._passives),
+            "dials": self._stats["dials"],
+            "dial_fails": self._stats["dial_fails"],
+            "evictions": sum(self._drop_counts.values()),
+            "sync_served": self._stats["sync_served"],
+            "sync_deferred": self._stats["sync_deferred"],
+            "held_now": len(self._held),
+            "held_drops": self._stats["held_drops"],
+        }
+        for reason in sorted(self._drop_counts):
+            out[f"drop_{reason}"] = self._drop_counts[reason]
+        return out
+
     def _flush_task_done(self, task) -> None:
         self._flush_tasks.discard(task)
         if not task.cancelled() and task.exception() is not None:
@@ -246,15 +394,25 @@ class Cluster:
     def _evict_idle(self) -> None:
         for conn, last in list(self._last_activity.items()):
             if self._tick - last > IDLE_TICKS_LIMIT:
-                self._log.info() and self._log.i("evicting idle connection")
-                self._drop(conn)
+                self._drop(conn, Drop.IDLE)
 
     def _sync_actives(self) -> None:
         """Dial an active connection to every known peer we lack
-        (cluster.pony:51-71); failures retry next tick."""
+        (cluster.pony:51-71). Unlike the reference's redial-every-tick
+        loop, each address runs a dial state machine: a failed dial
+        backs the address off exponentially (deterministic jitter,
+        capped), so an unreachable peer costs a bounded trickle of
+        attempts instead of one per heartbeat."""
         for addr in self._known_addrs:
             if addr == self._addr or addr in self._actives:
                 continue
+            st = self._peers.get(addr)
+            if st is None:
+                st = self._peers[addr] = _PeerState()
+            if self._tick < st.next_dial_tick:
+                continue  # backing off
+            st.dials += 1
+            self._stats["dials"] += 1
             loop = asyncio.get_running_loop()
             task = loop.create_task(self._dial(addr))
             conn = _Conn(writer=None, active_addr=addr)
@@ -264,9 +422,20 @@ class Cluster:
     # ---- active (outbound) connections ------------------------------------
 
     async def _dial(self, addr: Address) -> None:
+        async def connect():
+            # cluster.dial: error -> the OSError recovery path below;
+            # sleep -> a blackholed connect, which wait_for then bounds
+            await faults.async_point("cluster.dial")
+            return await asyncio.open_connection(addr.host, int(addr.port))
+
         try:
-            reader, writer = await asyncio.open_connection(addr.host, int(addr.port))
-        except (OSError, ValueError):
+            # the OS would let a blackholed connect hang for minutes;
+            # bound it so the placeholder conn frees (and backoff starts)
+            # within one predictable window
+            reader, writer = await asyncio.wait_for(
+                connect(), timeout=self._dial_timeout
+            )
+        except (OSError, ValueError, asyncio.TimeoutError):
             self._active_missed(addr)
             return
         conn = self._actives.get(addr)
@@ -275,14 +444,46 @@ class Cluster:
             return
         conn.writer = writer
         self._mark_activity(conn)  # handshake counts against the idle clock
-        conn.send_raw(frame(self._serial))  # handshake: our schema signature
+        # handshake: our schema signature, plus our advertised address so
+        # the passive side can identify this peer (teardown logs) and
+        # reset its own dial backoff toward us (inbound contact proves
+        # the address is alive again)
+        conn.send_raw(wire_frame(self._serial + codec.encode_addr(self._addr)))
         await self._read_loop(conn, reader, active=True)
 
     def _active_missed(self, addr: Address) -> None:
-        """Connect failure: drop the placeholder; the address stays known and
-        is re-dialed on the next sync (cluster_notify.pony:19-20,
-        cluster.pony:157-161)."""
+        """Connect failure: drop the placeholder and back the address
+        off — it stays known, and is re-dialed once the backoff window
+        passes (or immediately after inbound contact from it)."""
         self._actives.pop(addr, None)
+        self._stats["dial_fails"] += 1
+        st = self._peers.get(addr)
+        if st is None:
+            st = self._peers[addr] = _PeerState()
+        st.fails += 1
+        st.next_dial_tick = self._tick + self._backoff_ticks(addr, st.fails)
+
+    def _backoff_ticks(self, addr: Address, fails: int) -> int:
+        """Exponential backoff in heartbeat ticks, capped, with a
+        deterministic jitter (a function of BOTH endpoints and the
+        failure count, not of a PRNG: drills replay identically) of up
+        to half the backoff. Mixing in our own identity de-phases the
+        dialers: were the jitter a function of the target alone, every
+        node of a restarting mesh would compute the same offsets and
+        re-dial the recovering peer in lockstep."""
+        base = min(1 << min(fails - 1, 30), self._backoff_cap)
+        jitter = (self._addr.hash64() ^ addr.hash64() ^ fails) % (base // 2 + 1)
+        return base + jitter
+
+    def _inbound_contact(self, addr: Address) -> None:
+        """The v5 handshake told us `addr` just dialed US: that address
+        is alive, so any dial backoff against it is stale — reset it and
+        let the next heartbeat re-dial immediately (a rebooted peer
+        re-meshes in one tick instead of waiting out the cap)."""
+        st = self._peers.get(addr)
+        if st is not None and (st.fails or st.next_dial_tick > self._tick):
+            st.fails = 0
+            st.next_dial_tick = 0
 
     # ---- passive (inbound) connections -------------------------------------
 
@@ -308,36 +509,42 @@ class Cluster:
                 data = await reader.read(1 << 16)
                 if not data:
                     break
+                # cluster.read: error -> the ConnectionError path below;
+                # drop -> this chunk is lost (mid-frame loss desyncs the
+                # stream into a framing/codec drop, boundary loss loses
+                # whole messages — both heal through redial + sync)
+                data = await faults.async_point("cluster.read", data)
+                if data is None:
+                    continue
                 frames.append(data)
-                for body in frames:
+                for raw in frames:
+                    # cluster.decode (frame-decode): the failpoint fires
+                    # on the RAW frame, BEFORE the CRC check — injected
+                    # corruption is therefore detected exactly like real
+                    # wire/memory corruption would be, and can never
+                    # forge lattice state. drop -> one whole message
+                    # silently lost.
+                    raw = await faults.async_point("cluster.decode", raw)
+                    if raw is None:
+                        continue
+                    body = check_frame(raw)
+                    if body is None:
+                        self._log.err() and self._log.e(
+                            "cluster frame CRC mismatch"
+                        )
+                        self._drop(conn, Drop.CRC)
+                        return
                     if not conn.established:
-                        if body != self._serial:
-                            # wrong schema -> auth failure
-                            self._log.warn() and self._log.w(
-                                "cluster handshake signature mismatch"
-                            )
-                            self._drop(conn)
+                        if not self._handshake(conn, body, active):
                             return
-                        conn.established = True
                         frames.set_max_frame(1 << 30)  # authenticated peer
-                        self._mark_activity(conn)
-                        if active:
-                            # we initiated: announce our membership view,
-                            # then ask for missed state — this connection
-                            # just (re)opened, so any deltas flushed while
-                            # it was down are gone (fire-and-forget)
-                            self._send(conn, MsgExchangeAddrs(self._known_addrs.copy()))
-                            self._maybe_request_sync(conn)
-                        else:
-                            # passive side echoes the signature back
-                            conn.send_raw(frame(self._serial))
                         continue
                     self._mark_activity(conn)
                     try:
                         msg = codec.decode(body)
                     except codec.CodecError as e:
                         self._log.err() and self._log.e(f"cluster codec error: {e}")
-                        self._drop(conn)
+                        self._drop(conn, Drop.CODEC)
                         return
                     if active:
                         await self._active_msg(conn, msg)
@@ -346,7 +553,51 @@ class Cluster:
         except (ConnectionError, asyncio.CancelledError, FramingError):
             pass
         finally:
-            self._drop(conn)
+            self._drop(conn, Drop.EOF)
+
+    def _handshake(self, conn: _Conn, body: bytes, active: bool) -> bool:
+        """First frame on a connection: the 32-byte schema signature,
+        plus (from the DIALING side only, schema v5) the dialer's
+        advertised address. False -> the conn was dropped."""
+        sig_len = len(self._serial)
+        if body[:sig_len] != self._serial:
+            # wrong schema -> auth failure
+            self._log.warn() and self._log.w(
+                "cluster handshake signature mismatch"
+            )
+            self._drop(conn, Drop.HANDSHAKE)
+            return False
+        extra = body[sig_len:]
+        if active:
+            # the passive echo is the bare signature; we know who we
+            # dialed, so a successful handshake resets the backoff
+            if extra:
+                self._drop(conn, Drop.HANDSHAKE)
+                return False
+            st = self._peers.get(conn.active_addr)
+            if st is not None:
+                st.fails = 0
+                st.next_dial_tick = 0
+        else:
+            if extra:
+                try:
+                    conn.peer_addr = codec.decode_addr(extra)
+                except codec.CodecError:
+                    self._drop(conn, Drop.HANDSHAKE)
+                    return False
+                self._inbound_contact(conn.peer_addr)
+        conn.established = True
+        self._mark_activity(conn)
+        if active:
+            # we initiated: announce our membership view, then ask for
+            # missed state — this connection just (re)opened, so any
+            # deltas flushed while it was down are gone (fire-and-forget)
+            self._send(conn, MsgExchangeAddrs(self._known_addrs.copy()))
+            self._maybe_request_sync(conn)
+        else:
+            # passive side echoes the signature back
+            conn.send_raw(wire_frame(self._serial))
+        return True
 
     # ---- message handling --------------------------------------------------
 
@@ -366,7 +617,7 @@ class Cluster:
         self._log.err() and self._log.e(
             f"unexpected active message: {type(msg).__name__}"
         )
-        self._drop(conn)
+        self._drop(conn, Drop.UNEXPECTED)
 
     async def _passive_msg(self, conn: _Conn, msg) -> None:
         if isinstance(msg, MsgPong):
@@ -459,6 +710,7 @@ class Cluster:
                     conn.sync_defer_last_tick = self._tick
                     self._sync_serve_defer_total += 1
                     self._sync_defer_total_tick = self._tick
+                    self._stats["sync_deferred"] += 1
                     self._log.info() and self._log.i(
                         "sync: mid-heal, deferring dump "
                         f"(streak {conn.sync_defer_streak}, "
@@ -469,6 +721,7 @@ class Cluster:
             conn.sync_defer_streak = 0
             self._sync_serve_defer_total = 0
             conn.sync_served_tick = self._tick
+            self._stats["sync_served"] += 1
             conn.sync_digests = tuple(msg.digests)
             self._sync_waiters.append(conn)
             if self._sync_dump_inflight:
@@ -481,7 +734,7 @@ class Cluster:
         self._log.err() and self._log.e(
             f"unexpected passive message: {type(msg).__name__}"
         )
-        self._drop(conn)
+        self._drop(conn, Drop.UNEXPECTED)
 
     # ---- bootstrap / rejoin full-state sync --------------------------------
 
@@ -558,7 +811,7 @@ class Cluster:
                 stack.append(chunk[mid:])
                 stack.append(chunk[:mid])
                 continue
-            yield frame(data)
+            yield wire_frame(data)
 
     async def _system_frames(self) -> list[bytes]:
         """The SYSTEM log as sync frames, dumped fresh (it is tiny —
@@ -566,7 +819,7 @@ class Cluster:
         a digest-matched peer still recovers log lines it missed)."""
         dump = await self._database.dump_state_async(names=("SYSTEM",))
         return [
-            frame(codec.encode(MsgPushDeltas(name, tuple(batch))))
+            wire_frame(codec.encode(MsgPushDeltas(name, tuple(batch))))
             for name, batch in dump
         ]
 
@@ -639,13 +892,23 @@ class Cluster:
         receiver's converge speed, so a multi-second dump produces no
         inbound traffic on this conn — without the mark, the idle
         eviction would kill every large sync mid-flight."""
+        try:
+            # cluster.sync_dump: drop -> this dump frame is silently
+            # lost (the requester stays behind until the next periodic
+            # digest exchange); error/corrupt behave like cluster.write
+            data = await faults.async_point("cluster.sync_dump", data)
+        except faults.FaultError:
+            self._drop(conn, Drop.WRITE_FAILED)
+            return False
+        if data is None:
+            return True
         if not conn.send_raw(data):
-            self._drop(conn)
+            self._drop(conn, Drop.WRITE_FAILED)
             return False
         try:
             await conn.writer.drain()
         except (ConnectionError, RuntimeError):
-            self._drop(conn)
+            self._drop(conn, Drop.WRITE_FAILED)
             return False
         self._mark_activity(conn)
         return True
@@ -674,13 +937,16 @@ class Cluster:
             # drop actives to now-blacklisted addresses
             for addr in list(self._actives):
                 if addr not in self._known_addrs:
-                    self._drop(self._actives[addr])
-            # and their sync-request bookkeeping: blacklisted addresses
-            # never re-establish, so their cooldown entries are dead
-            # weight that would otherwise grow with name churn forever
+                    self._drop(self._actives[addr], Drop.BLACKLISTED)
+            # and their sync-request + dial-lifecycle bookkeeping:
+            # blacklisted addresses never re-establish, so their entries
+            # are dead weight that would otherwise grow with name churn
             for addr in list(self._sync_req_tick):
                 if addr not in self._known_addrs:
                     del self._sync_req_tick[addr]
+            for addr in list(self._peers):
+                if addr not in self._known_addrs:
+                    del self._peers[addr]
             self._sync_actives()
             self._broadcast_msg(MsgExchangeAddrs(self._known_addrs.copy()))
 
@@ -688,14 +954,18 @@ class Cluster:
 
     def broadcast_deltas(self, deltas) -> None:
         """The _SendDeltasFn sink (cluster.pony:209-213): serialise the batch
-        once, write to every established active connection."""
+        once, write to every established active connection. Anything
+        already held ships FIRST (strict FIFO: a late-joining peer sees
+        pre-join writes in flush order, never a fresh batch jumping the
+        queue), and a fresh batch that cannot ship queues behind them."""
         name, batch = deltas
         if batch and name != "SYSTEM":
             # outbound data deltas exist only for LOCAL applies: the
             # signal that defers the periodic digest pull (heartbeat)
             self._local_writes_seen = True
-        data = frame(codec.encode(MsgPushDeltas(name, tuple(batch))))
-        if not self._send_to_actives(data):
+        data = wire_frame(codec.encode(MsgPushDeltas(name, tuple(batch))))
+        self._flush_held()
+        if self._held or not self._send_to_actives(data):
             # nobody reachable right now (maybe nobody known yet): hold
             # instead of losing, so a late-joining peer still converges on
             # pre-join writes up to the cap. Empty SYSTEM keepalive frames
@@ -703,9 +973,14 @@ class Cluster:
             # real pre-join writes on a long-solo node — don't hold those.
             if self._worth_holding(name, batch):
                 self._held.append(data)
-                del self._held[: -self._held_cap]
-            return
-        self._flush_held()
+                over = len(self._held) - self._held_cap
+                if over > 0:
+                    # oldest-first eviction at the cap: DOCUMENTED data
+                    # loss (SURVEY.md §2.5's known gap, bounded) — made
+                    # visible per the robustness round: counted in the
+                    # CLUSTER metrics and warned once per episode
+                    del self._held[:over]
+                    self._note_held_drop(over)
 
     @staticmethod
     def _worth_holding(name: str, batch) -> bool:
@@ -720,8 +995,21 @@ class Cluster:
                 if conn.send_raw(data):
                     sent = True
                 else:
-                    self._drop(conn)
+                    self._drop(conn, Drop.WRITE_FAILED)
         return sent
+
+    def _note_held_drop(self, n: int) -> None:
+        self._stats["held_drops"] += n
+        if not self._held_drop_episode:
+            # once per eviction EPISODE (a burst of over-cap flushes),
+            # not per batch: a long-solo write-hot node would otherwise
+            # spam one warn per flush for hours
+            self._held_drop_episode = True
+            self._log.warn() and self._log.w(
+                f"held-delta cap {self._held_cap} reached: evicting "
+                "oldest batches — writes made with zero reachable peers "
+                "are being lost beyond the documented held window"
+            )
 
     def _flush_held(self) -> None:
         while self._held:
@@ -729,32 +1017,58 @@ class Cluster:
             if not self._send_to_actives(data):
                 return
             self._held.pop(0)
+        self._held_drop_episode = False  # drained: next eviction is news
 
     def _broadcast_msg(self, msg) -> None:
-        self._send_to_actives(frame(codec.encode(msg)))
+        self._send_to_actives(wire_frame(codec.encode(msg)))
 
     def _send(self, conn: _Conn, msg) -> None:
-        if not conn.send_raw(frame(codec.encode(msg))):
-            self._drop(conn)
+        if not conn.send_raw(wire_frame(codec.encode(msg))):
+            self._drop(conn, Drop.WRITE_FAILED)
 
     # ---- connection teardown -----------------------------------------------
 
     def _mark_activity(self, conn: _Conn) -> None:
         self._last_activity[conn] = self._tick
 
-    def _drop(self, conn: _Conn) -> None:
-        """Close and untrack a connection. A dropped active's address stays
-        in _known_addrs (unless blacklisting removed it), so _sync_actives
-        re-dials it next tick; passives are simply forgotten."""
-        if self._log.info() and (
-            conn in self._passives or conn.active_addr in self._actives
-        ):
-            kind = (
-                f"active {conn.active_addr}"
-                if conn.active_addr is not None
-                else "passive"
+    def _conn_desc(self, conn: _Conn) -> str:
+        """Peer identity + role for teardown logs: actives name the
+        address we dialed; passives name the advertised address the v5
+        handshake carried (or admit they never learned one)."""
+        if conn.active_addr is not None:
+            return f"active {conn.active_addr}"
+        if conn.peer_addr is not None:
+            return f"passive {conn.peer_addr}"
+        return "passive (pre-handshake)"
+
+    def _drop(self, conn: _Conn, reason: str = Drop.EOF) -> None:
+        """Close and untrack a connection, logging WHO and WHY and
+        counting the reason (CLUSTER metrics). A dropped active's
+        address stays in _known_addrs (unless blacklisting removed it),
+        so _sync_actives re-dials it — immediately for a conn drop,
+        after backoff for dial failures; passives are simply
+        forgotten."""
+        tracked = conn in self._passives or (
+            conn.active_addr is not None
+            and self._actives.get(conn.active_addr) is conn
+        )
+        if tracked:
+            self._drop_counts[reason] = self._drop_counts.get(reason, 0) + 1
+            self._log.info() and self._log.i(
+                f"dropping {self._conn_desc(conn)} connection ({reason})"
             )
-            self._log.i(f"dropping {kind} connection")
+            if conn.active_addr is not None and reason in _PEER_FAULT_DROPS:
+                # the peer answered TCP but violated the protocol:
+                # back its address off exactly like a connect failure
+                # (reset by a later clean establishment or by inbound
+                # contact, like any backoff)
+                st = self._peers.get(conn.active_addr)
+                if st is None:
+                    st = self._peers[conn.active_addr] = _PeerState()
+                st.fails += 1
+                st.next_dial_tick = self._tick + self._backoff_ticks(
+                    conn.active_addr, st.fails
+                )
         self._last_activity.pop(conn, None)
         self._passives.discard(conn)
         if conn.active_addr is not None:

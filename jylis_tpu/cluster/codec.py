@@ -47,13 +47,23 @@ from .msg import (
     MsgSyncRequest,
 )
 
-SCHEMA_VERSION = 4
+SCHEMA_VERSION = 5
 
 # The canonical schema text: any change to the wire format MUST change this
 # string (bump SCHEMA_VERSION), which changes the signature, which makes
 # incompatible peers refuse each other at handshake instead of corrupting.
+# v5: (a) every transport frame body is prefixed with its CRC32 —
+# without it a single bit flip past the TCP checksum can decode as a
+# valid message and converge as forged lattice state (found by the
+# drill matrix); (b) the dialer's handshake frame carries its
+# advertised address after the 32-byte signature (the passive side uses
+# it to identify the peer for teardown logs and to reset its dial
+# backoff on inbound contact); the passive echo remains the bare
+# signature.
 _SCHEMA_TEXT = f"""jylis-tpu cluster schema v{SCHEMA_VERSION}
 varint=LEB128 bytes=varint-len-prefixed str=utf8-bytes
+wire=frame(crc32(body):u32be body)
+handshake=wire(sig:32B dialer-addr:addr?)
 addr=(host:str port:str name:str)
 p2set=(adds:[addr] removes:[addr])
 msg0=Pong
@@ -214,6 +224,21 @@ def _w_addr(out: bytearray, a: Address) -> None:
 
 def _r_addr(r: _Reader) -> Address:
     return Address(r.str_(), r.str_(), r.str_())
+
+
+def encode_addr(a: Address) -> bytes:
+    """One bare address (the v5 handshake's dialer-identity suffix)."""
+    out = bytearray()
+    _w_addr(out, a)
+    return bytes(out)
+
+
+def decode_addr(data: bytes) -> Address:
+    r = _Reader(data)
+    a = _r_addr(r)
+    if not r.done():
+        raise CodecError("trailing bytes after address")
+    return a
 
 
 def _w_p2set(out: bytearray, s: P2Set) -> None:

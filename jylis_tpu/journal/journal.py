@@ -60,6 +60,7 @@ import time
 import zlib
 from collections import deque
 
+from .. import faults
 from ..cluster import codec
 from ..cluster.framing import FrameReader, FramingError, frame
 from ..cluster.msg import MsgPushDeltas
@@ -335,25 +336,32 @@ class Journal:
                             ask = True
                 if data is not None and f is not None:
                     try:
-                        f.write(data)
-                        # push past userspace buffering: a SIGKILL must
-                        # lose at most the queued tail, never batches
-                        # parked in Python's file buffer
-                        f.flush()
-                        wrote = len(data)
-                        # _busy protocol: while set, the writer owns _f
-                        # and the fsync bookkeeping — rotation and close
-                        # wait the flag out. jlint: shared-ok
-                        self._dirty = True
-                        if self._fsync == FSYNC_ALWAYS or (
-                            self._fsync == FSYNC_INTERVAL
-                            and (
-                                self._last_sync is None
-                                or self._clock() - self._last_sync
-                                >= self._fsync_interval
-                            )
-                        ):
-                            synced = self._sync_file(f)
+                        # journal.append: error -> the OSError recovery
+                        # below (counted, writer survives); corrupt ->
+                        # boot replay's CRC refusal; drop -> this batch
+                        # silently never reaches disk (peers still hold
+                        # it — the drill's local-durability-loss case)
+                        data = faults.point("journal.append", data)
+                        if data is not None:
+                            f.write(data)
+                            # push past userspace buffering: a SIGKILL
+                            # must lose at most the queued tail, never
+                            # batches parked in Python's file buffer
+                            f.flush()
+                            wrote = len(data)
+                            # _busy protocol: while set, the writer owns
+                            # _f and the fsync bookkeeping — rotation and
+                            # close wait the flag out. jlint: shared-ok
+                            self._dirty = True
+                            if self._fsync == FSYNC_ALWAYS or (
+                                self._fsync == FSYNC_INTERVAL
+                                and (
+                                    self._last_sync is None
+                                    or self._clock() - self._last_sync
+                                    >= self._fsync_interval
+                                )
+                            ):
+                                synced = self._sync_file(f)
                     except OSError as e:  # full disk etc: keep the writer
                         self.last_error = e  # jlint: shared-ok (atomic diagnostic ref)
                         metrics.note_journal("errors")
@@ -392,6 +400,10 @@ class Journal:
     def _sync_file(self, f) -> bool:
         """fsync + bookkeeping; writer-thread only (or under drain)."""
         try:
+            # journal.fsync: error -> the recovery below (counted, sync
+            # skipped, durability window widens); sleep -> a slow disk
+            # (writer thread stalls, serving-loop appends keep queueing)
+            faults.point("journal.fsync")
             os.fsync(f.fileno())
         except OSError as e:
             self.last_error = e  # jlint: shared-ok (atomic diagnostic ref)
@@ -427,6 +439,11 @@ class Journal:
         fresh = None
         synced_at = None
         try:
+            # journal.rotate: error -> the failed-rotation path below
+            # (writer resumes with no active segment, re-asks, retries);
+            # crash -> dies between drain and rename, leaving .retiring
+            # for boot recovery — the exact window the format defends
+            faults.point("journal.rotate")
             if f is not None:
                 try:
                     f.flush()
@@ -550,6 +567,9 @@ def replay_journal(database, path: str, truncate_tail: bool = True) -> int:
     OTHER unreadable file — and like snapshot loading, nothing is
     converged unless the readable part fully validates first."""
     try:
+        # journal.replay: error -> JournalError -> recover() moves the
+        # segment aside (.unreadable) and boots on, healing from peers
+        faults.point("journal.replay")
         msgs, good_end, total = read_journal(path)
     except FileNotFoundError:
         return 0

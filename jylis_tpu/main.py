@@ -13,6 +13,7 @@ import os
 import signal
 import sys
 
+from . import faults
 from . import persist
 from . import journal as journal_mod
 from .utils import metrics
@@ -148,6 +149,10 @@ class Dispose:
 
 async def run(argv: list[str] | None = None) -> None:
     config = config_from_cli(argv)
+    if config.failpoints:
+        # flag arming lands on top of any JYLIS_FAILPOINTS env arming
+        # (faults.py parses the env at import); same spec syntax
+        faults.arm_spec(config.failpoints)
     system = System(config)
     database_mod.warmup()  # compile serving kernels before going live
     metrics.counters.clear()  # don't count warmup compiles as serving drains

@@ -46,6 +46,10 @@ class RepoSYSTEM:
         # ... and this to the native-vs-demoted serving split for the
         # SERVING native_cmds/demoted_cmds/demotions/fallback_frac lines
         self.serving_fn = None
+        # the Cluster wires this to its peer-lifecycle totals for the
+        # CLUSTER section (peer states, dials/fails, evictions by
+        # reason, sync served/deferred, held-delta drops)
+        self.cluster_fn = None
 
     def apply(self, resp, args: list[bytes]) -> bool:
         op = need(args, 0)
@@ -70,6 +74,7 @@ class RepoSYSTEM:
             lines = metric_lines(
                 self.served_fn() if self.served_fn else None,
                 self.serving_fn() if self.serving_fn else None,
+                self.cluster_fn() if self.cluster_fn else None,
             )
             resp.array_start(len(lines))
             for line in lines:

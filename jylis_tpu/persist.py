@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import os
 
+from . import faults
 from .cluster import codec
 from .cluster.framing import FrameReader, FramingError, frame
 from .cluster.msg import MsgPushDeltas
@@ -46,7 +47,16 @@ def write_snapshot(batches, path: str) -> None:
         f.write(MAGIC)
         f.write(codec.delta_signature())
         for name, batch in batches:
-            f.write(frame(codec.encode(MsgPushDeltas(name, tuple(batch)))))
+            # snapshot.write (per type frame): error -> OSError out of
+            # here, the snapshot loop / shutdown path logs and the
+            # journal keeps the deltas; corrupt/drop -> the NEXT boot's
+            # load validation refuses the file and moves it aside
+            data = faults.point(
+                "snapshot.write",
+                frame(codec.encode(MsgPushDeltas(name, tuple(batch)))),
+            )
+            if data is not None:
+                f.write(data)
     os.replace(tmp, path)
 
 
@@ -62,8 +72,14 @@ def load_snapshot(database, path: str) -> int:
     try:
         with open(path, "rb") as f:
             blob = f.read()
+        # snapshot.load: error -> "cannot read" below; corrupt -> the
+        # validation path refuses (caller moves the file aside, node
+        # heals from peers); drop -> treated as unreadable
+        blob = faults.point("snapshot.load", blob)
     except OSError as e:
         raise SnapshotError(f"cannot read snapshot: {e}") from None
+    if blob is None:
+        raise SnapshotError("snapshot dropped by failpoint")
     if blob[: len(MAGIC)] != MAGIC:
         raise SnapshotError("not a snapshot file")
     sig_end = len(MAGIC) + len(codec.delta_signature())

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import asyncio
 
+from .. import faults
 from ..models.database import Database
 from ..native.resp import make_parser
 from ..utils.metrics import note_serving
@@ -165,9 +166,17 @@ class Server:
             # snapshot can never deadlock against a serving burst.
             async with mgrs[2]._lock, mgrs[3]._lock, mgrs[0]._lock, \
                     mgrs[1]._lock, mgrs[4]._lock:
-                rc, consumed, replies, unhandled, changed = (
-                    engine.scan_apply(buf)
-                )
+                try:
+                    # native.scan_apply: a failure AT the FFI burst
+                    # boundary must demote this connection to the Python
+                    # oracle path (replies stay correct, at the measured
+                    # demotion cliff), never kill the connection
+                    faults.point("native.scan_apply")
+                    rc, consumed, replies, unhandled, changed = (
+                        engine.scan_apply(buf)
+                    )
+                except faults.FaultError:
+                    return demote()
                 if replies:
                     flush()  # deferred-command replies precede these
                     writer.write(replies)

@@ -38,6 +38,13 @@ class Config:
     journal_fsync: str = "interval"
     journal_fsync_interval: float = 0.2
     journal_max_bytes: int = 64 << 20
+    # extension: peer dial lifecycle (cluster.py) — connect timeout in
+    # seconds and the exponential-backoff ceiling in heartbeat ticks
+    dial_timeout: float = 5.0
+    dial_backoff_cap: int = 32
+    # extension: deterministic fault injection (faults.py); same syntax
+    # as the JYLIS_FAILPOINTS env var, armed at startup
+    failpoints: str = ""
     log: Log = field(default_factory=Log.create_none)
 
     def normalize(self) -> None:
@@ -111,6 +118,30 @@ def config_from_cli(argv: list[str] | None = None, log_out=None) -> Config:
         "cut and the old journal segment retired (docs/durability.md).",
     )
     parser.add_argument(
+        "--dial-timeout", type=float, default=5.0,
+        help="Seconds before an outbound cluster dial attempt is "
+        "abandoned (a blackholed peer would otherwise hang for the "
+        "OS's minutes-long TCP timeout). Failed dials back off "
+        "exponentially up to --dial-backoff-cap heartbeat ticks.",
+    )
+    parser.add_argument(
+        "--dial-backoff-cap", type=int, default=32,
+        help="Ceiling, in heartbeat ticks, for the exponential re-dial "
+        "backoff to an unreachable peer (deterministic jitter of up to "
+        "half the backoff is added). Inbound contact from the address "
+        "resets its backoff immediately.",
+    )
+    parser.add_argument(
+        "--failpoints", default="",
+        help="Deterministic fault injection spec, e.g. "
+        "'cluster.dial=error:3,journal.fsync=sleep:0.2' "
+        "(name=action[:arg[:budget]], comma-separated; actions: error, "
+        "sleep, corrupt, crash, drop). Also read from the "
+        "JYLIS_FAILPOINTS environment variable; see "
+        "docs/operations.md. Empty (default) injects nothing and "
+        "costs nothing.",
+    )
+    parser.add_argument(
         "-L", "--log-level", default="info",
         help="Maximum level of detail for logging (error, warn, info, or debug).",
     )
@@ -137,6 +168,9 @@ def config_from_cli(argv: list[str] | None = None, log_out=None) -> Config:
     config.journal_fsync = args.journal_fsync
     config.journal_fsync_interval = args.journal_fsync_interval
     config.journal_max_bytes = args.journal_max_bytes
+    config.dial_timeout = args.dial_timeout
+    config.dial_backoff_cap = args.dial_backoff_cap
+    config.failpoints = args.failpoints
 
     level = {"error": "err", "warn": "warn", "info": "info", "debug": "debug"}.get(
         args.log_level

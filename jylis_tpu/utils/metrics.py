@@ -130,6 +130,7 @@ def _type_stats():
 def metric_lines(
     served: dict[str, int] | None = None,
     serving: dict[str, int] | None = None,
+    cluster: dict[str, int] | None = None,
 ) -> list[str]:
     """Flat `type counter value` lines — the SYSTEM METRICS reply body.
     ``served`` is the serving node's per-type commands-served totals
@@ -139,7 +140,11 @@ def metric_lines(
     in one process cannot cross-talk). ``serving`` is the native-vs-
     demoted split (native_cmds / demoted_cmds / demotions), emitted with
     the live fallback_frac so the bench record's headline condition is
-    checkable on a running node."""
+    checkable on a running node. ``cluster`` is the node's peer
+    lifecycle view (Cluster.metrics_totals: per-state peer counts,
+    dial/eviction/sync counters, held-delta drops) — per instance, so
+    every `CLUSTER` failure-envelope number is queryable from any Redis
+    client instead of buried in logs."""
     lines = [
         f"{name} cmds {n}" for name, n in sorted((served or {}).items()) if n
     ]
@@ -150,6 +155,10 @@ def metric_lines(
         if total:
             frac = serving.get("demoted_cmds", 0) / total
             lines.append(f"SERVING fallback_frac {frac:.4f}")
+    if cluster is not None:
+        # insertion order (states first, then counters) — a glossary
+        # order, kept stable for dashboards
+        lines.extend(f"CLUSTER {k} {v}" for k, v in cluster.items())
     for name, drains, keys, ms in _type_stats():
         lines.append(f"{name} drains {drains}")
         lines.append(f"{name} keys {keys}")

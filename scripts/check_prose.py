@@ -116,6 +116,10 @@ CLAIMS = [
      "vs {} served", "operations doc served rate"),
     ("docs/durability.md", "concurrent", "journal_cost_frac", fmt_percent,
      "journal costs {} of", "durability doc journal overhead"),
+    # the failure-envelope section cites the demotion cliff for the
+    # injected-FFI-fault path (robustness round)
+    ("docs/operations.md", "serving-demotion", "vs_baseline", fmt_ratio,
+     "at the recorded demotion cliff of {}", "failure envelope FFI cliff"),
 ]
 
 

@@ -21,6 +21,12 @@ re-record, and each gets a dedicated analysis pass:
   Python oracle path fails, and any drift between the sources and the
   committed manifest fails — PR 2's hand-checked parity as a mechanical
   invariant.
+* **Pass 4 — failpoint manifest parity** (`pass_failpoints`, rules
+  JL4xx): every ``faults.point(...)`` name in the product tree must be
+  a string literal declared in the committed
+  ``scripts/jlint/failpoints_manifest.json`` with a one-line
+  description; undeclared, stale, or undescribed names fail, so the
+  set of injectable failure seams stays reviewed and documented.
 
 Plus one hygiene rule, JL001: ``except Exception`` / bare ``except``
 without an explicit justification, so hot-path errors can't be silently
@@ -68,6 +74,8 @@ RULES = {
     "JL204": ("jit-ok", "jax.jit constructed inside a function body (per-call recompilation)"),
     "JL301": (None, "command served natively without a Python oracle path (or vice versa, unlisted)"),
     "JL302": (None, "parity manifest drift: committed manifest != extracted surfaces"),
+    "JL401": (None, "failpoint name non-literal or not declared in failpoints_manifest.json"),
+    "JL402": (None, "failpoints manifest entry stale, missing, or undescribed"),
     "JL900": (None, "stale or malformed baseline suppression entry"),
 }
 

@@ -19,7 +19,7 @@ from . import (
     iter_py_files,
     load_baseline,
 )
-from . import pass_async, pass_jax, pass_parity
+from . import pass_async, pass_failpoints, pass_jax, pass_parity
 
 # pass 1 + JL001 cover the product and its scripts; tests are excluded
 # (fixtures deliberately violate the rules), and jlint's own fixtures
@@ -51,6 +51,7 @@ def run_all(root: str = ROOT, verbose: bool = False) -> int:
     apply_suppressions(findings, by_rel)
     problems = apply_baseline(findings, load_baseline())
     findings += pass_parity.check()
+    findings += pass_failpoints.check()
     findings += problems
 
     bad = [f for f in findings if not f.suppressed]
@@ -61,7 +62,7 @@ def run_all(root: str = ROOT, verbose: bool = False) -> int:
     n_sup = sum(1 for f in findings if f.suppressed)
     print(
         f"jlint: {len(bad)} finding(s), {n_sup} suppressed "
-        f"({len(async_sources)} files, 3 passes)"
+        f"({len(async_sources)} files, 4 passes)"
     )
     return 1 if bad else 0
 
@@ -70,7 +71,8 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="jlint")
     ap.add_argument(
         "--write-manifest", action="store_true",
-        help="regenerate scripts/jlint/parity_manifest.json and exit",
+        help="regenerate scripts/jlint/parity_manifest.json and "
+        "failpoints_manifest.json (descriptions preserved) and exit",
     )
     ap.add_argument(
         "-v", "--verbose", action="store_true",
@@ -82,6 +84,12 @@ def main(argv=None) -> int:
         n = sum(len(v) for v in manifest["native"].values())
         p = sum(len(v) for v in manifest["python"].values())
         print(f"parity manifest written: {n} native, {p} python commands")
+        fps = pass_failpoints.write_manifest()
+        todo = sum(1 for d in fps.values() if d == pass_failpoints.PLACEHOLDER)
+        print(
+            f"failpoints manifest written: {len(fps)} failpoints"
+            + (f" ({todo} need descriptions)" if todo else "")
+        )
         return 0
     return run_all(verbose=args.verbose)
 

@@ -370,6 +370,28 @@ def test_active_redialed_after_drop(three_nodes):
     asyncio.run(main())
 
 
+def test_wire_frame_crc_detects_any_single_byte_flip():
+    """Schema v5 transport integrity: every cluster frame body carries
+    its CRC32, so a bit flip past the TCP checksum is a detected drop,
+    never a decodable forged message (the drill matrix demonstrated a
+    flipped counter value converging cluster-wide without this)."""
+    from jylis_tpu.cluster.cluster import check_frame, wire_frame
+    from jylis_tpu.cluster.framing import FrameReader, HEADER_SIZE
+
+    body = b"some message body"
+    framed = wire_frame(body)
+    frames = FrameReader()
+    frames.append(framed)
+    raw = next(iter(frames))
+    assert check_frame(raw) == body
+    for i in range(len(raw)):  # flip every byte of crc+payload in turn
+        bad = bytearray(raw)
+        bad[i] ^= 0x01
+        assert check_frame(bytes(bad)) is None, i
+    assert check_frame(b"") is None  # shorter than the CRC itself
+    assert len(framed) == HEADER_SIZE + 4 + len(body)
+
+
 def test_handshake_signature_mismatch_drops_connection():
     """A peer presenting the wrong schema signature is dropped before any
     message exchange (cluster_notify.pony:37-61: auth failure)."""

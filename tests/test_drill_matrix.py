@@ -1,0 +1,614 @@
+"""The crash/partition drill matrix: {fault class} x {injection site}
+over a real 3-node cluster.
+
+Every registered failpoint (the committed
+scripts/jlint/failpoints_manifest.json — the matrix reads it, so a seam
+added to the code can never be silently missing here) is exercised
+under every fault class {error, sleep, corrupt, drop, crash}, and every
+cell must end in a CONVERGED, DIGEST-MATCHED 3-node cluster:
+
+* the drill asserts the site actually FIRED (faults.hits), so a cell
+  can never pass vacuously;
+* post-heal writes on every node must reach every node, and the
+  per-type sync digests of all three databases must be equal;
+* an injected FFI fault must serve correct replies via demotion;
+* reconnect attempts to a downed peer must be bounded by the dial
+  backoff, not one per heartbeat tick.
+
+The fast subset (`@pytest.mark.chaos`, seconds) runs per commit via
+`make chaos` (inside `make ci`); the full matrix is nightly
+(`@pytest.mark.soak`, `make soak`). In-process cells model `crash` with
+a handler that fails the in-flight operation and abruptly tears the
+node down (no final flush, no shutdown snapshot) before rebooting it
+from disk; one spawned-process cell exercises the real
+JYLIS_FAILPOINTS env arming and os._exit path end to end.
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import test_cluster
+from test_cluster import TICK, Node, converge_wait, grab_ports, meshed, resp_call
+from jylis_tpu import faults, persist
+from jylis_tpu import journal as journal_mod
+
+MANIFEST = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "scripts", "jlint", "failpoints_manifest.json",
+)
+
+with open(MANIFEST, encoding="utf-8") as _f:
+    SITES = sorted(json.load(_f)["failpoints"])
+
+CLASSES = ("error", "sleep", "corrupt", "drop", "crash")
+
+# (arg, budget) per class: budgets bound every drill so the fault heals
+# by exhaustion even if the drill's explicit disarm is late; sleeps are
+# short because some sync seams fire on the shared event loop
+FAULT_ARGS = {
+    "error": (None, 5),
+    "sleep": (0.05, 5),
+    "corrupt": (None, 5),
+    "drop": (None, 5),
+    "crash": (None, 1),
+}
+
+BOOT_SITES = {"journal.replay", "snapshot.load"}
+DISK_SITES = {
+    "journal.append", "journal.fsync", "journal.rotate",
+    "journal.replay", "snapshot.write", "snapshot.load",
+}
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+    faults.set_crash_handler(None)
+
+
+class DiskNode(Node):
+    """A test Node with main.py's persistence wiring: snapshot restore,
+    journal recover/open/attach. fsync=always for deterministic drills."""
+
+    def __init__(self, name, cluster_port, seeds=(), data_dir=None):
+        super().__init__(name, cluster_port, seeds)
+        self.data_dir = data_dir
+        self.journal = None
+        self.snapshot_path = None
+        if data_dir:
+            os.makedirs(data_dir, exist_ok=True)
+            self.snapshot_path = os.path.join(data_dir, "snapshot.jylis")
+            if os.path.exists(self.snapshot_path):
+                try:
+                    persist.load_snapshot(self.database, self.snapshot_path)
+                except persist.SnapshotError:
+                    os.replace(
+                        self.snapshot_path, self.snapshot_path + ".unreadable"
+                    )
+            jpath = os.path.join(data_dir, "journal.jylis")
+            journal_mod.recover(self.database, jpath)
+            self.journal = journal_mod.Journal(jpath, fsync="always")
+            self.journal.open()
+            self.database.set_journal(self.journal)
+
+    async def stop(self):
+        await super().stop()
+        if self.journal is not None:
+            await asyncio.to_thread(self.journal.close)
+
+    async def crash_stop(self):
+        """Abrupt teardown: no final flush, no shutdown snapshot — what
+        peers and the disk see when the process dies. (The journal
+        writer is joined so the file is stable for the reboot; batches
+        still queued at 'death' are the documented loss window.)"""
+        self.cluster.dispose()
+        await self.server.dispose()
+        if self.journal is not None:
+            await asyncio.to_thread(self.journal.close)
+
+
+async def write_inc(node, key: bytes, amount: int) -> None:
+    got = await resp_call(
+        node.server.port,
+        b"*4\r\n$6\r\nGCOUNT\r\n$3\r\nINC\r\n$%d\r\n%s\r\n$%d\r\n%d\r\n"
+        % (len(key), key, len(str(amount)), amount),
+    )
+    assert got == b"+OK\r\n", got
+
+
+async def read_count(node, key: bytes) -> bytes:
+    return await resp_call(
+        node.server.port,
+        b"*3\r\n$6\r\nGCOUNT\r\n$3\r\nGET\r\n$%d\r\n%s\r\n" % (len(key), key),
+    )
+
+
+async def wait_counts(nodes, key: bytes, total: int, ticks: int = 300) -> None:
+    want = b":%d\r\n" % total
+    got = {}
+
+    async def check():
+        for n in nodes:
+            got[n.config.addr.name] = await read_count(n, key)
+        return all(v == want for v in got.values())
+
+    deadline = asyncio.get_event_loop().time() + ticks * TICK
+    while asyncio.get_event_loop().time() < deadline:
+        if await check():
+            return
+        await asyncio.sleep(TICK)
+    assert await check(), (key, total, got)
+
+
+async def wait_digests_match(nodes, ticks: int = 300) -> None:
+    """The acceptance bar: every node's per-type sync digests equal."""
+    last = None
+    deadline = asyncio.get_event_loop().time() + ticks * TICK
+    while asyncio.get_event_loop().time() < deadline:
+        last = [await n.database.sync_type_digests_async() for n in nodes]
+        if all(d == last[0] for d in last):
+            return
+        await asyncio.sleep(TICK)
+    assert all(d == last[0] for d in last), last
+
+
+async def wait_pred(pred, ticks: int = 200):
+    deadline = asyncio.get_event_loop().time() + ticks * TICK
+    while asyncio.get_event_loop().time() < deadline:
+        if pred():
+            return True
+        await asyncio.sleep(TICK)
+    return pred()
+
+
+def meshed_real(nodes) -> bool:
+    """Every node holds an ESTABLISHED active conn to every other REAL
+    node. Deliberately not `meshed()`'s exact-count check, which is
+    racy against in-flight dial placeholders while a cell is still
+    healing. (Historical note: before the transport CRC, a corrupt
+    injected at a cluster seam could flip a byte inside a membership
+    message that still decoded, gossiping a phantom address into the
+    P2Set permanently — and worse, forge counter values that converged
+    digest-matched. The schema-v5 per-frame CRC, added because THIS
+    matrix caught that, turns every such corruption into a detected
+    drop + reconnect heal.)"""
+    addrs = {n.config.addr for n in nodes}
+    for n in nodes:
+        for other in addrs - {n.config.addr}:
+            conn = n.cluster._actives.get(other)
+            if conn is None or not conn.established:
+                return False
+    return True
+
+
+# ---- the generic drill -----------------------------------------------------
+
+
+async def drill(site: str, action: str, tmp_path) -> None:
+    arg, budget = FAULT_ARGS[action]
+    data_dir = str(tmp_path / "bee") if site in DISK_SITES else None
+    p_a, p_b, p_c = grab_ports(3)
+    a = Node("aye", p_a)
+    b = DiskNode("bee", p_b, seeds=[a.config.addr], data_dir=data_dir)
+    c = Node("sea", p_c, seeds=[a.config.addr])
+    crashed: list[str] = []
+
+    def crash_handler(name):
+        # in-process 'crash': the in-flight operation fails like the
+        # real process death would make it, and the driver below tears
+        # the flagged node down abruptly before rebooting it from disk
+        crashed.append(name)
+        raise faults.FaultError(f"failpoint {name}: injected crash")
+
+    await a.start()
+    await b.start()
+    await c.start()
+    nodes = [a, b, c]
+    total = 0
+    try:
+        assert await converge_wait(lambda: meshed(a, b, c), ticks=200)
+        for i, n in enumerate(nodes):
+            await write_inc(n, b"drill", i + 1)
+            total += i + 1
+        await wait_counts(nodes, b"drill", total)
+
+        if action == "crash":
+            faults.set_crash_handler(crash_handler)
+        base_hits = faults.hits(site)
+
+        # ---- inject + trigger the seam -------------------------------------
+        if site in BOOT_SITES:
+            if site == "snapshot.load":
+                # a valid snapshot must exist for the loader to refuse
+                await asyncio.to_thread(
+                    persist.save_snapshot, b.database, b.snapshot_path
+                )
+            # journaled state present for replay
+            await asyncio.to_thread(b.journal.flush)
+            await b.crash_stop()
+            faults.arm(site, action, arg, budget)
+            b = DiskNode("bee", p_b, seeds=[a.config.addr], data_dir=data_dir)
+            await b.start()
+            nodes[1] = b
+        else:
+            faults.arm(site, action, arg, budget)
+            if site == "cluster.dial":
+                # force redials on every node
+                for n in nodes:
+                    for conn in list(n.cluster._actives.values()):
+                        n.cluster._drop(conn)
+            elif site == "cluster.sync_dump":
+                # a fresh rejoiner pulls a sync dump from the others
+                await c.stop()
+                c = Node("sea", p_c, seeds=[a.config.addr])
+                await c.start()
+                nodes[2] = c
+            elif site == "journal.rotate":
+                try:
+                    await asyncio.to_thread(b.journal.rotate_begin)
+                    batches = await b.database.dump_state_async()
+                    await asyncio.to_thread(
+                        persist.write_snapshot, batches, b.snapshot_path
+                    )
+                    await asyncio.to_thread(b.journal.rotate_commit)
+                except OSError:
+                    pass  # the injected rotation failure path
+            elif site == "snapshot.write":
+                try:
+                    batches = await b.database.dump_state_async()
+                    await asyncio.to_thread(
+                        persist.write_snapshot, batches, b.snapshot_path
+                    )
+                except OSError:
+                    pass
+            elif site == "native.scan_apply":
+                if b.database.native_engine is None:
+                    pytest.skip("no native toolchain: FFI seam absent")
+                # a pipelined burst through the native path; replies must
+                # stay correct even while the fault demotes connections
+                burst = (
+                    b"*4\r\n$6\r\nGCOUNT\r\n$3\r\nINC\r\n$3\r\nffi\r\n$1\r\n1\r\n"
+                    b"*3\r\n$6\r\nGCOUNT\r\n$3\r\nGET\r\n$3\r\nffi\r\n"
+                )
+                out = await resp_call(b.server.port, burst)
+                assert out == b"+OK\r\n:1\r\n", out
+            # cluster.read / cluster.write / cluster.decode /
+            # journal.append / journal.fsync: ordinary traffic fires them
+            for n in nodes:
+                await write_inc(n, b"during", 2)
+
+        # the cell is only meaningful if the seam actually fired
+        fired = await wait_pred(lambda: faults.hits(site) > base_hits)
+        assert fired, f"failpoint {site} never fired under {action}"
+
+        # ---- crash: the flagged node dies abruptly, then reboots -----------
+        if action == "crash":
+            await wait_pred(lambda: bool(crashed), ticks=100)
+            assert crashed, f"crash at {site} never flagged"
+            faults.disarm(site)
+            await b.crash_stop()
+            b = DiskNode("bee", p_b, seeds=[a.config.addr], data_dir=data_dir)
+            await b.start()
+            nodes[1] = b
+
+        # ---- heal ----------------------------------------------------------
+        faults.disarm(site)
+        assert await converge_wait(
+            lambda: meshed_real(nodes), ticks=300
+        ), {n.config.addr.name: len(n.cluster._actives) for n in nodes}
+        for i, n in enumerate(nodes):
+            await write_inc(n, b"heal", 10 + i)
+        await wait_counts(nodes, b"heal", 10 + 11 + 12)
+        await wait_counts(nodes, b"drill", total)
+        await wait_digests_match(nodes)
+    finally:
+        faults.reset()
+        faults.set_crash_handler(None)
+        for n in nodes:
+            try:
+                await n.stop()
+            except Exception:
+                pass
+
+
+# ---- per-commit chaos smoke (make chaos: seconds, not minutes) -------------
+
+SMOKE_CELLS = [
+    ("cluster.dial", "error"),
+    ("cluster.write", "drop"),
+    ("cluster.decode", "corrupt"),
+    ("journal.fsync", "error"),
+]
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("site,action", SMOKE_CELLS)
+def test_chaos_smoke_cell(site, action, tmp_path):
+    asyncio.run(drill(site, action, tmp_path))
+
+
+@pytest.mark.chaos
+def test_chaos_ffi_fault_demotes_and_serves_correctly():
+    """An injected failure at the FFI burst boundary must demote the
+    connection to the Python oracle path — correct replies, counted
+    demotion — never kill the connection."""
+
+    async def main():
+        from jylis_tpu.utils import metrics
+
+        (port,) = grab_ports(1)
+        node = Node("solo", port)
+        await node.start()
+        try:
+            if node.database.native_engine is None:
+                pytest.skip("no native toolchain: FFI seam absent")
+            before = metrics.serving_counters["demotions"]
+            faults.arm("native.scan_apply", "error", budget=1)
+            burst = b"".join(
+                b"*4\r\n$6\r\nGCOUNT\r\n$3\r\nINC\r\n$1\r\nk\r\n$1\r\n2\r\n"
+                for _ in range(3)
+            ) + b"*3\r\n$6\r\nGCOUNT\r\n$3\r\nGET\r\n$1\r\nk\r\n"
+            reader, writer = await asyncio.open_connection("127.0.0.1", node.server.port)
+            writer.write(burst)
+            await writer.drain()
+            got = b""
+            while got.count(b"\r\n") < 4:
+                chunk = await asyncio.wait_for(reader.read(1 << 16), timeout=5.0)
+                if not chunk:
+                    break
+                got += chunk
+            assert got == b"+OK\r\n+OK\r\n+OK\r\n:6\r\n", got
+            assert faults.hits("native.scan_apply") == 1
+            assert metrics.serving_counters["demotions"] == before + 1
+            # the demoted connection keeps serving correctly
+            writer.write(b"*3\r\n$6\r\nGCOUNT\r\n$3\r\nGET\r\n$1\r\nk\r\n")
+            await writer.drain()
+            assert await asyncio.wait_for(reader.read(1 << 10), timeout=5.0) == b":6\r\n"
+            writer.close()
+        finally:
+            await node.stop()
+
+    asyncio.run(main())
+
+
+@pytest.mark.chaos
+def test_chaos_reconnect_rate_bounded_by_backoff():
+    """A downed peer is re-dialed at the backoff schedule, not once per
+    heartbeat: over N ticks the attempt count must be O(log N + N/cap),
+    where the old redial-every-tick loop produced ~N."""
+
+    async def main():
+        p_a, p_dead = grab_ports(2)
+        from jylis_tpu.utils.address import Address
+
+        dead_addr = Address("127.0.0.1", str(p_dead), "dead")
+        a = Node("aye", p_a, seeds=[dead_addr])
+        await a.start()
+        try:
+            n_ticks = 40
+            await asyncio.sleep(n_ticks * TICK)
+            st = a.cluster._peers.get(dead_addr)
+            assert st is not None
+            # backoff 1,2,4,8,16,32(+jitter): ~6-8 attempts in 40 ticks
+            assert 2 <= st.dials <= 12, st.dials
+            m = a.cluster.metrics_totals()
+            assert m["dial_fails"] >= st.dials - 1
+            assert m["peers_backoff"] == 1
+        finally:
+            await a.stop()
+
+    asyncio.run(main())
+
+
+@pytest.mark.chaos
+def test_chaos_incompatible_peer_backs_off_like_dial_failure():
+    """A peer that ACCEPTS the TCP connect but then misbehaves (wrong
+    schema signature — e.g. the other side of a rolling upgrade across
+    a schema bump) must engage the dial backoff, not be re-dialed with
+    a fresh connect + handshake + teardown every single heartbeat."""
+
+    async def main():
+        from jylis_tpu.cluster.cluster import wire_frame
+        from jylis_tpu.cluster.framing import frame
+        from jylis_tpu.utils.address import Address
+
+        async def bad_peer(reader, writer):
+            # answers the dial with a wrong-signature handshake
+            writer.write(wire_frame(b"x" * 32))
+            try:
+                await writer.drain()
+                await reader.read(1 << 16)
+            except (ConnectionError, asyncio.CancelledError):
+                pass
+            finally:
+                writer.close()
+
+        server = await asyncio.start_server(bad_peer, "127.0.0.1", 0)
+        bad_port = server.sockets[0].getsockname()[1]
+        bad_addr = Address("127.0.0.1", str(bad_port), "oldversion")
+        (p_a,) = grab_ports(1)
+        a = Node("aye", p_a, seeds=[bad_addr])
+        await a.start()
+        try:
+            n_ticks = 40
+            await asyncio.sleep(n_ticks * TICK)
+            st = a.cluster._peers.get(bad_addr)
+            assert st is not None and st.dials >= 1
+            # per-tick redial would reach ~40 attempts; backoff bounds it
+            assert st.dials <= 12, st.dials
+            assert a.cluster._drop_counts.get("handshake_mismatch", 0) >= 1
+        finally:
+            await a.stop()
+            server.close()
+            await server.wait_closed()
+
+    asyncio.run(main())
+
+
+@pytest.mark.chaos
+def test_chaos_inbound_contact_resets_backoff():
+    """A peer deep in backoff is re-dialed immediately once IT dials us
+    (the v5 handshake identifies the dialer), so a rebooted node
+    re-meshes in ~one tick instead of waiting out the cap."""
+
+    async def main():
+        p_a, p_b = grab_ports(2)
+        from jylis_tpu.utils.address import Address
+
+        b_addr = Address("127.0.0.1", str(p_b), "bee")
+        a = Node("aye", p_a, seeds=[b_addr])
+        await a.start()
+        try:
+            # let dials fail, then pin the peer deep into backoff
+            assert await wait_pred(
+                lambda: (a.cluster._peers.get(b_addr) or None) is not None
+                and a.cluster._peers[b_addr].fails >= 2
+            )
+            st = a.cluster._peers[b_addr]
+            st.next_dial_tick = a.cluster._tick + 10_000  # deep backoff
+            b = Node("bee", p_b, seeds=[a.config.addr])
+            await b.start()
+            try:
+                # b dials a; the handshake identity resets a's backoff
+                assert await wait_pred(lambda: st.next_dial_tick <= a.cluster._tick)
+                assert await converge_wait(lambda: meshed(a, b), ticks=100)
+            finally:
+                await b.stop()
+        finally:
+            await a.stop()
+
+    asyncio.run(main())
+
+
+@pytest.mark.chaos
+def test_chaos_dial_timeout_bounds_blackholed_connect():
+    """A blackholed connect (the OS would let it hang for minutes) is
+    abandoned at --dial-timeout and enters backoff like any failure."""
+
+    async def main():
+        p_a, p_dead = grab_ports(2)
+        from jylis_tpu.utils.address import Address
+
+        dead_addr = Address("127.0.0.1", str(p_dead), "dead")
+        a = Node("aye", p_a, seeds=[dead_addr])
+        a.cluster._dial_timeout = 0.2
+        faults.arm("cluster.dial", "sleep", 30.0, budget=1)
+        await a.start()
+        try:
+            t0 = time.monotonic()
+            assert await wait_pred(lambda: faults.hits("cluster.dial") >= 1)
+            assert await wait_pred(
+                lambda: a.cluster.metrics_totals()["dial_fails"] >= 1
+            )
+            # the 30 s injected hang was cut off by the 0.2 s timeout
+            assert time.monotonic() - t0 < 10.0
+        finally:
+            await a.stop()
+
+    asyncio.run(main())
+
+
+@pytest.mark.chaos
+def test_chaos_cluster_metrics_surface():
+    """SYSTEM METRICS emits the CLUSTER section with the documented
+    keys, queryable over a real RESP connection."""
+
+    async def main():
+        p_a, p_b = grab_ports(2)
+        a = Node("aye", p_a)
+        b = Node("bee", p_b, seeds=[a.config.addr])
+        await a.start()
+        await b.start()
+        try:
+            assert await converge_wait(lambda: meshed(a, b), ticks=200)
+            out = await resp_call(
+                a.server.port, b"*2\r\n$6\r\nSYSTEM\r\n$7\r\nMETRICS\r\n"
+            )
+            for key in (
+                b"CLUSTER peers_known", b"CLUSTER peers_established",
+                b"CLUSTER peers_backoff", b"CLUSTER dials",
+                b"CLUSTER dial_fails", b"CLUSTER evictions",
+                b"CLUSTER sync_served", b"CLUSTER sync_deferred",
+                b"CLUSTER held_now", b"CLUSTER held_drops",
+            ):
+                assert key in out, (key, out)
+            assert b"CLUSTER peers_established 1" in out
+        finally:
+            await b.stop()
+            await a.stop()
+
+    asyncio.run(main())
+
+
+# ---- the full matrix (nightly) ---------------------------------------------
+
+
+@pytest.mark.soak
+@pytest.mark.slow  # nightly (`make soak`), not per-commit
+@pytest.mark.parametrize("action", CLASSES)
+@pytest.mark.parametrize("site", SITES)
+def test_drill_matrix_cell(site, action, tmp_path):
+    if (site, action) in SMOKE_CELLS:
+        pytest.skip("covered per-commit by the chaos smoke")
+    asyncio.run(drill(site, action, tmp_path))
+
+
+@pytest.mark.soak
+@pytest.mark.slow  # nightly (`make soak`), not per-commit
+def test_spawned_env_crash_drill(tmp_path):
+    """The real thing, end to end: a spawned node armed via the
+    JYLIS_FAILPOINTS env var dies by os._exit at the injected site, and
+    a clean respawn recovers from its journal and keeps serving."""
+    from procutil import SPAWN_CPU, connect_client, free_port, spawn_node, stop_node
+
+    data_dir = str(tmp_path / "crashnode")
+    port, cport = free_port(), free_port()
+    env = dict(os.environ, JYLIS_FAILPOINTS="journal.fsync=crash:1")
+    args = [
+        sys.executable, "-c", SPAWN_CPU,
+        "--port", str(port), "--addr", f"127.0.0.1:{cport}:crashy",
+        "--log-level", "warn", "--data-dir", data_dir,
+        "--journal-fsync", "always",
+    ]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.Popen(args, cwd=repo, env=env)
+    acked = 0
+    try:
+        client = connect_client(port, proc=proc)
+        # the first journaled append fsyncs (always) and the armed
+        # failpoint kills the process mid-serving
+        deadline = time.time() + 120
+        while proc.poll() is None and time.time() < deadline:
+            try:
+                client.execute_command("GCOUNT", "INC", "k", "1")
+                acked += 1
+            except (OSError, EOFError, RuntimeError, ValueError):
+                break
+            time.sleep(0.02)
+        proc.wait(timeout=120)
+        assert proc.returncode == faults.CRASH_EXIT_CODE, proc.returncode
+        assert acked > 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+
+    # clean respawn: journal replay restores what the writer persisted
+    proc2 = spawn_node(port, cport, "crashy", "--data-dir", data_dir)
+    try:
+        client = connect_client(port, proc=proc2)
+        got = int(client.execute_command("GCOUNT", "GET", "k"))
+        # no phantom data, and the node serves post-crash writes
+        assert 0 <= got <= acked
+        client.execute_command("GCOUNT", "INC", "k", "5")
+        assert int(client.execute_command("GCOUNT", "GET", "k")) == got + 5
+    finally:
+        stop_node(proc2)

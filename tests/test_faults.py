@@ -1,0 +1,140 @@
+"""Unit tests for the failpoint registry (jylis_tpu/faults.py): spec
+parsing, action semantics, hit budgets, thread/async variants, and the
+zero-cost-unarmed contract the hot paths rely on."""
+
+import asyncio
+import time
+
+import pytest
+
+from jylis_tpu import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    faults.reset()
+    yield
+    faults.reset()
+    faults.set_crash_handler(None)
+
+
+# ---- spec parsing ----------------------------------------------------------
+
+
+def test_parse_spec_issue_syntax():
+    got = faults.parse_spec(
+        "cluster.dial=error:3,journal.fsync=sleep:0.2,codec.decode=corrupt"
+    )
+    assert got == [
+        ("cluster.dial", "error", None, 3),
+        ("journal.fsync", "sleep", 0.2, None),
+        ("codec.decode", "corrupt", None, None),
+    ]
+
+
+def test_parse_spec_sleep_with_budget_and_whitespace():
+    got = faults.parse_spec(" a.b=sleep:0.5:2 , c.d=drop:1 ,")
+    assert got == [("a.b", "sleep", 0.5, 2), ("c.d", "drop", None, 1)]
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "nameonly",
+        "a.b=explode",
+        "a.b=sleep",  # sleep needs seconds
+        "a.b=sleep:xx",
+        "a.b=error:0",  # budget must be positive
+        "a.b=error:-1",
+        "a.b=error:2:9",  # trailing arg
+        "a.b=drop:x",
+    ],
+)
+def test_parse_spec_rejects(bad):
+    with pytest.raises(faults.FaultSpecError):
+        faults.parse_spec(bad)
+
+
+# ---- action semantics ------------------------------------------------------
+
+
+def test_unarmed_point_is_identity():
+    assert faults.point("never.armed") is None
+    assert faults.point("never.armed", b"data") == b"data"
+    assert faults.hits("never.armed") == 0
+
+
+def test_error_action_raises_connection_and_os_error():
+    faults.arm("x.y", "error")
+    with pytest.raises(faults.FaultError):
+        faults.point("x.y")
+    # the whole design leans on this: seams catch ConnectionError/OSError
+    assert issubclass(faults.FaultError, ConnectionError)
+    assert issubclass(faults.FaultError, OSError)
+
+
+def test_budget_bounds_firings_and_hits_survive_disarm():
+    faults.arm("x.y", "error", budget=2)
+    for _ in range(2):
+        with pytest.raises(faults.FaultError):
+            faults.point("x.y")
+    # exhausted: the point disarmed itself, calls are free again
+    assert faults.point("x.y", b"ok") == b"ok"
+    assert faults.hits("x.y") == 2
+    assert "x.y" not in faults.armed_points()
+
+
+def test_corrupt_is_deterministic_and_single_byte():
+    faults.arm("x.y", "corrupt", budget=2)
+    a = faults.point("x.y", b"hello world")
+    b = faults.point("x.y", b"hello world")
+    assert a == b != b"hello world"
+    assert len(a) == 11
+    assert sum(x != y for x, y in zip(a, b"hello world")) == 1
+
+
+def test_drop_returns_none_and_dataless_degrades_to_error():
+    faults.arm("x.y", "drop", budget=2)
+    assert faults.point("x.y", b"data") is None
+    with pytest.raises(faults.FaultError):
+        faults.point("x.y")  # data-less site: documented degradation
+    faults.arm("x.y", "corrupt")
+    with pytest.raises(faults.FaultError):
+        faults.point("x.y")
+
+
+def test_sleep_action_blocks_sync_and_async():
+    faults.arm("x.y", "sleep", arg=0.05, budget=2)
+    t0 = time.perf_counter()
+    assert faults.point("x.y", b"d") == b"d"
+    assert time.perf_counter() - t0 >= 0.04
+
+    async def drive():
+        t0 = time.perf_counter()
+        assert await faults.async_point("x.y", b"d") == b"d"
+        return time.perf_counter() - t0
+
+    assert asyncio.run(drive()) >= 0.04
+
+
+def test_crash_handler_replaces_process_exit():
+    crashed = []
+    faults.set_crash_handler(crashed.append)
+    faults.arm("x.y", "crash", budget=1)
+    faults.point("x.y")
+    assert crashed == ["x.y"]
+
+
+def test_arm_spec_and_reset():
+    faults.arm_spec("a.b=drop:1,c.d=error")
+    assert set(faults.armed_points()) == {"a.b", "c.d"}
+    faults.reset()
+    assert faults.armed_points() == {}
+    assert faults.hits("a.b") == 0
+
+
+def test_rearm_wins_over_stale_budget():
+    faults.arm("x.y", "error", budget=1)
+    faults.arm("x.y", "drop")  # re-arm before the budget was consumed
+    assert faults.point("x.y", b"d") is None
+    assert faults.point("x.y", b"d") is None  # no budget: keeps firing

@@ -1,0 +1,169 @@
+"""Pinning tests for the held-delta queue semantics (cluster.py).
+
+The held buffer is the ONE place the system knowingly trades data for
+memory (writes flushed with zero reachable peers are held up to a cap;
+past it, oldest batches are evicted — documented loss). These tests pin
+the three behaviors the robustness round made contractual:
+
+* strict FIFO: held batches ship BEFORE any fresh broadcast, in hold
+  order, so a late-joining peer sees pre-join writes oldest-first;
+* oldest-first eviction at the cap, with the drop COUNTED in the
+  CLUSTER metrics (`held_drops`) — never silent;
+* the eviction warn fires once per episode (a drained queue re-arms
+  it), not once per evicted batch.
+"""
+
+import io
+
+import test_cluster
+from jylis_tpu.cluster import codec
+from jylis_tpu.cluster.cluster import _Conn, check_frame
+from jylis_tpu.cluster.framing import FrameReader
+from jylis_tpu.utils.address import Address
+from jylis_tpu.utils.log import Log
+
+
+class _SinkWriter:
+    """Established-conn stand-in recording every framed write."""
+
+    class _T:
+        def is_closing(self):
+            return False
+
+        def get_write_buffer_size(self):
+            return 0
+
+    def __init__(self):
+        self.transport = self._T()
+        self.wrote = bytearray()
+        self.closed = False
+
+    def write(self, data):
+        self.wrote.extend(data)
+
+    def close(self):
+        self.closed = True
+
+
+def _pushed_keys(raw: bytes) -> list[bytes]:
+    """Decode a recorded write stream into MsgPushDeltas key lists."""
+    frames = FrameReader()
+    frames.append(bytes(raw))
+    out = []
+    for body in frames:
+        payload = check_frame(body)  # transport CRC wrapper (schema v5)
+        assert payload is not None
+        msg = codec.decode(payload)
+        out.extend(key for key, _ in msg.batch)
+    return out
+
+
+def _batch(key: bytes):
+    return ("GCOUNT", [(key, {1: 1})])
+
+
+def _solo_cluster(log=None):
+    node = test_cluster.Node("solo", test_cluster.grab_ports(1)[0])
+    if log is not None:
+        node.cluster._log = log
+    return node.cluster
+
+
+def _attach(cluster) -> _SinkWriter:
+    w = _SinkWriter()
+    addr = Address("127.0.0.1", "1", "peer")
+    conn = _Conn(w, addr)
+    conn.established = True
+    cluster._actives[addr] = conn
+    return w
+
+
+def test_flush_held_is_fifo_before_fresh_broadcasts():
+    cl = _solo_cluster()
+    # no actives: three worth-holding batches queue in order
+    for key in (b"h1", b"h2", b"h3"):
+        cl.broadcast_deltas(_batch(key))
+    assert len(cl._held) == 3
+    w = _attach(cl)
+    # the fresh batch must queue BEHIND the held ones on the wire
+    cl.broadcast_deltas(_batch(b"fresh"))
+    assert _pushed_keys(w.wrote) == [b"h1", b"h2", b"h3", b"fresh"]
+    assert cl._held == []
+
+
+def test_fresh_batch_queues_behind_unsendable_held():
+    """If the held queue cannot drain, a fresh batch joins the back of
+    the queue rather than jumping it (strict FIFO even under failure)."""
+    cl = _solo_cluster()
+    cl.broadcast_deltas(_batch(b"h1"))
+    cl.broadcast_deltas(_batch(b"fresh"))
+    assert len(cl._held) == 2
+    w = _attach(cl)
+    cl.broadcast_deltas(_batch(b"fresh2"))
+    assert _pushed_keys(w.wrote) == [b"h1", b"fresh", b"fresh2"]
+
+
+def test_eviction_is_oldest_first_and_counted():
+    cl = _solo_cluster()
+    cl._held_cap = 3
+    for key in (b"k1", b"k2", b"k3", b"k4", b"k5"):
+        cl.broadcast_deltas(_batch(key))
+    # oldest evicted, newest kept, loss counted
+    w = _attach(cl)
+    cl.broadcast_deltas(_batch(b"post"))
+    assert _pushed_keys(w.wrote) == [b"k3", b"k4", b"k5", b"post"]
+    assert cl.metrics_totals()["held_drops"] == 2
+    assert cl.metrics_totals()["held_now"] == 0
+
+
+def test_eviction_under_connection_churn_keeps_newest():
+    """A flaky peer (every send fails) churns the connection per
+    broadcast; held batches must still evict oldest-first at the cap."""
+    cl = _solo_cluster()
+    cl._held_cap = 2
+
+    class _DeadWriter(_SinkWriter):
+        class _T:
+            def is_closing(self):
+                return True  # send_raw -> False -> conn dropped
+
+            def get_write_buffer_size(self):
+                return 0
+
+        def __init__(self):
+            super().__init__()
+            self.transport = self._T()
+
+    for i, key in enumerate((b"c1", b"c2", b"c3", b"c4")):
+        # a fresh dead conn per broadcast: churn
+        addr = Address("127.0.0.1", str(100 + i), "churn")
+        conn = _Conn(_DeadWriter(), addr)
+        conn.established = True
+        cl._actives[addr] = conn
+        cl.broadcast_deltas(_batch(key))
+    w = _attach(cl)
+    cl.broadcast_deltas(_batch(b"post"))
+    assert _pushed_keys(w.wrote) == [b"c3", b"c4", b"post"]
+    assert cl.metrics_totals()["held_drops"] == 2
+
+
+def test_eviction_warns_once_per_episode():
+    sink = io.StringIO()
+    cl = _solo_cluster(log=Log("warn", out=sink))
+    cl._held_cap = 1
+    cl.broadcast_deltas(_batch(b"e1"))
+    cl.broadcast_deltas(_batch(b"e2"))  # evicts e1: warn
+    cl.broadcast_deltas(_batch(b"e3"))  # same episode: silent
+    assert sink.getvalue().count("held-delta cap") == 1
+    # episode ends when the queue drains; removing the conn again starts
+    # a new episode that must warn again
+    w = _attach(cl)
+    cl.broadcast_deltas(_batch(b"mid"))
+    assert cl._held == []
+    for addr in list(cl._actives):
+        cl._drop(cl._actives[addr])
+    del w
+    cl.broadcast_deltas(_batch(b"f1"))
+    cl.broadcast_deltas(_batch(b"f2"))  # evicts f1: second episode warn
+    assert sink.getvalue().count("held-delta cap") == 2
+    assert cl.metrics_totals()["held_drops"] == 3

@@ -18,7 +18,7 @@ if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
 from scripts import jlint  # noqa: E402
-from scripts.jlint import pass_async, pass_jax, pass_parity  # noqa: E402
+from scripts.jlint import pass_async, pass_failpoints, pass_jax, pass_parity  # noqa: E402
 
 
 def analyze(tmp_path, code: str, which=pass_async):
@@ -483,6 +483,102 @@ def test_missing_manifest_fails(tmp_path):
         native={"TREG": ["GET"]}, python={"TREG": ["GET"]},
     )
     assert any(f.rule == "JL302" for f in findings)
+
+
+# ---- pass 4: failpoint manifest parity (JL401/JL402) -----------------------
+
+FAKE_FAULTY = '''
+from jylis_tpu import faults
+
+def seam(data):
+    faults.point("good.site", data)
+    faults.point("undeclared.site")
+
+async def aseam(name):
+    await faults.async_point("computed." + name)
+'''
+
+
+def _fp_manifest(tmp_path, failpoints):
+    p = tmp_path / "failpoints.json"
+    p.write_text(json.dumps({"failpoints": failpoints}))
+    return str(p)
+
+
+def _fp_sites(tmp_path):
+    d = tmp_path / "jylis_tpu"
+    d.mkdir()
+    (d / "mod.py").write_text(FAKE_FAULTY)
+    return pass_failpoints.extract_sites(str(tmp_path), ("jylis_tpu",))
+
+
+def test_failpoint_nonliteral_name_fails(tmp_path):
+    sites, problems = _fp_sites(tmp_path)
+    assert set(sites) == {"good.site", "undeclared.site"}
+    assert any(
+        f.rule == "JL401" and "string literal" in f.msg for f in problems
+    )
+
+
+def test_undeclared_failpoint_fails(tmp_path):
+    sites, problems = _fp_sites(tmp_path)
+    path = _fp_manifest(tmp_path, {"good.site": "a fine seam"})
+    findings = pass_failpoints.check(path, sites, problems)
+    assert any(
+        f.rule == "JL401" and "undeclared.site" in f.msg for f in findings
+    )
+
+
+def test_stale_and_placeholder_failpoint_entries_fail(tmp_path):
+    sites, problems = _fp_sites(tmp_path)
+    path = _fp_manifest(
+        tmp_path,
+        {
+            "good.site": pass_failpoints.PLACEHOLDER,  # undescribed
+            "undeclared.site": "described",
+            "gone.site": "no call site uses this",  # stale
+        },
+    )
+    findings = pass_failpoints.check(path, sites, problems)
+    assert any(
+        f.rule == "JL402" and "gone.site" in f.msg for f in findings
+    )
+    assert any(
+        f.rule == "JL402" and "no description" in f.msg for f in findings
+    )
+
+
+def test_described_failpoints_clean(tmp_path):
+    d = tmp_path / "jylis_tpu"
+    d.mkdir()
+    (d / "mod.py").write_text(
+        "from jylis_tpu import faults\n"
+        'def seam(d):\n    return faults.point("only.site", d)\n'
+    )
+    sites, problems = pass_failpoints.extract_sites(
+        str(tmp_path), ("jylis_tpu",)
+    )
+    path = _fp_manifest(tmp_path, {"only.site": "the one seam"})
+    assert pass_failpoints.check(path, sites, problems) == []
+
+
+def test_missing_failpoints_manifest_fails(tmp_path):
+    sites, problems = _fp_sites(tmp_path)
+    findings = pass_failpoints.check(
+        str(tmp_path / "nope.json"), sites, problems
+    )
+    assert any(f.rule == "JL402" and "missing" in f.msg for f in findings)
+
+
+def test_real_failpoints_manifest_matches_sites():
+    """Every faults.point()/async_point() name in the product tree is
+    declared and described; no stale entries — `make lint` is clean."""
+    assert pass_failpoints.check() == []
+    # and the committed manifest names exactly the drill matrix's sites
+    manifest = pass_failpoints.load_manifest()
+    sites, problems = pass_failpoints.extract_sites()
+    assert problems == []
+    assert sorted(manifest) == sorted(sites)
 
 
 # ---- the real repo ----------------------------------------------------------
