@@ -5,7 +5,7 @@ PY ?= python
 
 .PHONY: test soak bench bench-all bench-full bench-smoke native run clean \
         check-graft ci check-prose image compose-smoke smoke3 release \
-        lint sanitize chaos
+        lint sanitize chaos metrics-smoke
 
 # what CI runs per commit (.github/workflows/ci.yml + .circleci/config.yml):
 # hermetic on any host. `test` includes the journal suite
@@ -15,7 +15,8 @@ PY ?= python
 # RESP surface parity, failpoint manifest parity); `sanitize` rebuilds the
 # native engine under ASAN+UBSAN with -Werror and re-runs the jax-free
 # native test subset; `chaos` is the tiny fault-injection drill smoke.
-ci: native lint test chaos check-graft check-prose bench-smoke sanitize
+ci: native lint test chaos check-graft check-prose bench-smoke \
+    metrics-smoke sanitize
 
 # the three jlint passes + the broad-except rule, against the committed
 # baseline (scripts/jlint/baseline.json — every entry justified in-line,
@@ -51,6 +52,12 @@ check-prose:
 # pinned to CPU — it checks the harness, not the hardware
 bench-smoke:
 	JAX_PLATFORMS=cpu $(PY) bench.py --smoke
+
+# boot a real node with --metrics-port, scrape it, validate the
+# Prometheus exposition grammar + presence of every histogram/gauge in
+# scripts/jlint/metrics_manifest.json (the scrape surface can't rot)
+metrics-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/metrics_smoke.py
 
 test:
 	$(PY) -m pytest tests/ -x -q

@@ -328,6 +328,7 @@ def _concurrent_rate(
     reps: int = 60,
     bursts: int = 4,
     demote: bool = False,
+    obs: bool = True,
 ) -> tuple[float, float]:
     """Whole-node (commands/sec, fallback_frac) with n_clients pipelined
     connections issuing the all-commands mix (_mix_burst, per-client
@@ -339,7 +340,11 @@ def _concurrent_rate(
     proactive flush path; ``journal_dir`` additionally attaches a delta
     write-ahead journal there — the sink-vs-sink+journal ratio isolates
     the journal's append+fsync cost on the serving path. ``demote``
-    prepends one demoting command per connection (_demoter_cmd)."""
+    prepends one demoting command per connection (_demoter_cmd).
+    ``obs=False`` disables the node's MetricsRegistry, which makes every
+    observability seam skip its clock reads AND bucket increments — the
+    with-vs-without ratio is the recorded `obs_cost_frac` (the full cost
+    of always-on histograms, perf_counter calls included)."""
     import asyncio
     import os
 
@@ -353,12 +358,16 @@ def _concurrent_rate(
         cfg.port = "0"
         cfg.log = Log.create_none()
         db = Database(identity=1)
+        if not obs:
+            db.metrics.enabled = False
         journal = None
         if journal_dir is not None:
             from jylis_tpu.journal import Journal
 
             journal = Journal(
-                os.path.join(journal_dir, "journal.jylis"), fsync="interval"
+                os.path.join(journal_dir, "journal.jylis"),
+                fsync="interval",
+                registry=db.metrics,
             )
             journal.open()
             db.set_journal(journal)
@@ -450,6 +459,19 @@ def config_concurrent() -> dict:
     base = statistics.median(bases)
     withj = statistics.median(withjs)
 
+    # always-on observability cost (obs/): the same 64-conn run with the
+    # registry armed (the shipped default — histograms on every seam)
+    # vs disabled (seams skip clock reads AND increments). Interleaved
+    # PAIRS, ratio per pair, median of ratios: whole-node rates drift
+    # run to run, and the paired ratio cancels that drift where two
+    # independent medians would not.
+    obs_ratios = []
+    for _ in range(3):
+        on = _concurrent_rate(64)[0]
+        off = _concurrent_rate(64, obs=False)[0]
+        obs_ratios.append(on / off)
+    obs_cost = max(0.0, 1.0 - statistics.median(obs_ratios))
+
     # baseline: per-command reference work, no server — one dict/list op
     # per command of the mix (reads are lookups/slices, generous to the
     # baseline: the real TLOG GET renders a sorted merged view)
@@ -490,6 +512,7 @@ def config_concurrent() -> dict:
         "vs_one_conn": round(r64 / r1, 2),
         "fallback_frac": round(fallback, 4),
         "journal_cost_frac": round(max(0.0, 1 - withj / base), 2),
+        "obs_cost_frac": round(obs_cost, 3),
     }
 
 
@@ -1296,6 +1319,9 @@ def smoke() -> None:
     rd, fbd = _concurrent_rate(2, reps=8, bursts=2, demote=True)
     # a demoted connection serves everything from the Python path
     assert rd > 0 and fbd > 0.5, (rd, fbd)
+    # the obs-off comparison path (obs_cost_frac's denominator) serves
+    ro, _ = _concurrent_rate(2, reps=8, bursts=2, obs=False)
+    assert ro > 0, ro
     lat = _latency_once(2, rounds=6)
     assert all(p50 > 0 and p99 >= p50 for p50, p99 in lat.values()), lat
     print(

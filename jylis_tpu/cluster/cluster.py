@@ -32,9 +32,12 @@ from __future__ import annotations
 
 import asyncio
 import struct
+import time
 import zlib
+from collections import deque
 
 from .. import faults
+from ..obs.trace import now_ms
 from ..ops.p2set import P2Set
 from ..utils.address import Address
 from ..utils.net import ipv4_port
@@ -46,6 +49,7 @@ from .msg import (
     MsgExchangeAddrs,
     MsgPong,
     MsgPushDeltas,
+    MsgSyncDone,
     MsgSyncRequest,
 )
 
@@ -92,21 +96,44 @@ DIAL_BACKOFF_CAP = 32
 # sync heal re-ships the true state. The on-disk formats are unchanged:
 # the journal has its own per-frame CRC, snapshots are
 # write-then-rename + full validation.
+#
+# Schema v6 adds the sender's wall-clock origin (ms, u64be) between the
+# CRC and the body, covered by the CRC: the one distributed quantity a
+# delta-CRDT store exists to bound — how stale a delta is when it
+# becomes visible on a replica — was observable nowhere before this.
+# Receivers subtract the stamp at apply time to feed the per-peer
+# converge_lag_ms gauge. Stamping the TRANSPORT (not MsgPushDeltas)
+# keeps snapshots/journals — which store bare message payloads under
+# delta_signature — loadable across the bump; origin 0 means unstamped.
 _WIRE_CRC_LEN = 4
+_WIRE_ORIGIN_LEN = 8
 
 
-def wire_frame(body: bytes) -> bytes:
-    """One cluster transport frame: framing header + crc32(body) + body."""
-    return frame(struct.pack(">I", zlib.crc32(body)) + body)
+# one wall-clock-ms source for origin stamps AND trace timestamps, so
+# the two surfaces can never disagree about when an event happened
+_now_ms = now_ms
 
 
-def check_frame(raw: bytes) -> bytes | None:
-    """CRC-validate one received frame; the payload, or None if corrupt."""
-    if len(raw) < _WIRE_CRC_LEN:
+def wire_frame(body: bytes, origin_ms: int | None = None) -> bytes:
+    """One cluster transport frame: framing header + crc32(stamp+body)
+    + origin stamp + body. ``origin_ms`` defaults to now."""
+    stamped = struct.pack(
+        ">Q", _now_ms() if origin_ms is None else origin_ms
+    ) + body
+    return frame(struct.pack(">I", zlib.crc32(stamped)) + stamped)
+
+
+def check_frame(raw: bytes) -> tuple[int, bytes] | None:
+    """CRC-validate one received frame; (origin_ms, payload), or None
+    if corrupt/short."""
+    if len(raw) < _WIRE_CRC_LEN + _WIRE_ORIGIN_LEN:
         return None
     (crc,) = struct.unpack_from(">I", raw)
-    payload = raw[_WIRE_CRC_LEN:]
-    return payload if zlib.crc32(payload) == crc else None
+    stamped = raw[_WIRE_CRC_LEN:]
+    if zlib.crc32(stamped) != crc:
+        return None
+    (origin_ms,) = struct.unpack_from(">Q", stamped)
+    return origin_ms, stamped[_WIRE_ORIGIN_LEN:]
 
 
 class Drop:
@@ -157,6 +184,7 @@ class _Conn:
         "writer", "active_addr", "peer_addr", "established", "task",
         "sync_served_tick",
         "sync_digests", "sync_defer_streak", "sync_defer_last_tick",
+        "pong_sent",
     )
 
     def __init__(self, writer, active_addr: Address | None):
@@ -169,7 +197,7 @@ class _Conn:
         self.established = False
         self.task: asyncio.Task | None = None
         # tick of the last sync served on this conn (rate limit: repeated
-        # requests within the cooldown get a Pong, not another dump)
+        # requests within the cooldown get a SyncDone, not another dump)
         self.sync_served_tick: int | None = None
         self.sync_digests = ()  # the requester's per-type digests, if any
         # consecutive mid-heal serve deferrals for THIS requester, capped
@@ -181,6 +209,18 @@ class _Conn:
         # finite-refusal guarantee hold for EACH requester.
         self.sync_defer_streak = 0
         self.sync_defer_last_tick: int | None = None
+        # send time of EVERY Pong-soliciting frame (push/announce)
+        # awaiting its Pong on this ACTIVE conn — the cluster.rtt
+        # histogram's heartbeat-send→Pong seam. Every such send is
+        # stamped and every Pong pops, so the FIFO match is exact even
+        # through a held-delta flush that puts hundreds of sends in
+        # flight at once (a maxlen here would evict under that burst and
+        # desync every later match by the evicted count). Growth is
+        # bounded without a cap: in-flight frames are limited by the
+        # conn's WRITE_BUFFER_LIMIT, a peer that stops replying is
+        # idle-evicted within IDLE_TICKS_LIMIT ticks, and the deque dies
+        # with the conn.
+        self.pong_sent: deque = deque()
 
     # a peer that keeps ponging but stops reading would otherwise grow the
     # transport write buffer without bound
@@ -252,8 +292,10 @@ class Cluster:
         # pure loss (the reference loses them the same way — a known gap,
         # SURVEY.md §2.5); holding them until a peer is reachable strictly
         # reduces loss without changing fire-and-forget semantics. Bounded:
-        # oldest batches drop past the cap.
-        self._held: list[bytes] = []
+        # oldest batches drop past the cap. Entries are (held_at_ms,
+        # frame): the age of the OLDEST entry is the anti-entropy
+        # backlog's time dimension (the backlog_ms gauge).
+        self._held: list[tuple[int, bytes]] = []
         self._held_cap = 1024
         self._flush_tasks: set = set()  # strong refs; asyncio's are weak
         self._sync_req_tick: dict[Address, int] = {}  # rate limit per peer
@@ -276,12 +318,28 @@ class Cluster:
         self._sync_rx_tick: int | None = None
         self._sync_serve_defer_total = 0  # consecutive defers, any conn
         self._sync_defer_total_tick: int | None = None
+        # observability (obs/): round-trip + convergence-lag histograms
+        # from the owning Database's registry, per-peer lag EWMAs, and
+        # the wall clock the backlog gauge ages held deltas against
+        from ..utils import metrics as _metrics
+
+        self._reg = _metrics.resolve_registry(database)
+        self._h_rtt = self._reg.hist("cluster.rtt")
+        self._h_lag = self._reg.hist("cluster.converge_lag")
+        # peer identity (str address) -> push→apply lag EWMA in ms; a
+        # digest match folds in as a zero-lag sample (the peer is
+        # provably converged at that wall instant)
+        self._lag_ms: dict[str, float] = {}
+        # wall time the current consecutive-defer episode began (the
+        # deferred-sync side of the backlog gauge); None when serving
+        self._defer_since_ms: int | None = None
         # SYSTEM METRICS' CLUSTER section reads straight from this
         # instance (wired here, not in main, so in-process test nodes
         # get the same observability as spawned ones)
         system = getattr(database, "system", None)
         if system is not None:
             system.cluster_fn = self.metrics_totals
+            system.lag_fn = self.lag_snapshot
 
     # ---- lifecycle --------------------------------------------------------
 
@@ -318,6 +376,18 @@ class Cluster:
             return
         self._tick += 1
         self._evict_idle()
+        if (
+            self._defer_since_ms is not None
+            and self._sync_defer_total_tick is not None
+            and self._tick - self._sync_defer_total_tick
+            > 6 * SYNC_PERIOD_TICKS
+        ):
+            # nobody has been deferred for several sync periods: every
+            # live requester re-pulls at least that often, so the defer
+            # episode is over (served requests clear the clock on the
+            # serve path; a requester that crashed mid-episode would
+            # otherwise leave backlog_ms climbing forever)
+            self._defer_since_ms = None
         if self._tick % ANNOUNCE_EVERY == 0:
             self._broadcast_msg(MsgAnnounceAddrs(self._known_addrs.copy()))
         if self._tick % SYNC_PERIOD_TICKS == 0:
@@ -379,10 +449,54 @@ class Cluster:
             "sync_deferred": self._stats["sync_deferred"],
             "held_now": len(self._held),
             "held_drops": self._stats["held_drops"],
+            # the time dimension of anti-entropy health: worst per-peer
+            # push→apply staleness, and how long work has been backed up
+            # (held deltas / deferred sync serves) — both also published
+            # as registry gauges for the Prometheus scrape
+            "converge_lag_ms": int(self._worst_lag_ms()),
+            "backlog_ms": int(self._backlog_ms()),
         }
         for reason in sorted(self._drop_counts):
             out[f"drop_{reason}"] = self._drop_counts[reason]
         return out
+
+    # ---- convergence lag / backlog (obs) -----------------------------------
+
+    # EWMA weight for a fresh lag sample: heavy enough that a healed
+    # partition's gauge decays back to baseline within a few pushes,
+    # smooth enough that one GC pause doesn't spike the surface
+    LAG_ALPHA = 0.5
+
+    def _note_lag(self, peer: str, lag_ms: float) -> None:
+        if not self._reg.enabled:
+            return  # the obs kill switch covers the lag surface too
+        old = self._lag_ms.get(peer)
+        self._lag_ms[peer] = (
+            lag_ms if old is None
+            else old + self.LAG_ALPHA * (lag_ms - old)
+        )
+        self._h_lag.record(lag_ms / 1e3)
+        self._reg.gauge_set("cluster.converge_lag_ms", self._worst_lag_ms())
+
+    def _worst_lag_ms(self) -> float:
+        return max(self._lag_ms.values(), default=0.0)
+
+    def lag_snapshot(self) -> dict[str, float]:
+        """{peer address: push→apply lag EWMA ms} — SYSTEM LATENCY's
+        per-peer lines."""
+        return dict(self._lag_ms)
+
+    def _backlog_ms(self) -> float:
+        """Age of the oldest held delta batch, or of the current
+        sync-serve defer episode — whichever says work has been waiting
+        longer. Published as the cluster.backlog_ms gauge."""
+        now = _now_ms()
+        age = float(now - self._held[0][0]) if self._held else 0.0
+        if self._defer_since_ms is not None:
+            age = max(age, float(now - self._defer_since_ms))
+        if self._reg.enabled:
+            self._reg.gauge_set("cluster.backlog_ms", age)
+        return age
 
     def _flush_task_done(self, task) -> None:
         self._flush_tasks.discard(task)
@@ -457,6 +571,7 @@ class Cluster:
         passes (or immediately after inbound contact from it)."""
         self._actives.pop(addr, None)
         self._stats["dial_fails"] += 1
+        self._reg.trace_event("cluster", "dial_fail", "", str(addr))
         st = self._peers.get(addr)
         if st is None:
             st = self._peers[addr] = _PeerState()
@@ -527,13 +642,14 @@ class Cluster:
                     raw = await faults.async_point("cluster.decode", raw)
                     if raw is None:
                         continue
-                    body = check_frame(raw)
-                    if body is None:
+                    checked = check_frame(raw)
+                    if checked is None:
                         self._log.err() and self._log.e(
                             "cluster frame CRC mismatch"
                         )
                         self._drop(conn, Drop.CRC)
                         return
+                    origin_ms, body = checked
                     if not conn.established:
                         if not self._handshake(conn, body, active):
                             return
@@ -547,9 +663,9 @@ class Cluster:
                         self._drop(conn, Drop.CODEC)
                         return
                     if active:
-                        await self._active_msg(conn, msg)
+                        await self._active_msg(conn, msg, origin_ms)
                     else:
-                        await self._passive_msg(conn, msg)
+                        await self._passive_msg(conn, msg, origin_ms)
         except (ConnectionError, asyncio.CancelledError, FramingError):
             pass
         finally:
@@ -601,9 +717,43 @@ class Cluster:
 
     # ---- message handling --------------------------------------------------
 
-    async def _active_msg(self, conn: _Conn, msg) -> None:
+    def _peer_key(self, conn: _Conn) -> str:
+        """Stable per-peer identity for the lag gauge: the dialed
+        address (actives) or the v5 handshake's advertised address
+        (passives)."""
+        if conn.active_addr is not None:
+            return str(conn.active_addr)
+        if conn.peer_addr is not None:
+            return str(conn.peer_addr)
+        return "unknown"
+
+    def _record_push_lag(self, conn: _Conn, origin_ms: int) -> None:
+        """Push→apply convergence lag: the frame's v6 origin stamp vs
+        NOW (the converge just completed). origin 0 = unstamped sender
+        (should not happen post-v6, but records nothing rather than a
+        50-year lag)."""
+        if origin_ms and self._reg.enabled:
+            self._note_lag(
+                self._peer_key(conn), max(_now_ms() - origin_ms, 0)
+            )
+
+    async def _active_msg(self, conn: _Conn, msg, origin_ms: int = 0) -> None:
         if isinstance(msg, MsgPong):
+            # heartbeat-send → Pong round-trip (cluster.rtt): matched
+            # against the oldest outstanding Pong-soliciting send. The
+            # FIFO match is exact because Pongs answer ONLY stamped
+            # push/announce sends, in order — sync replies are
+            # MsgSyncDone, never Pong. Pop unconditionally; the enabled
+            # switch gates only the record, so a mid-conn toggle can
+            # never strand stamps and shift later matches
+            if conn.pong_sent:
+                dt = time.perf_counter() - conn.pong_sent.popleft()
+                if self._reg.enabled:
+                    self._h_rtt.record(dt)
             return  # liveness only
+        if isinstance(msg, MsgSyncDone):
+            return  # sync reply: liveness only (requester re-pulls by
+            # cooldown; a deferred or matched request needs no data)
         if isinstance(msg, MsgExchangeAddrs):
             self._converge_addrs(msg.known_addrs)
             return
@@ -613,14 +763,15 @@ class Cluster:
             # live deltas is harmless
             self._sync_rx_tick = self._tick  # mid-heal: defer serving dumps
             await self._database.converge_async((msg.name, list(msg.batch)))
+            self._record_push_lag(conn, origin_ms)
             return
         self._log.err() and self._log.e(
             f"unexpected active message: {type(msg).__name__}"
         )
         self._drop(conn, Drop.UNEXPECTED)
 
-    async def _passive_msg(self, conn: _Conn, msg) -> None:
-        if isinstance(msg, MsgPong):
+    async def _passive_msg(self, conn: _Conn, msg, origin_ms: int = 0) -> None:
+        if isinstance(msg, (MsgPong, MsgSyncDone)):
             return
         if isinstance(msg, MsgExchangeAddrs):
             # full sync: converge then reply with our own set
@@ -637,6 +788,7 @@ class Cluster:
             # and per-connection delta ordering are unchanged.
             self._send(conn, MsgPong())
             await self._database.converge_async((msg.name, list(msg.batch)))
+            self._record_push_lag(conn, origin_ms)
             return
         if isinstance(msg, MsgAnnounceAddrs):
             self._converge_addrs(msg.known_addrs)
@@ -695,6 +847,10 @@ class Cluster:
                 > 6 * SYNC_PERIOD_TICKS
             ):
                 self._sync_serve_defer_total = 0  # same decay, aggregate
+                # the old defer episode is dead with its streaks: a
+                # fresh defer below starts a fresh backlog clock rather
+                # than inheriting a long-gone requester's wait
+                self._defer_since_ms = None
             # a defer needs BOTH allowances: the per-conn streak (< 2,
             # the fairness cap) and the aggregate consecutive-defer
             # count (< 6 — a churning requester presents a fresh conn
@@ -711,15 +867,20 @@ class Cluster:
                     self._sync_serve_defer_total += 1
                     self._sync_defer_total_tick = self._tick
                     self._stats["sync_deferred"] += 1
+                    if self._defer_since_ms is None:
+                        # the backlog gauge's defer clock: how long
+                        # rejoiners have been waiting on this node
+                        self._defer_since_ms = _now_ms()
                     self._log.info() and self._log.i(
                         "sync: mid-heal, deferring dump "
                         f"(streak {conn.sync_defer_streak}, "
                         f"total {self._sync_serve_defer_total})"
                     )
-                self._send(conn, MsgPong())
+                self._send(conn, MsgSyncDone())
                 return
             conn.sync_defer_streak = 0
             self._sync_serve_defer_total = 0
+            self._defer_since_ms = None  # serving again: backlog clock off
             conn.sync_served_tick = self._tick
             self._stats["sync_served"] += 1
             conn.sync_digests = tuple(msg.digests)
@@ -745,7 +906,7 @@ class Cluster:
         unreachable are not retransmitted; the reference loses them
         permanently — cluster.pony:250-252 converges only what arrives).
         The request carries OUR data digest, so an up-to-date peer
-        answers with a Pong instead of re-shipping everything."""
+        answers with a SyncDone instead of re-shipping everything."""
         addr = conn.active_addr
         last = self._sync_req_tick.get(addr)
         if last is not None and self._tick - last < SYNC_REQUEST_COOLDOWN:
@@ -828,7 +989,7 @@ class Cluster:
         every queued requester, with writer.drain() between frames so a
         large state streams under backpressure instead of tripping the
         16 MB kill limit mid-sync. A requester whose digest matches ours
-        gets the (tiny) SYSTEM frames and a Pong — zero data frames, and
+        gets the (tiny) SYSTEM frames and a SyncDone — zero data frames, and
         the digest comparison itself is the O(dirty) incremental one (no
         dump happens at all when every waiter matches)."""
         try:
@@ -848,7 +1009,11 @@ class Cluster:
                         miss = set(types)  # unknown digest shape: ship all
                     if not miss:
                         # replicated observability (SYSTEM GETLOG): an
-                        # in-sync rejoin is provably zero-cost
+                        # in-sync rejoin is provably zero-cost. The
+                        # digest match also PROVES the peer converged as
+                        # of this wall instant — fold it into the lag
+                        # gauge as a zero-lag sample
+                        self._note_lag(self._peer_key(conn), 0.0)
                         self._log.info() and self._log.i(
                             "sync: peer digest match, zero data frames"
                         )
@@ -917,7 +1082,7 @@ class Cluster:
         for data in frames:
             if not await self._send_frame(conn, data):
                 return
-        self._send(conn, MsgPong())
+        self._send(conn, MsgSyncDone())
 
     def _converge_addrs(self, other: P2Set) -> None:
         """Membership gossip convergence with stale-name self-healing
@@ -965,14 +1130,14 @@ class Cluster:
             self._local_writes_seen = True
         data = wire_frame(codec.encode(MsgPushDeltas(name, tuple(batch))))
         self._flush_held()
-        if self._held or not self._send_to_actives(data):
+        if self._held or not self._send_to_actives(data, expect_pong=True):
             # nobody reachable right now (maybe nobody known yet): hold
             # instead of losing, so a late-joining peer still converges on
             # pre-join writes up to the cap. Empty SYSTEM keepalive frames
             # (deltas_size()==1 quirk) carry nothing and would FIFO-evict
             # real pre-join writes on a long-solo node — don't hold those.
             if self._worth_holding(name, batch):
-                self._held.append(data)
+                self._held.append((_now_ms(), data))
                 over = len(self._held) - self._held_cap
                 if over > 0:
                     # oldest-first eviction at the cap: DOCUMENTED data
@@ -986,20 +1151,30 @@ class Cluster:
     def _worth_holding(name: str, batch) -> bool:
         return codec.batch_has_content(name, batch)
 
-    def _send_to_actives(self, data: bytes) -> bool:
+    def _send_to_actives(self, data: bytes, expect_pong: bool = False) -> bool:
         """Write one pre-framed message to every established active conn;
-        True if it reached at least one."""
+        True if it reached at least one. ``expect_pong`` stamps the send
+        time per conn so the peer's Pong closes a cluster.rtt sample
+        (pushes and announces solicit Pongs; exchanges do not)."""
         sent = False
         for conn in list(self._actives.values()):
             if conn.established:
                 if conn.send_raw(data):
                     sent = True
+                    if expect_pong:
+                        # stamp unconditionally (one float append — not
+                        # the serving hot path the enabled switch
+                        # guards): stamping only-while-enabled would mix
+                        # stamped and unstamped sends on one conn and
+                        # desync the FIFO when the switch flips mid-conn
+                        conn.pong_sent.append(time.perf_counter())
                 else:
                     self._drop(conn, Drop.WRITE_FAILED)
         return sent
 
     def _note_held_drop(self, n: int) -> None:
         self._stats["held_drops"] += n
+        self._reg.trace_event("cluster", "held_evict", "", f"dropped {n}")
         if not self._held_drop_episode:
             # once per eviction EPISODE (a burst of over-cap flushes),
             # not per batch: a long-solo write-hot node would otherwise
@@ -1013,14 +1188,17 @@ class Cluster:
 
     def _flush_held(self) -> None:
         while self._held:
-            data = self._held[0]
-            if not self._send_to_actives(data):
+            data = self._held[0][1]
+            if not self._send_to_actives(data, expect_pong=True):
                 return
             self._held.pop(0)
         self._held_drop_episode = False  # drained: next eviction is news
 
     def _broadcast_msg(self, msg) -> None:
-        self._send_to_actives(wire_frame(codec.encode(msg)))
+        self._send_to_actives(
+            wire_frame(codec.encode(msg)),
+            expect_pong=isinstance(msg, MsgAnnounceAddrs),
+        )
 
     def _send(self, conn: _Conn, msg) -> None:
         if not conn.send_raw(wire_frame(codec.encode(msg))):
@@ -1054,6 +1232,9 @@ class Cluster:
         )
         if tracked:
             self._drop_counts[reason] = self._drop_counts.get(reason, 0) + 1
+            self._reg.trace_event(
+                "cluster", "drop", reason, self._conn_desc(conn)
+            )
             self._log.info() and self._log.i(
                 f"dropping {self._conn_desc(conn)} connection ({reason})"
             )
@@ -1069,6 +1250,14 @@ class Cluster:
                 st.next_dial_tick = self._tick + self._backoff_ticks(
                     conn.active_addr, st.fails
                 )
+        if tracked:
+            # the lag gauge tracks LIVE peers: a departed conn's EWMA
+            # must not pin the node-wide max forever (a rejoin restarts
+            # sampling immediately)
+            self._lag_ms.pop(self._peer_key(conn), None)
+            self._reg.gauge_set(
+                "cluster.converge_lag_ms", self._worst_lag_ms()
+            )
         self._last_activity.pop(conn, None)
         self._passives.discard(conn)
         if conn.active_addr is not None:

@@ -44,10 +44,11 @@ from .msg import (
     MsgExchangeAddrs,
     MsgPong,
     MsgPushDeltas,
+    MsgSyncDone,
     MsgSyncRequest,
 )
 
-SCHEMA_VERSION = 5
+SCHEMA_VERSION = 6
 
 # The canonical schema text: any change to the wire format MUST change this
 # string (bump SCHEMA_VERSION), which changes the signature, which makes
@@ -60,9 +61,22 @@ SCHEMA_VERSION = 5
 # it to identify the peer for teardown logs and to reset its dial
 # backoff on inbound contact); the passive echo remains the bare
 # signature.
+# v6: every transport frame carries its sender's wall-clock origin
+# (milliseconds, u64be, CRC-covered) between the CRC and the body —
+# mirroring the v5 handshake-address precedent of enriching the
+# TRANSPORT layer rather than the message encodings, so snapshots and
+# journals (which store bare message payloads versioned by
+# delta_signature) remain loadable across the bump. Receivers fold the
+# stamp into per-peer convergence-lag gauges (push→apply staleness, the
+# quantity a delta-CRDT store exists to bound) and heartbeat round-trip
+# histograms; origin 0 means "unstamped" and records nothing. Sync
+# replies get their own message (msg5 SyncDone) so a Pong always
+# answers a round-trip-stamped send and the rtt histogram's FIFO
+# matching stays exact — a sync reply's timing includes digest
+# computation or a whole dump stream, which is not a round trip.
 _SCHEMA_TEXT = f"""jylis-tpu cluster schema v{SCHEMA_VERSION}
 varint=LEB128 bytes=varint-len-prefixed str=utf8-bytes
-wire=frame(crc32(body):u32be body)
+wire=frame(crc32(origin_ms:u64be body):u32be origin_ms:u64be body)
 handshake=wire(sig:32B dialer-addr:addr?)
 addr=(host:str port:str name:str)
 p2set=(adds:[addr] removes:[addr])
@@ -71,6 +85,7 @@ msg1=ExchangeAddrs(p2set)
 msg2=AnnounceAddrs(p2set)
 msg3=PushDeltas(name:str batch:[(key:bytes delta)])
 msg4=SyncRequest(digests:[bytes] order=TREG,TLOG,GCOUNT,PNCOUNT,UJSON)
+msg5=SyncDone
 delta/TREG=(value:bytes ts:varint)
 delta/TLOG=delta/SYSTEM=(entries:[(value:bytes ts:varint)] cutoff:varint)
 delta/GCOUNT=[(rid:varint v:varint)]
@@ -350,6 +365,7 @@ _TAG_EXCHANGE = 1
 _TAG_ANNOUNCE = 2
 _TAG_PUSH = 3
 _TAG_SYNC_REQ = 4
+_TAG_SYNC_DONE = 5
 
 
 def encode(msg: Msg) -> bytes:
@@ -366,6 +382,8 @@ def _encode_oracle(msg: Msg) -> bytes:
     out = bytearray()
     if isinstance(msg, MsgPong):
         out.append(_TAG_PONG)
+    elif isinstance(msg, MsgSyncDone):
+        out.append(_TAG_SYNC_DONE)
     elif isinstance(msg, MsgExchangeAddrs):
         out.append(_TAG_EXCHANGE)
         _w_p2set(out, msg.known_addrs)
@@ -407,6 +425,8 @@ def _decode_oracle(body: bytes) -> Msg:
     r.pos = 1
     if tag == _TAG_PONG:
         msg: Msg = MsgPong()
+    elif tag == _TAG_SYNC_DONE:
+        msg = MsgSyncDone()
     elif tag == _TAG_EXCHANGE:
         msg = MsgExchangeAddrs(_r_p2set(r))
     elif tag == _TAG_ANNOUNCE:

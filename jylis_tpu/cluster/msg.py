@@ -27,6 +27,17 @@ class MsgPong:
 
 
 @dataclass(frozen=True)
+class MsgSyncDone:
+    """Reply closing a MsgSyncRequest: sent after the dump stream (or
+    instead of one, when the request is deferred / digest-matched /
+    rate-limited). Distinct from MsgPong so the requester's heartbeat
+    round-trip histogram stays exact: every Pong the active side
+    receives then answers a stamped push/announce send in FIFO order,
+    and sync replies — whose timing includes digest computation or a
+    whole dump stream — never consume a round-trip stamp."""
+
+
+@dataclass(frozen=True)
 class MsgExchangeAddrs:
     known_addrs: P2Set  # P2Set[Address]
 
@@ -52,7 +63,7 @@ class MsgSyncRequest:
     cluster.pony:250-252 converges only what is pushed). The requester
     sends this after establishing an active connection (and periodically
     thereafter) WITH its own PER-TYPE data-state digests; a peer whose
-    digests all match replies MsgPong (the requester is already in sync
+    digests all match replies MsgSyncDone (the requester is already in sync
     — a flapping connection re-ships nothing), otherwise it streams ONLY
     the mismatched types' state as chunked MsgPushDeltas batches (the
     snapshot wire shape, persist.py), which converge idempotently.
@@ -66,4 +77,11 @@ class MsgSyncRequest:
     digests: tuple = ()
 
 
-Msg = MsgPong | MsgExchangeAddrs | MsgAnnounceAddrs | MsgPushDeltas | MsgSyncRequest
+Msg = (
+    MsgPong
+    | MsgSyncDone
+    | MsgExchangeAddrs
+    | MsgAnnounceAddrs
+    | MsgPushDeltas
+    | MsgSyncRequest
+)

@@ -101,9 +101,17 @@ class Journal:
         fsync_interval: float = 0.2,
         max_bytes: int = 64 << 20,
         clock=time.monotonic,
+        registry=None,
     ):
         if fsync not in (FSYNC_ALWAYS, FSYNC_INTERVAL, FSYNC_OFF):
             raise ValueError(f"unknown fsync policy: {fsync}")
+        # the owning Database's MetricsRegistry (main.py passes it);
+        # registry-less direct drives record into the process DEFAULT —
+        # counters, the append/fsync latency histograms, and the trace
+        # ring all ride this one handle
+        self._reg = registry if registry is not None else metrics.DEFAULT
+        self._h_append = self._reg.hist("journal.append")
+        self._h_fsync = self._reg.hist("journal.fsync")
         self._path = path
         self._fsync = fsync
         self._fsync_interval = fsync_interval
@@ -294,7 +302,7 @@ class Journal:
                 try:
                     synced = self._sync_file(f)
                     if synced:
-                        metrics.note_journal("fsyncs")
+                        self._reg.note_journal("fsyncs")
                 finally:
                     with self._cv:
                         self._busy = False
@@ -316,7 +324,8 @@ class Journal:
                     # silently ends durability); recorded via last_error
                     # and the JOURNAL errors counter
                     self.last_error = e  # jlint: shared-ok (atomic diagnostic ref)
-                    metrics.note_journal("errors")
+                    self._reg.note_journal("errors")
+                    self._reg.trace_event("journal", "error", "encode", repr(e))
                 if data is not None and f is None:
                     # no active segment (a failed rotation): the batch
                     # cannot be made durable — count the drop instead of
@@ -326,7 +335,8 @@ class Journal:
                     # (--snapshot-interval 0) nothing else ever would.
                     # Paced to append cadence, so a dead disk retries
                     # per flush, not in a hot loop.
-                    metrics.note_journal("errors")
+                    self._reg.note_journal("errors")
+                    self._reg.trace_event("journal", "error", "no_segment")
                     with self._cv:
                         if (
                             not self._rotation_asked
@@ -343,11 +353,14 @@ class Journal:
                         # it — the drill's local-durability-loss case)
                         data = faults.point("journal.append", data)
                         if data is not None:
+                            t0 = time.perf_counter() if self._reg.enabled else 0.0
                             f.write(data)
                             # push past userspace buffering: a SIGKILL
                             # must lose at most the queued tail, never
                             # batches parked in Python's file buffer
                             f.flush()
+                            if t0:
+                                self._h_append.record(time.perf_counter() - t0)
                             wrote = len(data)
                             # _busy protocol: while set, the writer owns
                             # _f and the fsync bookkeeping — rotation and
@@ -364,7 +377,8 @@ class Journal:
                                 synced = self._sync_file(f)
                     except OSError as e:  # full disk etc: keep the writer
                         self.last_error = e  # jlint: shared-ok (atomic diagnostic ref)
-                        metrics.note_journal("errors")
+                        self._reg.note_journal("errors")
+                        self._reg.trace_event("journal", "error", "append", repr(e))
                 with self._cv:
                     if wrote:
                         self._size += wrote
@@ -383,10 +397,10 @@ class Journal:
                             self._rotation_asked = True
                             ask = True
                 if wrote:
-                    metrics.note_journal("appends")
-                    metrics.note_journal("bytes", wrote)
+                    self._reg.note_journal("appends")
+                    self._reg.note_journal("bytes", wrote)
                 if synced:
-                    metrics.note_journal("fsyncs")
+                    self._reg.note_journal("fsyncs")
                 notify = self.rotate_notify
                 if ask and notify is not None:
                     notify()
@@ -404,10 +418,14 @@ class Journal:
             # skipped, durability window widens); sleep -> a slow disk
             # (writer thread stalls, serving-loop appends keep queueing)
             faults.point("journal.fsync")
+            t0 = time.perf_counter() if self._reg.enabled else 0.0
             os.fsync(f.fileno())
+            if t0:
+                self._h_fsync.record(time.perf_counter() - t0)
         except OSError as e:
             self.last_error = e  # jlint: shared-ok (atomic diagnostic ref)
-            metrics.note_journal("errors")
+            self._reg.note_journal("errors")
+            self._reg.trace_event("journal", "error", "fsync", repr(e))
             return False
         # writer-owns-file protocol (see _run): only the writer (or a
         # drain-holding caller) reaches here. jlint: shared-ok
@@ -431,6 +449,7 @@ class Journal:
         previous version holding ``_cv`` across all of it — every
         append, and with it the event loop, stalled behind the disk for
         up to a full 64 MB segment fold)."""
+        self._reg.trace_event("journal", "rotate")
         with self._cv:
             self._drain_locked()  # queued batches belong to the OLD cut
             self._paused = True  # writer sleeps; appends only enqueue
@@ -486,7 +505,8 @@ class Journal:
             # rotation re-opens the segment; the snapshot loop keeps
             # retrying on its interval
             self.last_error = e  # jlint: shared-ok (atomic diagnostic ref)
-            metrics.note_journal("errors")
+            self._reg.note_journal("errors")
+            self._reg.trace_event("journal", "error", "rotate", repr(e))
         finally:
             with self._cv:
                 self._f = fresh
@@ -587,8 +607,14 @@ def replay_journal(database, path: str, truncate_tail: bool = True) -> int:
         # land replayed state on the device now (persist.py's rationale:
         # a boot-sized host pending buffer taxes every read)
         database.drain_all()
-        metrics.note_journal("replayed_batches", len(msgs))
+        _db_registry(database).note_journal("replayed_batches", len(msgs))
     return len(msgs)
+
+
+def _db_registry(database):
+    """The database's MetricsRegistry, or the process DEFAULT for bare
+    drivers (the replay helpers take any converge-shaped object)."""
+    return metrics.resolve_registry(database)
 
 
 def recover(database, path: str, log=None) -> int:
@@ -605,6 +631,9 @@ def recover(database, path: str, log=None) -> int:
         except JournalError as e:
             if log is not None:
                 log.err() and log.e(f"journal not replayed: {e}")
+            _db_registry(database).trace_event(
+                "journal", "error", "replay_refused", str(e)
+            )
             aside = p + ".unreadable"
             try:
                 os.replace(p, aside)

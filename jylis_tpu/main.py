@@ -123,7 +123,7 @@ class Dispose:
             # covers them and no profiler trace restarts behind our back
             if self._log is not None:
                 self._log.info() and self._log.i(
-                    f"merge metrics: {metrics.report()}"
+                    f"merge metrics: {self._database.metrics.report()}"
                 )
             metrics.stop_profiling()
         finally:
@@ -155,7 +155,10 @@ async def run(argv: list[str] | None = None) -> None:
         faults.arm_spec(config.failpoints)
     system = System(config)
     database_mod.warmup()  # compile serving kernels before going live
-    metrics.counters.clear()  # don't count warmup compiles as serving drains
+    # (warmup's throwaway Database records its compile-time drains into
+    # its OWN registry, so the serving registry starts clean by
+    # construction — the old process-global clear() is gone with the
+    # globals it cleared)
     database = Database(identity=config.addr.hash64(), system_repo=system.repo)
     log = config.log
 
@@ -196,6 +199,7 @@ async def run(argv: list[str] | None = None) -> None:
                 fsync=config.journal_fsync,
                 fsync_interval=config.journal_fsync_interval,
                 max_bytes=config.journal_max_bytes,
+                registry=database.metrics,
             )
             journal.open()  # jlint: blocking-ok (pre-serving boot)
             database.set_journal(journal)
@@ -204,6 +208,16 @@ async def run(argv: list[str] | None = None) -> None:
     cluster = Cluster(config, database)
     await server.start()
     await cluster.start()
+    metrics_http = None
+    if config.metrics_port:
+        # opt-in Prometheus endpoint (obs/prom.py): the SYSTEM METRICS
+        # surface as text exposition, scrapeable without a Redis client
+        from .obs.prom import MetricsHTTP
+
+        metrics_http = MetricsHTTP(
+            database, max(config.metrics_port, 0), log
+        )
+        await metrics_http.start()
     dispose = Dispose(database, server, cluster, snapshot_path, log, journal)
     dispose.on_signal()
 
@@ -222,7 +236,34 @@ async def run(argv: list[str] | None = None) -> None:
     log.info() and log.i(f"jylis-tpu version: {__version__}")
     log.info() and log.i(f"cluster address: {config.addr}")
     log.info() and log.i(f"serving clients on port: {server.port}")
-    await dispose.done.wait()
+    if metrics_http is not None:
+        log.info() and log.i(f"metrics endpoint on port: {metrics_http.port}")
+    try:
+        await dispose.done.wait()
+    except BaseException:  # jlint: broad-ok — re-raised immediately;
+        # unclean shutdown: dump the structured trace ring to stderr —
+        # the node's own account of its final seconds, which the
+        # now-dead SYSTEM TRACE command can no longer serve
+        _dump_trace(database, log)
+        raise
+    finally:
+        if metrics_http is not None:
+            await metrics_http.dispose()
+
+
+def _dump_trace(database, log) -> None:
+    try:
+        entries = database.metrics.trace.dump()
+        if entries:
+            from .obs.trace import TraceRing
+
+            print(f"--- trace ring ({len(entries)} events) ---", file=sys.stderr)
+            for entry in entries:
+                print(TraceRing.format(entry), file=sys.stderr)
+    except Exception as e:  # jlint: broad-ok — the trace dump is
+        # best-effort post-mortem output; failing to render it must not
+        # mask the exception that killed the node
+        log.err() and log.e(f"trace dump failed: {e!r}")
 
 
 async def _snapshot_loop(

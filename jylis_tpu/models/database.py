@@ -28,7 +28,15 @@ class Database:
         engine: str = "auto",
     ):
         from ..native.engine import resolve_engine
+        from ..obs.registry import MetricsRegistry
 
+        # THIS instance's whole observability surface (obs/registry.py):
+        # drain/journal/serving counters, latency histograms, gauges,
+        # trace ring. Passed down explicitly to every component that
+        # times or traces (repos, Server, Journal, Cluster) — the old
+        # process-global dicts in utils/metrics.py cross-talked between
+        # Databases in one process, which this retires.
+        self.metrics = MetricsRegistry()
         self.system = system_repo if system_repo is not None else RepoSYSTEM(identity)
         # ONE native engine shared by every data repo AND the server's
         # batch applier (server/server.py): single source of host truth.
@@ -51,6 +59,9 @@ class Database:
             RepoUJSON(identity, engine=self.native_engine),
             self.system,
         ):
+            # timed_drain resolves the registry through this attribute,
+            # so drain counters/histograms land per-Database
+            repo.metrics = self.metrics
             self._map[repo.name.encode()] = RepoManager(
                 repo.name, repo, repo.help, served=self._served_py
             )
@@ -82,15 +93,13 @@ class Database:
         engine settled in C++ vs commands that went through the Python
         dispatch path (engine defers, demoted connections, and direct
         applies), plus whole-connection demotion events."""
-        from ..utils import metrics
-
         native = 0
         if self.native_engine is not None:
             native = sum(self.native_engine.served_counts().values())
         return {
             "native_cmds": native,
             "demoted_cmds": sum(self._served_py.values()),
-            "demotions": metrics.serving_counters["demotions"],
+            "demotions": self.metrics.serving_counters["demotions"],
         }
 
     def _sync_update_repo(self, name: str, repo) -> None:
@@ -139,9 +148,13 @@ class Database:
     def set_journal(self, journal) -> None:
         """Attach the delta write-ahead journal (journal/): every repo's
         flushed delta batches append to it before reaching the network
-        sink (manager._emit). Pass None to detach."""
+        sink (manager._emit). Pass None to detach. Attaching also arms
+        the JOURNAL section of SYSTEM METRICS (explicit zeros from
+        boot); the journal's own registry is whatever it was constructed
+        with — main.py passes this Database's."""
         for mgr in self._map.values():
             mgr.journal = journal
+        self.metrics.journal_enabled = journal is not None
 
     def manager(self, name: str) -> RepoManager:
         return self._map[name.encode()]

@@ -24,6 +24,8 @@ SYSTEM_HELP = LeafHelp(
     "The following are valid SYSTEM commands:\n"
     "  SYSTEM GETLOG [count]\n"
     "  SYSTEM METRICS\n"
+    "  SYSTEM LATENCY\n"
+    "  SYSTEM TRACE [count]\n"
     "  SYSTEM VERSION"
 )
 
@@ -50,6 +52,14 @@ class RepoSYSTEM:
         # CLUSTER section (peer states, dials/fails, evictions by
         # reason, sync served/deferred, held-delta drops)
         self.cluster_fn = None
+        # ... and this to its per-peer convergence-lag view (push→apply
+        # EWMA per sender) for the SYSTEM LATENCY per-peer lines
+        self.lag_fn = None
+        # the owning Database's MetricsRegistry (obs/registry.py):
+        # drain/journal counters, latency histograms, trace ring —
+        # wired as `metrics` like every repo. None (a standalone
+        # RepoSYSTEM) reads the process DEFAULT via resolve_registry.
+        self.metrics = None
 
     def apply(self, resp, args: list[bytes]) -> bool:
         op = need(args, 0)
@@ -75,10 +85,41 @@ class RepoSYSTEM:
                 self.served_fn() if self.served_fn else None,
                 self.serving_fn() if self.serving_fn else None,
                 self.cluster_fn() if self.cluster_fn else None,
+                registry=self.metrics,
             )
             resp.array_start(len(lines))
             for line in lines:
                 resp.string(line)
+            return False
+        if op == b"LATENCY":
+            # the latency histograms as one line per seam (count + p50/
+            # p90/p99/max in µs), ALL declared seams — a zero count means
+            # the seam exists but has not fired, which is itself signal —
+            # plus one line per peer with the convergence-lag EWMA
+            lines = []
+            for name, snap in self._registry().seam_stats():
+                lines.append(
+                    f"{name} count {snap['count']}"
+                    f" p50_us {snap['p50_s'] * 1e6:.0f}"
+                    f" p90_us {snap['p90_s'] * 1e6:.0f}"
+                    f" p99_us {snap['p99_s'] * 1e6:.0f}"
+                    f" max_us {snap['max_s'] * 1e6:.0f}"
+                )
+            if self.lag_fn is not None:
+                for peer, ms in sorted(self.lag_fn().items()):
+                    lines.append(f"converge_lag_ms peer {peer} {ms:.1f}")
+            resp.array_start(len(lines))
+            for line in lines:
+                resp.string(line)
+            return False
+        if op == b"TRACE":
+            count = parse_opt_count(args, 1)
+            entries = self._registry().trace.dump(count)
+            resp.array_start(len(entries))
+            from ..obs.trace import TraceRing
+
+            for entry in entries:
+                resp.string(TraceRing.format(entry))
             return False
         if op == b"VERSION":
             from .. import __version__
@@ -86,6 +127,11 @@ class RepoSYSTEM:
             resp.string(f"jylis-tpu {__version__}".encode())
             return False
         raise ParseError()
+
+    def _registry(self):
+        from ..utils.metrics import resolve_registry
+
+        return resolve_registry(self)
 
     # -- server-internal (repo_system.pony:56-64) --------------------------
 

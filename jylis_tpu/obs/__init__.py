@@ -1,0 +1,69 @@
+"""Always-on node observability: latency histograms, convergence lag,
+and a bounded structured trace ring.
+
+Until this package, every latency number the repo could show was
+measured from OUTSIDE by bench.py, and `SYSTEM METRICS` was monotonic
+counters only — the node itself could not answer "how long does a drain
+take at p99?" or "how stale is the data a peer pushed me?". The
+delta-CRDT literature frames exactly those two quantities as THE trade
+the model makes (Almeida et al., arXiv:1410.2803: anti-entropy cost vs
+staleness; Big(ger) Sets, arXiv:1605.06424: per-replica propagation
+backlog), so they must be live on the node, not in offline bench
+records. Three pillars:
+
+* **Fixed-bucket log2 latency histograms** (`hist.Histogram`): 64
+  power-of-two nanosecond buckets, record = one index computation + one
+  list increment, no allocation — cheap enough to stay armed on the
+  serving hot path permanently (bench.py records `obs_cost_frac` to
+  prove it). Wired into every timed seam the repo already has: native
+  burst + Python dispatch (server), per-type device drains
+  (utils/metrics.timed_drain), journal append/fsync, and cluster
+  heartbeat round-trips.
+* **Convergence-lag tracking**: every cluster transport frame carries
+  its sender's wall-clock origin (schema v6, cluster/cluster.py);
+  receivers record push→apply lag per peer into a `converge_lag_ms`
+  gauge (EWMA) plus a node-wide anti-entropy `backlog_ms` gauge — the
+  time dimension of the held-delta / deferred-sync counts the CLUSTER
+  metrics section already carries.
+* **A bounded structured trace ring** (`trace.TraceRing`): fixed-size
+  deque of (ts_ms, subsystem, event, reason, detail) tuples fed by the
+  same seams the failpoints manifest names, dumped by `SYSTEM TRACE
+  [count]` and automatically on unclean shutdown.
+
+Everything surfaces three ways: extended `SYSTEM METRICS` lines, the
+`SYSTEM LATENCY` subcommand, and the opt-in `--metrics-port` HTTP
+endpoint emitting Prometheus text exposition (`prom.py`).
+
+Naming discipline: every histogram/gauge/trace-event name is a string
+literal at its call site, declared and described in
+`scripts/jlint/metrics_manifest.json` (jlint pass 5, rules
+JL501/JL502), and every histogram/gauge is pre-registered below so a
+scrape shows the full surface (with zero counts) from boot.
+"""
+
+from __future__ import annotations
+
+# Every latency histogram seam, pre-created in each MetricsRegistry so
+# the Prometheus scrape and SYSTEM LATENCY show the complete surface
+# from boot (zero counts included). jlint pass 5 cross-checks this
+# tuple against the literal names at the call sites.
+SEAMS = (
+    "drain.TREG",
+    "drain.TLOG",
+    "drain.GCOUNT",
+    "drain.PNCOUNT",
+    "server.native_burst",
+    "server.py_dispatch",
+    "journal.append",
+    "journal.fsync",
+    "cluster.rtt",
+    "cluster.converge_lag",
+)
+
+# Node-wide gauges (per-peer convergence lag lives on the Cluster and
+# surfaces through SYSTEM LATENCY; only the folded node-wide values are
+# registry gauges).
+GAUGES = (
+    "cluster.converge_lag_ms",
+    "cluster.backlog_ms",
+)

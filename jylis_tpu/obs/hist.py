@@ -1,0 +1,76 @@
+"""Fixed-bucket log2 latency histogram.
+
+64 power-of-two nanosecond buckets: bucket 0 holds exact zeros, bucket
+i (1..63) holds durations in [2^(i-1), 2^i) ns, with everything past
+~2^62 ns clamped into the last bucket. `record` is one float→int
+conversion, one `int.bit_length`, and one list increment — no
+allocation, no branching on the data, so the seams stay armed on the
+serving hot path permanently (bench.py's `obs_cost_frac` records the
+measured cost).
+
+Quantile queries walk the 64 buckets and report the matched bucket's
+UPPER bound, so the reported value is within one bucket (a factor of
+two) above the true sample — a deliberate over- rather than
+under-report for a latency surface (tests/test_obs.py pins the bound
+against numpy percentiles on adversarial distributions).
+
+Thread model: `record` fires from the event loop AND from worker
+threads (journal writer, threaded drains). The increments are plain
+GIL-interleaved operations; a lost update under contention skews a
+count by one, which is acceptable for a metrics surface and the price
+of keeping the hot path lock-free.
+"""
+
+from __future__ import annotations
+
+N_BUCKETS = 64
+
+
+class Histogram:
+    __slots__ = ("buckets", "count", "total", "max")
+
+    def __init__(self):
+        self.buckets = [0] * N_BUCKETS
+        self.count = 0
+        self.total = 0.0  # seconds, for Prometheus summary _sum
+        self.max = 0.0  # seconds
+
+    def record(self, seconds: float) -> None:
+        ns = int(seconds * 1e9)
+        if ns < 0:  # clock hiccup: bucket as zero rather than crash
+            ns = 0
+        i = ns.bit_length()
+        if i > N_BUCKETS - 1:
+            i = N_BUCKETS - 1
+        self.buckets[i] += 1
+        self.count += 1
+        self.total += seconds
+        if seconds > self.max:
+            self.max = seconds
+
+    def percentile(self, q: float) -> float:
+        """The q-quantile (0 < q <= 1) in SECONDS: the upper bound of
+        the bucket holding the ceil(q * count)-th sample, 0.0 when
+        empty."""
+        n = self.count
+        if n == 0:
+            return 0.0
+        target = q * n
+        cum = 0
+        for i, c in enumerate(self.buckets):
+            cum += c
+            if cum >= target:
+                return 0.0 if i == 0 else float(1 << i) * 1e-9
+        return float(1 << (N_BUCKETS - 1)) * 1e-9  # racing counts: clamp
+
+    def snapshot(self) -> dict:
+        """One consistent-enough view for the reporting surfaces:
+        {count, sum_s, max_s, p50_s, p90_s, p99_s}."""
+        return {
+            "count": self.count,
+            "sum_s": self.total,
+            "max_s": self.max,
+            "p50_s": self.percentile(0.50),
+            "p90_s": self.percentile(0.90),
+            "p99_s": self.percentile(0.99),
+        }

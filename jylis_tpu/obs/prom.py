@@ -1,0 +1,158 @@
+"""Opt-in Prometheus text-exposition endpoint (``--metrics-port``).
+
+A scrape-friendly view of the same registry `SYSTEM METRICS` reads, so
+the node is observable WITHOUT a Redis client: counters for commands
+served / serving split / journal / cluster lifecycle, one summary per
+latency seam (quantiles from the log2 histograms), and the node-wide
+gauges. Format is the Prometheus text exposition (version 0.0.4);
+`make ci`'s metrics-smoke step boots a node, scrapes this endpoint, and
+validates both the grammar and that every histogram/gauge declared in
+scripts/jlint/metrics_manifest.json is present from boot.
+
+The server is a deliberately tiny asyncio HTTP responder (GET /metrics
+only): a scrape every few seconds does not justify an HTTP framework
+dependency, and the render itself is a pure function over the registry
+(`render`), testable without sockets.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..utils.net import ipv4_port
+
+
+def _esc(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def render(database) -> str:
+    """The full exposition body for one node. ``database`` carries the
+    registry plus the served/serving/cluster views RepoSYSTEM uses, so
+    the scrape and SYSTEM METRICS can never disagree about sources."""
+    reg = database.metrics
+    system = database.system
+    out: list[str] = []
+
+    out.append("# HELP jylis_cmds_total Commands served per data type.")
+    out.append("# TYPE jylis_cmds_total counter")
+    served = system.served_fn() if system.served_fn else {}
+    for name, n in sorted(served.items()):
+        out.append(f'jylis_cmds_total{{type="{_esc(name)}"}} {n}')
+
+    out.append("# TYPE jylis_serving_total counter")
+    serving = system.serving_fn() if system.serving_fn else {}
+    for key in ("native_cmds", "demoted_cmds", "demotions"):
+        out.append(
+            f'jylis_serving_total{{kind="{key}"}} {serving.get(key, 0)}'
+        )
+
+    out.append("# TYPE jylis_journal_total counter")
+    for key, n in reg.journal_counters.items():
+        out.append(f'jylis_journal_total{{kind="{key}"}} {n}')
+
+    out.append("# TYPE jylis_drain_total counter")
+    for name, drains, keys, ms in reg.type_stats():
+        t = _esc(name)
+        out.append(f'jylis_drain_total{{type="{t}",kind="batches"}} {drains}')
+        out.append(f'jylis_drain_total{{type="{t}",kind="keys"}} {keys}')
+
+    cluster = system.cluster_fn() if system.cluster_fn else {}
+    if cluster:
+        out.append("# TYPE jylis_cluster gauge")
+        for key, v in cluster.items():
+            out.append(f'jylis_cluster{{key="{_esc(key)}"}} {v}')
+
+    out.append(
+        "# HELP jylis_seam_latency_seconds Log2-bucket latency per "
+        "instrumented seam."
+    )
+    out.append("# TYPE jylis_seam_latency_seconds summary")
+    for name, snap in reg.seam_stats():
+        seam = _esc(name)
+        for q, key in (("0.5", "p50_s"), ("0.9", "p90_s"), ("0.99", "p99_s")):
+            out.append(
+                f'jylis_seam_latency_seconds{{seam="{seam}",quantile="{q}"}}'
+                f" {snap[key]:.9f}"
+            )
+        out.append(
+            f'jylis_seam_latency_seconds_count{{seam="{seam}"}} {snap["count"]}'
+        )
+        out.append(
+            f'jylis_seam_latency_seconds_sum{{seam="{seam}"}} {snap["sum_s"]:.9f}'
+        )
+
+    out.append("# HELP jylis_gauge Node-wide observability gauges.")
+    out.append("# TYPE jylis_gauge gauge")
+    for name, v in sorted(reg.gauges.items()):
+        out.append(f'jylis_gauge{{name="{_esc(name)}"}} {v:.3f}')
+
+    out.append(f"jylis_trace_events {len(reg.trace)}")
+    return "\n".join(out) + "\n"
+
+
+class MetricsHTTP:
+    """GET /metrics on ``port`` (0 = ephemeral; the bound port is
+    `.port`). Anything else is a 404; malformed requests just close."""
+
+    def __init__(self, database, port: int, log=None):
+        self._database = database
+        self._want_port = port
+        self._log = log
+        self._server: asyncio.base_events.Server | None = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, host=None, port=self._want_port
+        )
+
+    @property
+    def port(self) -> int:
+        assert self._server is not None
+        return ipv4_port(self._server)
+
+    async def _handle(self, reader, writer) -> None:
+        try:
+            line = await asyncio.wait_for(reader.readline(), timeout=5.0)
+            parts = line.split()
+            # drain the (ignored) request headers so the client's write
+            # half can complete cleanly before we respond — bounded, so
+            # a client dripping header lines forever cannot hold this
+            # handler task (and its socket) open indefinitely
+            for _ in range(128):
+                h = await asyncio.wait_for(reader.readline(), timeout=5.0)
+                if h in (b"\r\n", b"\n", b""):
+                    break
+            else:
+                return  # header flood: just close
+            if len(parts) >= 2 and parts[0] == b"GET" and (
+                parts[1] == b"/metrics" or parts[1].startswith(b"/metrics?")
+            ):
+                body = render(self._database).encode()
+                head = (
+                    b"HTTP/1.1 200 OK\r\n"
+                    b"Content-Type: text/plain; version=0.0.4; charset=utf-8\r\n"
+                    b"Content-Length: %d\r\nConnection: close\r\n\r\n"
+                    % len(body)
+                )
+                writer.write(head + body)
+            else:
+                writer.write(
+                    b"HTTP/1.1 404 Not Found\r\nContent-Length: 0\r\n"
+                    b"Connection: close\r\n\r\n"
+                )
+            await writer.drain()
+        except (
+            OSError,
+            ValueError,  # readline: line longer than the stream limit
+            asyncio.TimeoutError,
+            asyncio.CancelledError,
+        ):
+            pass
+        finally:
+            writer.close()
+
+    async def dispose(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()

@@ -1,0 +1,114 @@
+"""Per-Database metrics registry.
+
+Before this class the drain / journal / serving counters were
+process-global module state in utils/metrics.py, with a documented
+caveat: multiple Databases in one process (tests, benches, the warmup
+throwaway) cross-talked through them. The registry makes the whole
+observability surface — counters, histograms, gauges, trace ring — a
+per-`Database` instance passed down explicitly: Database creates one,
+hands it to its repos (drain timing), the Server (dispatch seams), the
+Journal (append/fsync seams), and the Cluster (round-trip + convergence
+lag), and RepoSYSTEM reads it for `SYSTEM METRICS` / `LATENCY` /
+`TRACE`. utils/metrics.py keeps a process-wide DEFAULT instance so
+registry-less direct drives (standalone repos, a bare Journal) still
+record somewhere.
+
+``enabled`` is the one global switch the seams check before paying for
+`perf_counter` pairs: bench.py flips it off for the `obs_cost_frac`
+comparison run, so the recorded overhead covers the FULL cost of
+observation (clock reads included), not just the bucket increment.
+
+Histogram and gauge names are pre-registered from obs.SEAMS/GAUGES —
+`hist()` raises KeyError on an undeclared name, and jlint pass 5
+(JL501/JL502) holds the call-site literals, the declarations, and the
+manifest descriptions in lockstep.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+from . import GAUGES, SEAMS
+from .hist import Histogram
+from .trace import TraceRing
+
+JOURNAL_KEYS = ("appends", "bytes", "fsyncs", "replayed_batches", "errors")
+
+
+class MetricsRegistry:
+    def __init__(self, trace_cap: int = 512):
+        self.enabled = True
+        # per-type device drain accumulators (batches / keys / seconds)
+        self.counters: dict[str, dict[str, float]] = defaultdict(
+            lambda: {"batches": 0, "keys": 0, "seconds": 0.0}
+        )
+        # delta write-ahead journal counters: appends / bytes / fsyncs
+        # accrue on the writer thread, replayed_batches on boot
+        # recovery, errors on ANY writer-side encode/write/fsync failure
+        self.journal_counters: dict[str, int] = dict.fromkeys(JOURNAL_KEYS, 0)
+        # True once a journal is attached (Database.set_journal): the
+        # JOURNAL section of SYSTEM METRICS then shows explicit zeros
+        # from boot instead of appearing at the first nonzero counter
+        self.journal_enabled = False
+        # serving-path: whole-connection demotions off the native engine
+        self.serving_counters: dict[str, int] = {"demotions": 0}
+        self.hists: dict[str, Histogram] = {name: Histogram() for name in SEAMS}
+        self.gauges: dict[str, float] = {name: 0.0 for name in GAUGES}
+        self.trace = TraceRing(trace_cap)
+
+    # ---- counters ----------------------------------------------------------
+
+    def note_drain(self, name: str, n_keys: int, seconds: float) -> None:
+        c = self.counters[name]
+        c["batches"] += 1
+        c["keys"] += n_keys
+        c["seconds"] += seconds
+        h = self.hists.get("drain." + name)
+        if h is not None:
+            h.record(seconds)
+
+    def note_journal(self, counter: str, n: int = 1) -> None:
+        self.journal_counters[counter] += n
+
+    def note_serving(self, counter: str, n: int = 1) -> None:
+        self.serving_counters[counter] += n
+
+    # ---- histograms / gauges / trace --------------------------------------
+
+    def hist(self, name: str) -> Histogram:
+        return self.hists[name]  # KeyError = undeclared seam, fail loud
+
+    def gauge_set(self, name: str, value: float) -> None:
+        if name not in self.gauges:
+            raise KeyError(name)  # undeclared gauge, fail loud
+        self.gauges[name] = value
+
+    def trace_event(
+        self, subsystem: str, event: str, reason: str = "", detail: str = ""
+    ) -> None:
+        if self.enabled:
+            self.trace.push(subsystem, event, reason, detail)
+
+    # ---- reporting ---------------------------------------------------------
+
+    def type_stats(self):
+        """(name, drains, keys, device_ms) per drained type — the ONE
+        iteration the reporting surfaces share. list() snapshots the key
+        set atomically under the GIL: note_drain runs in worker threads
+        and may insert a type's key mid-request."""
+        for name in sorted(list(self.counters)):
+            c = self.counters.get(name)
+            if c is not None:
+                yield name, int(c["batches"]), int(c["keys"]), c["seconds"] * 1e3
+
+    def seam_stats(self):
+        """(name, snapshot) per declared seam, SEAMS order."""
+        for name in SEAMS:
+            yield name, self.hists[name].snapshot()
+
+    def report(self) -> str:
+        parts = [
+            f"{name}: {drains} drains, {keys} keys, {ms:.1f}ms device"
+            for name, drains, keys, ms in self.type_stats()
+        ]
+        return "; ".join(parts) if parts else "no drains"

@@ -17,11 +17,11 @@ request order), which each connection's sequential await provides.
 from __future__ import annotations
 
 import asyncio
+import time
 
 from .. import faults
 from ..models.database import Database
 from ..native.resp import make_parser
-from ..utils.metrics import note_serving
 from ..utils.net import ipv4_port
 from .resp import Respond, RespError
 
@@ -34,6 +34,15 @@ class Server:
         self._server: asyncio.base_events.Server | None = None
         self._conns: set[asyncio.StreamWriter] = set()
         self._closing = False
+        # dispatch-latency seams (obs/): one histogram per serving path —
+        # a native burst (one engine scan_apply call settling many
+        # commands) vs one Python-path dispatch (deferred, demoted, or
+        # busy-routed command). Resolved once; the registry's `enabled`
+        # flag is checked per record so bench.py's obs-off comparison
+        # run skips the clock reads too.
+        self._reg = database.metrics
+        self._h_burst = self._reg.hist("server.native_burst")
+        self._h_py = self._reg.hist("server.py_dispatch")
 
     async def start(self) -> None:
         try:
@@ -105,7 +114,12 @@ class Server:
                 parser.append(data)
                 try:
                     for cmd in parser:
+                        t0 = (
+                            time.perf_counter() if self._reg.enabled else 0.0
+                        )
                         await self._database.apply_async(resp, cmd)
+                        if t0:
+                            self._h_py.record(time.perf_counter() - t0)
                         flush(1 << 16)  # bound the reply buffer mid-burst
                 except RespError as e:
                     resp.err(str(e))
@@ -148,8 +162,10 @@ class Server:
         def demote() -> bool:
             # the whole connection moves to the Python dispatch path for
             # its remaining lifetime — counted so the live fallback_frac
-            # (SYSTEM METRICS SERVING lines) reflects demotion events
-            note_serving("demotions")
+            # (SYSTEM METRICS SERVING lines) reflects demotion events,
+            # and traced so SYSTEM TRACE shows when/why serving slowed
+            self._reg.note_serving("demotions")
+            self._reg.trace_event("server", "demote")
             parser.append(bytes(buf))
             buf.clear()
             return False
@@ -172,9 +188,12 @@ class Server:
                     # oracle path (replies stay correct, at the measured
                     # demotion cliff), never kill the connection
                     faults.point("native.scan_apply")
+                    t0 = time.perf_counter() if self._reg.enabled else 0.0
                     rc, consumed, replies, unhandled, changed = (
                         engine.scan_apply(buf)
                     )
+                    if t0:
+                        self._h_burst.record(time.perf_counter() - t0)
                 except faults.FaultError:
                     return demote()
                 if replies:
@@ -185,7 +204,10 @@ class Server:
                         mgr._maybe_proactive_flush()
             del buf[:consumed]
             if rc == 1:  # one command for the Python path, in order
+                t0 = time.perf_counter() if self._reg.enabled else 0.0
                 await self._database.apply_async(resp, unhandled)
+                if t0:
+                    self._h_py.record(time.perf_counter() - t0)
                 # a burst of repeatedly deferring reads (e.g. renders
                 # too big for the engine's reply buffer) produces no
                 # engine write to piggyback on: bound the buffer here

@@ -45,6 +45,9 @@ class Config:
     # extension: deterministic fault injection (faults.py); same syntax
     # as the JYLIS_FAILPOINTS env var, armed at startup
     failpoints: str = ""
+    # extension: opt-in Prometheus text-exposition endpoint (obs/prom.py);
+    # 0 disables, -1 asks for an ephemeral port (logged at boot)
+    metrics_port: int = 0
     log: Log = field(default_factory=Log.create_none)
 
     def normalize(self) -> None:
@@ -142,6 +145,15 @@ def config_from_cli(argv: list[str] | None = None, log_out=None) -> Config:
         "costs nothing.",
     )
     parser.add_argument(
+        "--metrics-port", type=int, default=0,
+        help="Serve Prometheus text exposition on this HTTP port "
+        "(GET /metrics): commands served, serving split, journal and "
+        "cluster counters, latency-seam summaries, and the "
+        "convergence-lag/backlog gauges — the same surface as SYSTEM "
+        "METRICS, scrapeable without a Redis client. -1 binds an "
+        "ephemeral port (logged at boot); 0 (default) disables.",
+    )
+    parser.add_argument(
         "-L", "--log-level", default="info",
         help="Maximum level of detail for logging (error, warn, info, or debug).",
     )
@@ -171,6 +183,7 @@ def config_from_cli(argv: list[str] | None = None, log_out=None) -> Config:
     config.dial_timeout = args.dial_timeout
     config.dial_backoff_cap = args.dial_backoff_cap
     config.failpoints = args.failpoints
+    config.metrics_port = args.metrics_port
 
     level = {"error": "err", "warn": "warn", "info": "info", "debug": "debug"}.get(
         args.log_level

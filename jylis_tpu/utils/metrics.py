@@ -3,12 +3,19 @@
 The reference's only observability is the replicated SYSTEM log
 (SURVEY.md §2.6 — no tracing, no profiler, no metrics endpoint); §5.1
 directs the rebuild to add profiler hooks around merge batches with
-per-batch timing counters. Two pieces:
+per-batch timing counters. The counters themselves live in a
+per-Database :class:`~jylis_tpu.obs.registry.MetricsRegistry` (the
+observability round retired the old process-global dicts, whose
+documented caveat — Databases in one process cross-talking — had been
+this module's known wart): every repo carries a ``metrics`` attribute
+pointing at its Database's registry, and registry-less direct drives
+(standalone repos, a bare Journal) fall back to the process-wide
+``DEFAULT`` instance below. Two pieces stay here:
 
 * every device drain runs under `timed_drain`, accumulating per-type
-  batch counts / batched-key counts / device seconds — dumped into the
-  (replicated, queryable) SYSTEM log at clean shutdown and available any
-  time via `report()`;
+  batch counts / batched-key counts / device seconds AND a log2 latency
+  histogram per type (``drain.<TYPE>`` in SYSTEM LATENCY) — dumped into
+  the (replicated, queryable) SYSTEM log at clean shutdown;
 * set ``JYLIS_PROFILE_DIR=/some/dir`` to wrap each drain in a
   ``jax.profiler.trace`` step so the XLA timeline of the merge path can
   be inspected in TensorBoard/XProf.
@@ -20,7 +27,9 @@ import contextlib
 import functools
 import os
 import time
-from collections import defaultdict
+
+from ..obs.registry import JOURNAL_KEYS as _JOURNAL_KEYS  # noqa: F401 (re-export)
+from ..obs.registry import MetricsRegistry
 
 _PROFILE_DIR = os.environ.get("JYLIS_PROFILE_DIR", "")
 _profiling = False
@@ -40,53 +49,53 @@ def _drain_scope(name: str):
         _profiling = True
     return jax.profiler.StepTraceAnnotation(f"drain_{name}")
 
-counters: dict[str, dict[str, float]] = defaultdict(
-    lambda: {"batches": 0, "keys": 0, "seconds": 0.0}
-)
 
-# delta write-ahead journal counters (journal/journal.py): appends /
-# bytes / fsyncs accrue on the flush path, replayed_batches on boot
-# recovery, errors on ANY writer-side encode/write/fsync failure — the
-# one signal that durability silently degraded (full disk), so it must
-# be visible in SYSTEM METRICS, not just a stashed exception.
-# Process-global like the drain counters above (and with the same
-# caveat: multiple journaling Databases in one process share them).
-_JOURNAL_KEYS = ("appends", "bytes", "fsyncs", "replayed_batches", "errors")
-journal_counters: dict[str, int] = dict.fromkeys(_JOURNAL_KEYS, 0)
+# The process-wide fallback registry for callers constructed without an
+# explicit one (standalone repos in unit tests, a bare Journal, warmup
+# before its throwaway Database exists). The module-level dict aliases
+# keep the historical direct-drive surface working: they ARE the default
+# registry's dicts, not copies.
+DEFAULT = MetricsRegistry()
+counters = DEFAULT.counters
+journal_counters = DEFAULT.journal_counters
+serving_counters = DEFAULT.serving_counters
+
+
+def resolve_registry(obj) -> MetricsRegistry:
+    """The registry ``obj`` carries (its owning Database's, wired as the
+    ``metrics`` attribute), or the process DEFAULT for registry-less
+    direct drives — THE fallback policy, shared by every consumer
+    (timed_drain, RepoSYSTEM, Journal, Cluster) so it cannot drift."""
+    return getattr(obj, "metrics", None) or DEFAULT
 
 
 def note_journal(counter: str, n: int = 1) -> None:
-    journal_counters[counter] += n
-
-
-# serving-path split counters: connection demotions off the native engine
-# (server/server.py demote() — the whole connection moves to the Python
-# dispatch path for its remaining lifetime). Process-global like the
-# drain counters; the per-command native/demoted tallies live per
-# Database (engine served counts vs the managers' Python-path tally) and
-# merge with this in SYSTEM METRICS' SERVING lines, so fallback_frac is
-# observable live, not just in the bench record.
-serving_counters: dict[str, int] = {"demotions": 0}
+    DEFAULT.note_journal(counter, n)
 
 
 def note_serving(counter: str, n: int = 1) -> None:
-    serving_counters[counter] += n
+    DEFAULT.note_serving(counter, n)
 
 
 def note_drain(name: str, n_keys: int, seconds: float) -> None:
-    c = counters[name]
-    c["batches"] += 1
-    c["keys"] += n_keys
-    c["seconds"] += seconds
+    DEFAULT.note_drain(name, n_keys, seconds)
 
 
 def timed_drain(name: str, key_count):
-    """Decorator for repo drain() methods: per-batch counters + optional
-    profiler trace. ``key_count(self)`` returns the pending batch size."""
+    """Decorator for repo drain() methods: per-batch counters, a log2
+    latency histogram (``drain.<name>``), and an optional profiler
+    trace. ``key_count(self)`` returns the pending batch size. The
+    registry resolves per call from the repo's ``metrics`` attribute
+    (set by Database) so one decorated class serves any number of
+    registry-carrying instances; jlint pass 5 maps the literal ``name``
+    here to the ``drain.<name>`` histogram in the metrics manifest."""
 
     def wrap(fn):
         @functools.wraps(fn)
         def inner(self, *args, **kwargs):
+            reg = resolve_registry(self)
+            if not reg.enabled:
+                return fn(self, *args, **kwargs)
             n = key_count(self)
             # a drain invoked with explicit work (e.g. TLOG's fused
             # trim=(row, count)) dispatches even with nothing pending —
@@ -98,7 +107,7 @@ def timed_drain(name: str, key_count):
             with _drain_scope(name):
                 t0 = time.perf_counter()
                 out = fn(self, *args, **kwargs)
-                note_drain(name, max(n, 1), time.perf_counter() - t0)
+                reg.note_drain(name, max(n, 1), time.perf_counter() - t0)
             return out
 
         return inner
@@ -116,35 +125,27 @@ def stop_profiling() -> None:
         _profiling = False
 
 
-def _type_stats():
-    """(name, drains, keys, device_ms) per type — the ONE iteration both
-    reporting surfaces share, so they can't drift apart. list(counters)
-    snapshots the key set atomically under the GIL: note_drain runs in
-    worker threads and may insert a type's key mid-request."""
-    for name in sorted(list(counters)):
-        c = counters.get(name)
-        if c is not None:
-            yield name, int(c["batches"]), int(c["keys"]), c["seconds"] * 1e3
-
-
 def metric_lines(
     served: dict[str, int] | None = None,
     serving: dict[str, int] | None = None,
     cluster: dict[str, int] | None = None,
+    registry: MetricsRegistry | None = None,
 ) -> list[str]:
     """Flat `type counter value` lines — the SYSTEM METRICS reply body.
     ``served`` is the serving node's per-type commands-served totals
     (Database merges its Python-path tally with its engine's native
-    counters and wires the result through RepoSYSTEM — per instance,
-    unlike the process-global drain counters, so test/bench Databases
-    in one process cannot cross-talk). ``serving`` is the native-vs-
-    demoted split (native_cmds / demoted_cmds / demotions), emitted with
-    the live fallback_frac so the bench record's headline condition is
-    checkable on a running node. ``cluster`` is the node's peer
-    lifecycle view (Cluster.metrics_totals: per-state peer counts,
-    dial/eviction/sync counters, held-delta drops) — per instance, so
-    every `CLUSTER` failure-envelope number is queryable from any Redis
-    client instead of buried in logs."""
+    counters and wires the result through RepoSYSTEM). ``serving`` is
+    the native-vs-demoted split (native_cmds / demoted_cmds /
+    demotions), emitted with the live fallback_frac so the bench
+    record's headline condition is checkable on a running node.
+    ``cluster`` is the node's peer lifecycle view (Cluster.metrics_totals:
+    per-state peer counts, dial/eviction/sync counters, held-delta
+    drops, and the convergence-lag/backlog gauges). ``registry`` is the
+    node's MetricsRegistry (drain/journal counters + the latency
+    histograms, emitted as `LATENCY <seam>.<stat>` lines); None falls
+    back to the process DEFAULT. Existing line names stay byte-stable —
+    new sections only append."""
+    reg = registry if registry is not None else DEFAULT
     lines = [
         f"{name} cmds {n}" for name, n in sorted((served or {}).items()) if n
     ]
@@ -159,21 +160,25 @@ def metric_lines(
         # insertion order (states first, then counters) — a glossary
         # order, kept stable for dashboards
         lines.extend(f"CLUSTER {k} {v}" for k, v in cluster.items())
-    for name, drains, keys, ms in _type_stats():
+    for name, drains, keys, ms in reg.type_stats():
         lines.append(f"{name} drains {drains}")
         lines.append(f"{name} keys {keys}")
         lines.append(f"{name} device_ms {ms:.1f}")
-    if any(journal_counters.values()):
-        # every _JOURNAL_KEYS line once journaling is live, so dashboards
-        # see explicit zeros (e.g. fsyncs under --journal-fsync off)
+    if reg.journal_enabled or any(reg.journal_counters.values()):
+        # every JOURNAL_KEYS line whenever journaling is live — explicit
+        # zeros from boot (e.g. fsyncs under --journal-fsync off), not a
+        # section that pops into existence at the first nonzero counter
         for k in _JOURNAL_KEYS:
-            lines.append(f"JOURNAL {k} {journal_counters[k]}")
+            lines.append(f"JOURNAL {k} {reg.journal_counters[k]}")
+    for name, snap in reg.seam_stats():
+        if snap["count"]:
+            lines.append(f"LATENCY {name}.p50_us {snap['p50_s'] * 1e6:.0f}")
+            lines.append(f"LATENCY {name}.p90_us {snap['p90_s'] * 1e6:.0f}")
+            lines.append(f"LATENCY {name}.p99_us {snap['p99_s'] * 1e6:.0f}")
+            lines.append(f"LATENCY {name}.max_us {snap['max_s'] * 1e6:.0f}")
+            lines.append(f"LATENCY {name}.count {snap['count']}")
     return lines
 
 
 def report() -> str:
-    parts = [
-        f"{name}: {drains} drains, {keys} keys, {ms:.1f}ms device"
-        for name, drains, keys, ms in _type_stats()
-    ]
-    return "; ".join(parts) if parts else "no drains"
+    return DEFAULT.report()

@@ -120,6 +120,14 @@ CLAIMS = [
     # injected-FFI-fault path (robustness round)
     ("docs/operations.md", "serving-demotion", "vs_baseline", fmt_ratio,
      "at the recorded demotion cliff of {}", "failure envelope FFI cliff"),
+    # observability round: the always-on histogram cost is a recorded
+    # number (obs_cost_frac — the armed-vs-disarmed paired ratio on the
+    # concurrent config), pinned wherever the prose claims the seams
+    # are cheap enough to stay on
+    ("README.md", "concurrent", "obs_cost_frac", fmt_percent,
+     "histograms on cost {} of recorded", "README obs cost"),
+    ("docs/operations.md", "concurrent", "obs_cost_frac", fmt_percent,
+     "always-on seams cost {} of recorded", "operations doc obs cost"),
 ]
 
 

@@ -27,6 +27,13 @@ re-record, and each gets a dedicated analysis pass:
   ``scripts/jlint/failpoints_manifest.json`` with a one-line
   description; undeclared, stale, or undescribed names fail, so the
   set of injectable failure seams stays reviewed and documented.
+* **Pass 5 — metrics manifest parity** (`pass_metrics`, rules JL5xx):
+  every histogram/gauge/trace-event name in the observability layer
+  (``.hist()`` / ``.gauge_set()`` / ``.trace_event()`` /
+  ``timed_drain()`` call sites) must be a string literal declared in
+  the committed ``scripts/jlint/metrics_manifest.json`` AND
+  pre-registered in ``jylis_tpu/obs/__init__.py``; stale entries and
+  dead declarations fail, so the scrapeable surface stays reviewed.
 
 Plus one hygiene rule, JL001: ``except Exception`` / bare ``except``
 without an explicit justification, so hot-path errors can't be silently
@@ -76,6 +83,8 @@ RULES = {
     "JL302": (None, "parity manifest drift: committed manifest != extracted surfaces"),
     "JL401": (None, "failpoint name non-literal or not declared in failpoints_manifest.json"),
     "JL402": (None, "failpoints manifest entry stale, missing, or undescribed"),
+    "JL501": (None, "metric name non-literal, not declared in metrics_manifest.json, or not pre-registered in obs"),
+    "JL502": (None, "metrics manifest / obs declaration stale, missing, or undescribed"),
     "JL900": (None, "stale or malformed baseline suppression entry"),
 }
 

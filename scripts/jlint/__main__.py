@@ -19,7 +19,7 @@ from . import (
     iter_py_files,
     load_baseline,
 )
-from . import pass_async, pass_failpoints, pass_jax, pass_parity
+from . import pass_async, pass_failpoints, pass_jax, pass_metrics, pass_parity
 
 # pass 1 + JL001 cover the product and its scripts; tests are excluded
 # (fixtures deliberately violate the rules), and jlint's own fixtures
@@ -52,6 +52,7 @@ def run_all(root: str = ROOT, verbose: bool = False) -> int:
     problems = apply_baseline(findings, load_baseline())
     findings += pass_parity.check()
     findings += pass_failpoints.check()
+    findings += pass_metrics.check()
     findings += problems
 
     bad = [f for f in findings if not f.suppressed]
@@ -62,7 +63,7 @@ def run_all(root: str = ROOT, verbose: bool = False) -> int:
     n_sup = sum(1 for f in findings if f.suppressed)
     print(
         f"jlint: {len(bad)} finding(s), {n_sup} suppressed "
-        f"({len(async_sources)} files, 4 passes)"
+        f"({len(async_sources)} files, 5 passes)"
     )
     return 1 if bad else 0
 
@@ -88,6 +89,12 @@ def main(argv=None) -> int:
         todo = sum(1 for d in fps.values() if d == pass_failpoints.PLACEHOLDER)
         print(
             f"failpoints manifest written: {len(fps)} failpoints"
+            + (f" ({todo} need descriptions)" if todo else "")
+        )
+        mets = pass_metrics.write_manifest()
+        todo = sum(1 for d in mets.values() if d == pass_metrics.PLACEHOLDER)
+        print(
+            f"metrics manifest written: {len(mets)} metrics"
             + (f" ({todo} need descriptions)" if todo else "")
         )
         return 0

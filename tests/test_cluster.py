@@ -371,25 +371,32 @@ def test_active_redialed_after_drop(three_nodes):
 
 
 def test_wire_frame_crc_detects_any_single_byte_flip():
-    """Schema v5 transport integrity: every cluster frame body carries
-    its CRC32, so a bit flip past the TCP checksum is a detected drop,
-    never a decodable forged message (the drill matrix demonstrated a
-    flipped counter value converging cluster-wide without this)."""
+    """Schema v5/v6 transport integrity: every cluster frame carries a
+    CRC32 over the origin stamp + body, so a bit flip past the TCP
+    checksum — in the payload OR the timestamp — is a detected drop,
+    never a decodable forged message or a forged convergence-lag sample
+    (the drill matrix demonstrated a flipped counter value converging
+    cluster-wide without this)."""
     from jylis_tpu.cluster.cluster import check_frame, wire_frame
     from jylis_tpu.cluster.framing import FrameReader, HEADER_SIZE
 
     body = b"some message body"
-    framed = wire_frame(body)
+    framed = wire_frame(body, origin_ms=1234)
     frames = FrameReader()
     frames.append(framed)
     raw = next(iter(frames))
-    assert check_frame(raw) == body
-    for i in range(len(raw)):  # flip every byte of crc+payload in turn
+    assert check_frame(raw) == (1234, body)
+    for i in range(len(raw)):  # flip every byte of crc+stamp+payload
         bad = bytearray(raw)
         bad[i] ^= 0x01
         assert check_frame(bytes(bad)) is None, i
     assert check_frame(b"") is None  # shorter than the CRC itself
-    assert len(framed) == HEADER_SIZE + 4 + len(body)
+    # default stamp is "now": a real wall-clock millisecond count
+    frames2 = FrameReader()
+    frames2.append(wire_frame(body))
+    origin, payload = check_frame(next(iter(frames2)))
+    assert payload == body and origin > 1_600_000_000_000
+    assert len(framed) == HEADER_SIZE + 4 + 8 + len(body)
 
 
 def test_handshake_signature_mismatch_drops_connection():

@@ -13,6 +13,7 @@ from jylis_tpu.cluster.msg import (
     MsgExchangeAddrs,
     MsgPong,
     MsgPushDeltas,
+    MsgSyncDone,
 )
 from jylis_tpu.ops.p2set import P2Set
 from jylis_tpu.ops.ujson_host import UJSON
@@ -59,6 +60,10 @@ def _roundtrip(msg):
 
 def test_pong_roundtrip():
     _roundtrip(MsgPong())
+
+
+def test_sync_done_roundtrip():
+    _roundtrip(MsgSyncDone())
 
 
 def test_membership_roundtrip():

@@ -342,15 +342,14 @@ def test_chaos_ffi_fault_demotes_and_serves_correctly():
     demotion — never kill the connection."""
 
     async def main():
-        from jylis_tpu.utils import metrics
-
         (port,) = grab_ports(1)
         node = Node("solo", port)
         await node.start()
         try:
             if node.database.native_engine is None:
                 pytest.skip("no native toolchain: FFI seam absent")
-            before = metrics.serving_counters["demotions"]
+            # demotions count in the serving Database's own registry
+            before = node.database.metrics.serving_counters["demotions"]
             faults.arm("native.scan_apply", "error", budget=1)
             burst = b"".join(
                 b"*4\r\n$6\r\nGCOUNT\r\n$3\r\nINC\r\n$1\r\nk\r\n$1\r\n2\r\n"
@@ -367,7 +366,10 @@ def test_chaos_ffi_fault_demotes_and_serves_correctly():
                 got += chunk
             assert got == b"+OK\r\n+OK\r\n+OK\r\n:6\r\n", got
             assert faults.hits("native.scan_apply") == 1
-            assert metrics.serving_counters["demotions"] == before + 1
+            assert (
+                node.database.metrics.serving_counters["demotions"]
+                == before + 1
+            )
             # the demoted connection keeps serving correctly
             writer.write(b"*3\r\n$6\r\nGCOUNT\r\n$3\r\nGET\r\n$1\r\nk\r\n")
             await writer.drain()

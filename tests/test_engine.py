@@ -402,18 +402,17 @@ def test_counter_gets_skip_device_when_local_only(db):
     """Read-your-writes host shadow: GETs after purely-local INC/DEC are
     served from the exact host value cache with NO device drain; a foreign
     delta makes exactly the next GET drain."""
-    from jylis_tpu.utils import metrics
-
-    metrics.counters.pop("GCOUNT", None)
+    counters = db.metrics.counters  # the per-Database registry's view
+    counters.pop("GCOUNT", None)
     for i in range(5):
         run(db, "GCOUNT", "INC", "k", "3")
         assert run(db, "GCOUNT", "GET", "k") == b":%d\r\n" % (3 * (i + 1))
-    assert metrics.counters["GCOUNT"]["batches"] == 0  # no drains
+    assert counters["GCOUNT"]["batches"] == 0  # no drains
 
     mgr = db.manager("GCOUNT")
     mgr.repo.converge(b"k", {999: 100})
     assert run(db, "GCOUNT", "GET", "k") == b":115\r\n"
-    assert metrics.counters["GCOUNT"]["batches"] == 1  # exactly one drain
+    assert counters["GCOUNT"]["batches"] == 1  # exactly one drain
 
     # and PNCOUNT wraps its eager adjust into the signed read domain
     run(db, "PNCOUNT", "DEC", "pk", "5")
@@ -431,9 +430,7 @@ def test_system_metrics_command(db):
     """SYSTEM METRICS (extension): live per-type drain counters over
     RESP — drains become visible without waiting for the shutdown
     report."""
-    from jylis_tpu.utils import metrics
-
-    before = int(metrics.counters["TLOG"]["batches"])
+    before = int(db.metrics.counters["TLOG"]["batches"])
     run(db, "TLOG", "INS", "m:met", "x", "5")
     db.manager("TLOG").repo.drain()
     out = run(db, "SYSTEM", "METRICS")

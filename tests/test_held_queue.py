@@ -51,8 +51,9 @@ def _pushed_keys(raw: bytes) -> list[bytes]:
     frames.append(bytes(raw))
     out = []
     for body in frames:
-        payload = check_frame(body)  # transport CRC wrapper (schema v5)
-        assert payload is not None
+        checked = check_frame(body)  # transport CRC wrapper (schema v6)
+        assert checked is not None
+        _origin_ms, payload = checked
         msg = codec.decode(payload)
         out.extend(key for key, _ in msg.batch)
     return out

@@ -18,7 +18,13 @@ if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
 from scripts import jlint  # noqa: E402
-from scripts.jlint import pass_async, pass_failpoints, pass_jax, pass_parity  # noqa: E402
+from scripts.jlint import (  # noqa: E402
+    pass_async,
+    pass_failpoints,
+    pass_jax,
+    pass_metrics,
+    pass_parity,
+)
 
 
 def analyze(tmp_path, code: str, which=pass_async):
@@ -581,6 +587,140 @@ def test_real_failpoints_manifest_matches_sites():
     assert sorted(manifest) == sorted(sites)
 
 
+# ---- pass 5: metrics manifest parity (JL501/JL502) --------------------------
+
+FAKE_METRICS = '''
+class Thing:
+    def __init__(self, reg):
+        self.h = reg.hist("good.seam")
+        self.g = reg
+    def work(self, reg, name):
+        reg.gauge_set("good.gauge", 1.0)
+        reg.trace_event("sub", "event", "why", "detail")
+        reg.hist("undeclared.seam")
+        reg.hist("pre" + "computed")  # non-literal: JL501
+
+from jylis_tpu.utils.metrics import timed_drain
+
+class Repo:
+    @timed_drain("FAKETYPE", lambda self: 1)
+    def drain(self):
+        pass
+'''
+
+FAKE_DECLARED = (
+    {"good.seam", "undeclared.seam", "drain.FAKETYPE"},
+    {"good.gauge"},
+)
+
+
+def _met_manifest(tmp_path, entries):
+    p = tmp_path / "metrics.json"
+    p.write_text(json.dumps({"metrics": entries}))
+    return str(p)
+
+
+def _met_sites(tmp_path):
+    d = tmp_path / "jylis_tpu"
+    d.mkdir()
+    (d / "mod.py").write_text(FAKE_METRICS)
+    return pass_metrics.extract_sites(str(tmp_path), ("jylis_tpu",))
+
+
+GOOD_ENTRIES = {
+    "hist:good.seam": "a fine seam",
+    "gauge:good.gauge": "a fine gauge",
+    "trace:sub.event": "a fine event",
+    "hist:drain.FAKETYPE": "a fine drain",
+}
+
+
+def test_metric_nonliteral_name_fails(tmp_path):
+    sites, problems = _met_sites(tmp_path)
+    assert set(sites) == {
+        "hist:good.seam", "hist:undeclared.seam", "gauge:good.gauge",
+        "trace:sub.event", "hist:drain.FAKETYPE",
+    }
+    assert any(
+        f.rule == "JL501" and "string literal" in f.msg for f in problems
+    )
+
+
+def test_undeclared_metric_fails(tmp_path):
+    sites, problems = _met_sites(tmp_path)
+    path = _met_manifest(tmp_path, GOOD_ENTRIES)
+    findings = pass_metrics.check(path, sites, problems, declared=FAKE_DECLARED)
+    assert any(
+        f.rule == "JL501" and "hist:undeclared.seam" in f.msg for f in findings
+    )
+
+
+def test_stale_and_placeholder_metric_entries_fail(tmp_path):
+    sites, problems = _met_sites(tmp_path)
+    entries = dict(GOOD_ENTRIES)
+    entries["hist:undeclared.seam"] = pass_metrics.PLACEHOLDER  # undescribed
+    entries["hist:gone.seam"] = "no call site uses this"  # stale
+    path = _met_manifest(tmp_path, entries)
+    findings = pass_metrics.check(path, sites, problems, declared=FAKE_DECLARED)
+    assert any(f.rule == "JL502" and "gone.seam" in f.msg for f in findings)
+    assert any(
+        f.rule == "JL502" and "no description" in f.msg for f in findings
+    )
+
+
+def test_unregistered_and_dead_obs_declarations_fail(tmp_path):
+    """Both directions of the SEAMS/GAUGES pre-registration parity:
+    a used name missing from obs/__init__.py (runtime KeyError) and a
+    declared name nothing records into (dead scrape surface)."""
+    sites, problems = _met_sites(tmp_path)
+    entries = dict(GOOD_ENTRIES)
+    entries["hist:undeclared.seam"] = "described now"
+    path = _met_manifest(tmp_path, entries)
+    declared = ({"good.seam", "drain.FAKETYPE", "dead.seam"}, {"good.gauge"})
+    findings = pass_metrics.check(path, sites, problems, declared=declared)
+    assert any(
+        f.rule == "JL501" and "undeclared.seam" in f.msg
+        and "pre-registered" in f.msg
+        for f in findings
+    )
+    assert any(
+        f.rule == "JL502" and "dead.seam" in f.msg for f in findings
+    )
+
+
+def test_described_and_registered_metrics_clean(tmp_path):
+    sites, problems = _met_sites(tmp_path)
+    entries = dict(GOOD_ENTRIES)
+    entries["hist:undeclared.seam"] = "described now"
+    path = _met_manifest(tmp_path, entries)
+    findings = pass_metrics.check(path, sites, problems, declared=FAKE_DECLARED)
+    # only the non-literal call remains flagged
+    assert [f.rule for f in findings] == ["JL501"]
+    assert "string literal" in findings[0].msg
+
+
+def test_missing_metrics_manifest_fails(tmp_path):
+    sites, problems = _met_sites(tmp_path)
+    findings = pass_metrics.check(
+        str(tmp_path / "nope.json"), sites, problems, declared=FAKE_DECLARED
+    )
+    assert any(f.rule == "JL502" and "missing" in f.msg for f in findings)
+
+
+def test_real_metrics_manifest_matches_sites():
+    """Every histogram/gauge/trace name in the product tree is literal,
+    declared, described, and pre-registered — `make lint` is clean, and
+    the declared obs surface equals the manifest's."""
+    assert pass_metrics.check() == []
+    manifest = pass_metrics.load_manifest()
+    sites, problems = pass_metrics.extract_sites()
+    assert problems == []
+    assert sorted(manifest) == sorted(sites)
+    seams, gauges = pass_metrics.declared_names()
+    assert {n[5:] for n in manifest if n.startswith("hist:")} == seams
+    assert {n[6:] for n in manifest if n.startswith("gauge:")} == gauges
+
+
 # ---- the real repo ----------------------------------------------------------
 
 
@@ -598,7 +738,7 @@ def test_real_native_surface_is_python_subset():
     # the oracle-only commands are exactly the declared deferrals
     manifest = json.load(open(jlint.MANIFEST_PATH))
     assert manifest["python_only"] == {
-        "SYSTEM": ["GETLOG", "METRICS", "VERSION"],
+        "SYSTEM": ["GETLOG", "LATENCY", "METRICS", "TRACE", "VERSION"],
         "TLOG": ["CLR", "TRIM", "TRIMAT"],
     }
 

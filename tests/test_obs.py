@@ -1,0 +1,378 @@
+"""Observability layer (jylis_tpu/obs/): histograms, trace ring,
+per-Database registry, SYSTEM LATENCY/TRACE, Prometheus endpoint.
+
+The histogram tests pin the log2-bucket quantile contract against numpy
+percentiles on adversarial distributions (the reported value is the
+matched bucket's UPPER bound, so it may exceed the true quantile by at
+most one bucket — a factor of two — and never undershoots by more than
+the quantile-definition wobble within a bucket). The trace-ring tests
+pin bounded memory and overwrite order. The integration tests drive a
+real Database/Server and assert every armed seam reports non-zero
+percentiles through all three surfaces (METRICS lines, SYSTEM LATENCY,
+Prometheus render).
+"""
+
+import asyncio
+import json
+import os
+import random
+import re
+
+import numpy as np
+import pytest
+
+import jylis_tpu  # noqa: F401
+from jylis_tpu.models.database import Database
+from jylis_tpu.obs import GAUGES, SEAMS
+from jylis_tpu.obs.hist import Histogram
+from jylis_tpu.obs.registry import MetricsRegistry
+from jylis_tpu.obs.trace import DETAIL_CAP, TraceRing
+from jylis_tpu.server.server import Server
+from jylis_tpu.utils import metrics
+from jylis_tpu.utils.config import Config
+from jylis_tpu.utils.log import Log
+
+
+class _Resp:
+    """Collects reply-protocol calls as (name, args) for assertions."""
+
+    def __init__(self):
+        self.calls = []
+
+    def __getattr__(self, name):
+        return lambda *a: self.calls.append((name, a))
+
+    def strings(self):
+        return [a[0] for n, a in self.calls if n == "string"]
+
+
+# ---- histogram quantiles vs numpy ------------------------------------------
+
+
+def _check_against_numpy(samples):
+    h = Histogram()
+    for s in samples:
+        h.record(s)
+    assert h.count == len(samples)
+    assert h.max == pytest.approx(max(samples))
+    for q in (0.50, 0.90, 0.99):
+        got = h.percentile(q)
+        # inverted_cdf = the order-statistic definition the histogram
+        # implements (smallest value whose CDF reaches q); the default
+        # linear interpolation invents values BETWEEN modes of a
+        # bimodal distribution, which no bucket scheme can report
+        ref = float(np.percentile(samples, q * 100, method="inverted_cdf"))
+        if ref == 0.0:
+            assert got == 0.0
+            continue
+        # upper-bound semantics: got lies in (ref/2, 2*ref] up to the
+        # within-bucket wobble of the quantile definition — the bucket
+        # holding the reference value has bounds within 2x of it
+        assert got <= ref * 2.05, (q, got, ref)
+        assert got >= ref * 0.5, (q, got, ref)
+
+
+def test_histogram_uniform_and_constant():
+    rng = random.Random(7)
+    _check_against_numpy([rng.uniform(1e-6, 1e-3) for _ in range(5000)])
+    _check_against_numpy([3.2e-4] * 1000)
+    _check_against_numpy([1e-9])  # single sample
+
+
+def test_histogram_adversarial_distributions():
+    rng = random.Random(11)
+    # bimodal with a 100x gap: p50 in the low mode, p99 in the high one
+    bimodal = [rng.uniform(1e-5, 2e-5) for _ in range(900)] + [
+        rng.uniform(1e-3, 2e-3) for _ in range(100)
+    ]
+    rng.shuffle(bimodal)
+    _check_against_numpy(bimodal)
+    # heavy tail spanning six decades
+    heavy = [10 ** rng.uniform(-7, -1) for _ in range(4000)]
+    _check_against_numpy(heavy)
+    # near-boundary values: exact powers of two in ns
+    _check_against_numpy([(1 << k) * 1e-9 for k in range(1, 40)] * 3)
+
+
+def test_histogram_edge_cases():
+    h = Histogram()
+    assert h.percentile(0.5) == 0.0  # empty
+    h.record(0.0)
+    assert h.percentile(0.99) == 0.0  # zero bucket reports zero
+    h.record(-1.0)  # clock hiccup: clamped, never raises
+    h.record(1e12)  # absurd duration: clamped into the last bucket
+    assert h.count == 3
+    assert sum(h.buckets) == 3
+    assert h.percentile(1.0) > 0
+
+
+# ---- trace ring -------------------------------------------------------------
+
+
+def test_trace_ring_bounded_and_overwrites_oldest():
+    r = TraceRing(cap=8)
+    for i in range(50):
+        r.push("sub", "ev", reason=f"r{i}")
+    assert len(r) == 8  # bounded
+    reasons = [e[3] for e in r.dump()]
+    assert reasons == [f"r{i}" for i in range(42, 50)]  # oldest gone
+    assert [e[3] for e in r.dump(3)] == ["r47", "r48", "r49"]  # newest N
+    # detail truncation bounds per-entry memory
+    r.push("sub", "ev", detail="x" * 10_000)
+    assert len(r.dump()[-1][4]) == DETAIL_CAP
+    line = TraceRing.format(r.dump()[-1])
+    assert "sub ev" in line and line.endswith("x" * 10)
+
+
+# ---- registry ---------------------------------------------------------------
+
+
+def test_registry_preregisters_all_declared_names():
+    reg = MetricsRegistry()
+    assert set(reg.hists) == set(SEAMS)
+    assert set(reg.gauges) == set(GAUGES)
+    with pytest.raises(KeyError):
+        reg.hist("not.a.seam")
+    with pytest.raises(KeyError):
+        reg.gauge_set("not.a.gauge", 1.0)
+
+
+def test_registry_note_drain_feeds_histogram():
+    reg = MetricsRegistry()
+    reg.note_drain("TREG", 5, 0.001)
+    assert reg.counters["TREG"]["batches"] == 1
+    assert reg.hists["drain.TREG"].count == 1
+    reg.note_drain("NOSUCH", 1, 0.001)  # un-seamed type: counters only
+    assert reg.counters["NOSUCH"]["batches"] == 1
+
+
+def test_registries_do_not_cross_talk():
+    """The PR's satellite fix: two Databases in one process keep fully
+    separate counters (the old module-global dicts shared them)."""
+    a, b = Database(identity=1), Database(identity=2)
+    default_before = int(
+        metrics.DEFAULT.counters.get("GCOUNT", {"batches": 0})["batches"]
+    )
+    resp = _Resp()
+    a.apply(resp, [b"GCOUNT", b"INC", b"k", b"1"])
+    a.manager("GCOUNT").repo.converge(b"k", {9: 1})
+    a.apply(resp, [b"GCOUNT", b"GET", b"k"])  # forces a drain on A
+    assert a.metrics.counters["GCOUNT"]["batches"] == 1
+    assert b.metrics.counters.get("GCOUNT") is None
+    assert (
+        int(metrics.DEFAULT.counters.get("GCOUNT", {"batches": 0})["batches"])
+        == default_before
+    )
+    a.metrics.note_serving("demotions")
+    assert b.metrics.serving_counters["demotions"] == 0
+
+
+def test_journal_section_emits_zeros_once_enabled():
+    """metric_lines: the JOURNAL section appears with explicit zeros as
+    soon as journaling is enabled — dashboards see the full glossary
+    from boot, not a section that pops in at the first nonzero."""
+    reg = MetricsRegistry()
+    assert not any(
+        line.startswith("JOURNAL") for line in metrics.metric_lines(registry=reg)
+    )
+    reg.journal_enabled = True
+    lines = metrics.metric_lines(registry=reg)
+    got = [line for line in lines if line.startswith("JOURNAL")]
+    assert got == [
+        "JOURNAL appends 0",
+        "JOURNAL bytes 0",
+        "JOURNAL fsyncs 0",
+        "JOURNAL replayed_batches 0",
+        "JOURNAL errors 0",
+    ]
+
+
+def test_metric_lines_latency_section_shape():
+    reg = MetricsRegistry()
+    reg.hist("journal.fsync").record(0.0005)
+    lines = metrics.metric_lines(registry=reg)
+    lat = [line for line in lines if line.startswith("LATENCY")]
+    assert any(
+        re.fullmatch(r"LATENCY journal\.fsync\.p50_us \d+", line) for line in lat
+    )
+    assert "LATENCY journal.fsync.count 1" in lat
+    # silent seams emit nothing in METRICS (they still show in LATENCY)
+    assert not any("server.native_burst" in line for line in lat)
+
+
+# ---- SYSTEM LATENCY / SYSTEM TRACE -----------------------------------------
+
+
+def test_system_latency_and_trace_commands():
+    db = Database(identity=3)
+    resp = _Resp()
+    db.metrics.hist("server.py_dispatch").record(0.002)
+    db.metrics.trace_event("server", "demote", "", "conn 1")
+    db.metrics.trace_event("cluster", "drop", "eof", "active x")
+    db.apply(resp, [b"SYSTEM", b"LATENCY"])
+    lines = resp.strings()
+    # every declared seam reports, armed ones with non-zero percentiles
+    assert len([line for line in lines if line.startswith("drain.")]) == 4
+    (dispatch,) = [
+        line for line in lines if line.startswith("server.py_dispatch ")
+    ]
+    m = re.fullmatch(
+        r"server\.py_dispatch count 1 p50_us (\d+) p90_us \d+ "
+        r"p99_us (\d+) max_us \d+",
+        dispatch,
+    )
+    assert m and int(m.group(1)) > 0 and int(m.group(2)) > 0
+    (silent,) = [
+        line for line in lines if line.startswith("server.native_burst ")
+    ]
+    assert " count 0 " in silent
+
+    resp2 = _Resp()
+    db.apply(resp2, [b"SYSTEM", b"TRACE"])
+    t = resp2.strings()
+    assert len(t) == 2 and "server demote" in t[0] and "cluster drop eof" in t[1]
+    resp3 = _Resp()
+    db.apply(resp3, [b"SYSTEM", b"TRACE", b"1"])
+    assert len(resp3.strings()) == 1 and "cluster drop" in resp3.strings()[0]
+    # help advertises the new subcommands
+    resp4 = _Resp()
+    db.apply(resp4, [b"SYSTEM", b"NOPE"])
+    err = [a[0] for n, a in resp4.calls if n == "err"]
+    assert err and "LATENCY" in err[0] and "TRACE" in err[0]
+
+
+# ---- server dispatch seams --------------------------------------------------
+
+
+async def _drive_server(db, payload: bytes, n_replies: int) -> bytes:
+    cfg = Config()
+    cfg.port = "0"
+    cfg.log = Log.create_none()
+    server = Server(cfg, db)
+    await server.start()
+    try:
+        reader, writer = await asyncio.open_connection("127.0.0.1", server.port)
+        writer.write(payload)
+        await writer.drain()
+        got = b""
+        while got.count(b"\r\n") < n_replies:
+            chunk = await asyncio.wait_for(reader.read(1 << 16), timeout=5.0)
+            if not chunk:
+                break
+            got += chunk
+        writer.close()
+        return got
+    finally:
+        await server.dispose()
+
+
+def test_server_seams_record_both_paths():
+    async def main():
+        db = Database(identity=4)
+        burst = (
+            b"GCOUNT INC k 1\r\nGCOUNT GET k\r\n"
+            b"SYSTEM VERSION\r\n"  # SYSTEM always defers to Python
+        )
+        await _drive_server(db, burst, 3)
+        if db.native_engine is not None:
+            assert db.metrics.hist("server.native_burst").count > 0
+        assert db.metrics.hist("server.py_dispatch").count > 0
+        for h in ("server.native_burst", "server.py_dispatch"):
+            snap = db.metrics.hist(h).snapshot()
+            if snap["count"]:
+                assert snap["p50_s"] > 0 and snap["p99_s"] >= snap["p50_s"]
+
+    asyncio.run(main())
+
+
+def test_server_seams_disabled_registry_records_nothing():
+    async def main():
+        db = Database(identity=5)
+        db.metrics.enabled = False
+        await _drive_server(db, b"GCOUNT INC k 1\r\nSYSTEM VERSION\r\n", 2)
+        assert db.metrics.hist("server.native_burst").count == 0
+        assert db.metrics.hist("server.py_dispatch").count == 0
+
+    asyncio.run(main())
+
+
+# ---- Prometheus render ------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9.eE+-]+$"
+)
+
+
+def test_prom_render_grammar_and_presence():
+    from jylis_tpu.obs import prom
+
+    db = Database(identity=6)
+    resp = _Resp()
+    db.apply(resp, [b"GCOUNT", b"INC", b"k", b"2"])
+    db.metrics.hist("journal.append").record(0.0001)
+    body = prom.render(db)
+    for line in body.splitlines():
+        if line and not line.startswith("#"):
+            assert _SAMPLE_RE.match(line), line
+    for seam in SEAMS:  # full surface from boot, zero counts included
+        assert f'seam="{seam}"' in body
+    for g in GAUGES:
+        assert f'name="{g}"' in body
+    assert 'jylis_cmds_total{type="GCOUNT"} 1' in body
+    assert 'jylis_seam_latency_seconds_count{seam="journal.append"} 1' in body
+    # and the manifest agrees with the declared surface (the CI smoke
+    # asserts the same equivalence against a LIVE node's scrape)
+    manifest_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts", "jlint", "metrics_manifest.json",
+    )
+    manifest = json.load(open(manifest_path))["metrics"]
+    assert {n[5:] for n in manifest if n.startswith("hist:")} == set(SEAMS)
+    assert {n[6:] for n in manifest if n.startswith("gauge:")} == set(GAUGES)
+
+
+def test_prom_http_endpoint_serves_and_404s():
+    from jylis_tpu.obs.prom import MetricsHTTP
+
+    async def main():
+        db = Database(identity=7)
+        http = MetricsHTTP(db, port=0)
+        await http.start()
+        try:
+            reader, writer = await asyncio.open_connection("127.0.0.1", http.port)
+            writer.write(b"GET /metrics HTTP/1.1\r\nHost: x\r\n\r\n")
+            await writer.drain()
+            got = await asyncio.wait_for(reader.read(1 << 20), timeout=5.0)
+            assert got.startswith(b"HTTP/1.1 200 OK")
+            assert b"jylis_seam_latency_seconds" in got
+            writer.close()
+            reader, writer = await asyncio.open_connection("127.0.0.1", http.port)
+            writer.write(b"GET /nope HTTP/1.1\r\n\r\n")
+            await writer.drain()
+            got = await asyncio.wait_for(reader.read(1 << 16), timeout=5.0)
+            assert got.startswith(b"HTTP/1.1 404")
+            writer.close()
+        finally:
+            await http.dispose()
+
+    asyncio.run(main())
+
+
+# ---- journal seams ----------------------------------------------------------
+
+
+def test_journal_seams_record_append_and_fsync(tmp_path):
+    from jylis_tpu.journal import Journal
+
+    reg = MetricsRegistry()
+    default_before = metrics.DEFAULT.hists["journal.append"].count
+    j = Journal(str(tmp_path / "j.jylis"), fsync="always", registry=reg)
+    j.open()
+    j.append("GCOUNT", [(b"a", {1: 1})])
+    j.append("GCOUNT", [(b"b", {1: 2})])
+    j.close()
+    assert reg.hists["journal.append"].count == 2
+    assert reg.hists["journal.fsync"].count >= 2
+    assert reg.journal_counters["appends"] == 2
+    # per-instance: the process default saw none of it
+    assert metrics.DEFAULT.hists["journal.append"].count == default_before

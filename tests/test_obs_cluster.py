@@ -1,0 +1,218 @@
+"""Convergence-lag observability over a REAL 3-node cluster.
+
+The one distributed quantity a delta-CRDT store exists to bound — how
+long a delta takes to become visible on every replica — must be live on
+the node (ROADMAP's production-scale north star; arXiv:1410.2803 frames
+staleness as THE delta-CRDT trade). These tests drive the v6
+origin-stamped transport end to end: baseline lag on loopback is small,
+an injected `cluster.write=sleep:0.2` failpoint (PR 4's seam) makes the
+receiver's `converge_lag_ms` gauge rise past the injected delay, and
+healing the fault brings it back down — the EWMA decays within a few
+healthy pushes. Round-trip histograms and the SYSTEM LATENCY per-peer
+lines ride the same drill.
+"""
+
+import asyncio
+
+import pytest
+
+import jylis_tpu  # noqa: F401
+from jylis_tpu import faults
+from test_cluster import TICK, converge_wait, make_three_nodes, meshed, resp_call
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+async def _patient_call(port: int, payload: bytes) -> bytes:
+    """resp_call with a long read deadline: while cluster.write=sleep is
+    armed, every cluster send blocks the SHARED in-process event loop
+    for 0.2 s (3 nodes × 2 peers × keepalives per tick stack up), so a
+    client reply can legitimately take many seconds to flush."""
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(payload)
+    await writer.drain()
+    out = await asyncio.wait_for(reader.read(1 << 16), timeout=60.0)
+    writer.close()
+    return out
+
+
+async def _inc(node, key: bytes, amount: bytes) -> None:
+    got = await _patient_call(
+        node.server.port,
+        b"*4\r\n$6\r\nGCOUNT\r\n$3\r\nINC\r\n$%d\r\n%s\r\n$%d\r\n%s\r\n"
+        % (len(key), key, len(amount), amount),
+    )
+    assert got == b"+OK\r\n"
+
+
+async def _pump_until(writer_node, pred, ticks: int = 400) -> bool:
+    """Write on `writer_node` every few ticks until pred() holds — lag
+    samples only exist where pushes flow, so the drill keeps traffic
+    moving while it polls."""
+    for i in range(ticks):
+        if pred():
+            return True
+        if i % 3 == 0:
+            await _inc(writer_node, b"lagkey", b"1")
+        await asyncio.sleep(TICK)
+    return pred()
+
+
+def test_converge_lag_rises_under_fault_and_heals():
+    async def main():
+        foo, bar, baz = await make_three_nodes()
+        try:
+            assert await converge_wait(lambda: meshed(foo, bar, baz), ticks=200)
+            lag = lambda n: n.cluster._worst_lag_ms()  # noqa: E731
+
+            # baseline: pushes from foo land on bar/baz within a few ms
+            # of their origin stamp on loopback
+            assert await _pump_until(foo, lambda: lag(bar) > 0)
+            assert lag(bar) < 150, lag(bar)
+            assert str(foo.config.addr) in bar.cluster.lag_snapshot()
+
+            # fault: every cluster write sleeps 200 ms AFTER the origin
+            # stamp, so receivers apply stale data and the gauge must
+            # say so (>= the injected delay, minus EWMA smoothing)
+            faults.arm("cluster.write", "sleep", 0.2)
+            assert await _pump_until(foo, lambda: lag(bar) > 150.0), lag(bar)
+
+            # heal: fresh low-lag pushes decay the EWMA back to baseline
+            faults.disarm("cluster.write")
+            assert await _pump_until(foo, lambda: lag(bar) < 100.0), lag(bar)
+
+            # the same drill armed the round-trip seam on the sender and
+            # the lag histogram on the receiver
+            assert foo.cluster._h_rtt.count > 0
+            assert bar.cluster._h_lag.count > 0
+            # node-wide gauge mirrors into the registry (Prometheus view)
+            assert (
+                bar.database.metrics.gauges["cluster.converge_lag_ms"]
+                == pytest.approx(lag(bar))
+            )
+        finally:
+            faults.reset()
+            await foo.stop()
+            await bar.stop()
+            await baz.stop()
+
+    asyncio.run(main())
+
+
+def test_system_latency_reports_per_peer_lag_and_backlog_gauge():
+    async def main():
+        foo, bar, baz = await make_three_nodes()
+        try:
+            assert await converge_wait(lambda: meshed(foo, bar, baz), ticks=200)
+            assert await _pump_until(
+                foo, lambda: len(bar.cluster.lag_snapshot()) > 0
+            )
+            out = await resp_call(
+                bar.server.port, b"*2\r\n$6\r\nSYSTEM\r\n$7\r\nLATENCY\r\n"
+            )
+            assert b"converge_lag_ms peer " in out
+            assert b"cluster.converge_lag" in out
+            # METRICS carries the folded gauges in the CLUSTER section
+            out = await resp_call(
+                bar.server.port, b"*2\r\n$6\r\nSYSTEM\r\n$7\r\nMETRICS\r\n"
+            )
+            assert b"CLUSTER converge_lag_ms " in out
+            assert b"CLUSTER backlog_ms " in out
+        finally:
+            await foo.stop()
+            await bar.stop()
+            await baz.stop()
+
+    asyncio.run(main())
+
+
+def test_sync_replies_never_consume_rtt_stamps():
+    """cluster.rtt's FIFO match is exact only because a Pong answers
+    nothing but a stamped push/announce send: sync replies (deferred,
+    digest-matched, or end-of-dump) are MsgSyncDone, which must leave
+    the stamp queue untouched — one sync reply popping a push's stamp
+    would shift every later match by one, permanently skewing the
+    histogram this layer exists to make trustworthy."""
+    from test_cluster import Node, grab_ports
+
+    from jylis_tpu.cluster.msg import MsgPong, MsgSyncDone
+
+    async def main():
+        (port,) = grab_ports(1)
+        solo = Node("rtt", port)
+        await solo.start()
+        try:
+            conn = type("C", (), {})()
+            conn.pong_sent = __import__("collections").deque([1.0, 2.0])
+            await solo.cluster._active_msg(conn, MsgSyncDone())
+            assert list(conn.pong_sent) == [1.0, 2.0]
+            count0 = solo.cluster._h_rtt.count
+            await solo.cluster._active_msg(conn, MsgPong())
+            assert list(conn.pong_sent) == [2.0]
+            assert solo.cluster._h_rtt.count == count0 + 1
+        finally:
+            await solo.stop()
+
+    asyncio.run(main())
+
+
+def test_backlog_defer_clock_clears_when_requester_vanishes():
+    """A defer episode whose requester crashed (no sync request ever
+    returns) must not leave backlog_ms climbing forever: the heartbeat
+    decays the defer clock once no defer has happened for the same
+    6-sync-period window that retires the defer streaks."""
+    from test_cluster import Node, grab_ports
+
+    from jylis_tpu.cluster.cluster import SYNC_PERIOD_TICKS
+
+    async def main():
+        (port,) = grab_ports(1)
+        solo = Node("bklg", port)
+        await solo.start()
+        try:
+            c = solo.cluster
+            c._defer_since_ms = 123  # mid-episode, requester now gone
+            c._sync_defer_total_tick = c._tick - (6 * SYNC_PERIOD_TICKS + 1)
+            tick0 = c._tick
+            assert await converge_wait(lambda: c._tick > tick0, ticks=100)
+            assert c._defer_since_ms is None
+            assert c._backlog_ms() == 0.0
+        finally:
+            await solo.stop()
+
+    asyncio.run(main())
+
+
+def test_backlog_gauge_ages_held_deltas():
+    """A node with zero reachable peers holds flushed deltas; the
+    backlog gauge is the AGE of the oldest one — the time dimension the
+    held_now count lacks."""
+    from test_cluster import Node, grab_ports
+
+    async def main():
+        (port,) = grab_ports(1)
+        solo = Node("solo", port)  # knows nobody: every flush holds
+        await solo.start()
+        try:
+            await _inc(solo, b"k", b"3")
+            assert await converge_wait(
+                lambda: len(solo.cluster._held) > 0, ticks=100
+            )
+            await asyncio.sleep(4 * TICK)
+            age = solo.cluster._backlog_ms()
+            assert age >= 3 * TICK * 1000, age
+            assert (
+                solo.database.metrics.gauges["cluster.backlog_ms"] == age
+            )
+            assert solo.cluster.metrics_totals()["backlog_ms"] >= int(
+                3 * TICK * 1000
+            )
+        finally:
+            await solo.stop()
+
+    asyncio.run(main())
