@@ -18,11 +18,11 @@ PY ?= python
 ci: native lint test chaos check-graft check-prose bench-smoke \
     metrics-smoke sanitize
 
-# the three jlint passes + the broad-except rule, against the committed
+# the six jlint passes + the broad-except rule, against the committed
 # baseline (scripts/jlint/baseline.json — every entry justified in-line,
-# stale entries fail). The parity check re-extracts the native and Python
-# command surfaces on every run and fails on uncommitted drift against
-# scripts/jlint/parity_manifest.json; regenerate with
+# stale entries fail). The manifest checks (RESP parity, failpoints,
+# metrics, lane shared-state) re-extract their surfaces on every run
+# and fail on uncommitted drift; regenerate with
 # `$(PY) -m scripts.jlint --write-manifest` and commit the diff.
 lint:
 	$(PY) -m scripts.jlint
@@ -55,18 +55,23 @@ bench-smoke:
 
 # boot a real node with --metrics-port, scrape it, validate the
 # Prometheus exposition grammar + presence of every histogram/gauge in
-# scripts/jlint/metrics_manifest.json (the scrape surface can't rot)
+# scripts/jlint/metrics_manifest.json; then boot a --lanes 4 node and
+# validate the supervisor's AGGREGATED scrape (per-lane labels +
+# lane-less counter sums) — neither surface can rot
 metrics-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/metrics_smoke.py
 
 test:
 	$(PY) -m pytest tests/ -x -q
 
-# tiny fault-injection drill smoke (seconds): a curated subset of the
-# drill matrix — dial backoff/reset/timeout drills, an FFI fault served
-# via demotion, the CLUSTER metrics surface — per commit via `make ci`.
-# The FULL {error,sleep,corrupt,drop,crash} x {every registered
-# failpoint} matrix runs nightly behind `-m soak` (make soak).
+# tiny fault-injection drill smoke: a curated subset of the drill
+# matrix — dial backoff/reset/timeout drills, an FFI fault served via
+# demotion, the CLUSTER metrics surface, and the LANE-CRASH cell
+# (SIGKILL one lane of a spawned --lanes 2 node mid-traffic; surviving
+# lanes serve throughout, the respawn replays its journal segment,
+# per-lane digests re-match) — per commit via `make ci`. The FULL
+# {error,sleep,corrupt,drop,crash} x {every registered failpoint}
+# matrix plus the 3-node lane drills run nightly behind `-m soak`.
 chaos:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_drill_matrix.py -m chaos -q
 

@@ -424,28 +424,40 @@ def _concurrent_rate(
     return asyncio.run(measure())
 
 
+CONN_SWEEP = (1, 4, 16, 64, 256)
+
+
 def config_concurrent() -> dict:
     """Config 1b (round-4 verdict item 2; mix and counting re-recorded
-    for round 6): whole-node serving throughput under CONCURRENT
-    connections — 16 and 64 pipelined clients issuing a mixed
-    all-five-types workload with NO excluded command class (writes plus
-    TREG GET, TLOG GET, UJSON GET and UJSON SET) against per-client
-    keys, through the real RESP server, replies counted by a real RESP
-    reply parser (RespReplyCounter — the old line-terminator count both
-    mis-timed and excluded the structured reads). The recorded
-    fallback_frac is the measured fraction of the mix the Python
-    dispatch path served (the headline is an all-commands native number
-    only while it stays ≤ 0.05). Baseline: the same command mix as bare
-    Python dict/list loops (the reference's per-command work),
+    for round 6; connection sweep for the multi-lane round): whole-node
+    serving throughput under CONCURRENT connections — a FULL sweep over
+    1/4/16/64/256 pipelined clients issuing a mixed all-five-types
+    workload with NO excluded command class (writes plus TREG GET, TLOG
+    GET, UJSON GET and UJSON SET) against per-client keys, through the
+    real RESP server, replies counted by a real RESP reply parser
+    (RespReplyCounter — the old line-terminator count both mis-timed
+    and excluded the structured reads). Recording the whole curve (not
+    the old 1/16/64 three-point) makes lane-scaling shape a committed
+    artifact: the single-loop node's flat curve — and any non-monotonic
+    kink in it — is visible per point as `sweep`/`vs_one_conn`. The
+    recorded fallback_frac is the measured fraction of the mix the
+    Python dispatch path served (the headline is an all-commands native
+    number only while it stays ≤ 0.05). Baseline: the same command mix
+    as bare Python dict/list loops (the reference's per-command work),
     single-threaded — a baseline that pays no parsing, sockets, or
     replies."""
     from jylis_tpu.ops.hostref import GCounter, PNCounter
 
     import tempfile
 
-    r16, _ = _concurrent_rate(16)
-    r64, fallback = _concurrent_rate(64)
-    r1, _ = _concurrent_rate(1)
+    sweep: dict[str, float] = {}
+    fallback = 0.0
+    for n in CONN_SWEEP:
+        r, fb = _concurrent_rate(n)
+        sweep[str(n)] = round(r, 1)
+        if n == 64:
+            fallback = fb
+    r1, r64 = sweep["1"], sweep["64"]
     # journal append overhead (docs/durability.md): same 64-conn run with
     # the delta sink registered — as the cluster heartbeat does on every
     # real node — with vs without a journal attached (fsync=interval).
@@ -507,13 +519,231 @@ def config_concurrent() -> dict:
         "value": round(r64, 1),
         "unit": "commands/sec",
         "vs_baseline": round(r64 / cpu, 2),
-        "conns_16": round(r16, 1),
-        "conns_1": round(r1, 1),
+        "sweep": sweep,
+        "vs_one_conn_sweep": {
+            n: round(r / r1, 2) for n, r in sweep.items() if n != "1"
+        },
         "vs_one_conn": round(r64 / r1, 2),
         "fallback_frac": round(fallback, 4),
         "journal_cost_frac": round(max(0.0, 1 - withj / base), 2),
         "obs_cost_frac": round(obs_cost, 3),
     }
+
+
+# ---- multi-lane serving (config concurrent-sharded) ------------------------
+
+_SHARDED_SPAWN = (
+    "import os\n"
+    "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+    "import sys\n"
+    "from jylis_tpu.main import main\n"
+    "main(sys.argv[1:])\n"
+)
+
+
+def _free_port() -> int:
+    from jylis_tpu.utils.net import free_port
+
+    return free_port()
+
+
+def _spawn_sharded_node(lanes: int):
+    """A REAL node process (supervisor + SO_REUSEPORT lane workers for
+    lanes > 1; the ordinary single process for lanes == 1) pinned to
+    the CPU platform — the sharded config measures the host serving
+    path, and N lane processes cannot share one accelerator anyway
+    (docs/operations.md). Returns (proc, port)."""
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    port = _free_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-c", _SHARDED_SPAWN,
+            "--lanes", str(lanes), "--port", str(port),
+            "--addr", f"127.0.0.1:{_free_port()}:bench-sharded",
+            "--log-level", "warn", "-T", "0.5",
+        ],
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        env=env,
+        stdout=subprocess.DEVNULL,  # the logo must not pollute --smoke JSON
+    )
+    deadline = time.time() + 180
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError("bench node died during startup")
+        try:
+            s = socket.create_connection(("127.0.0.1", port), timeout=2)
+            s.sendall(b"GCOUNT GET boot\r\n")
+            s.settimeout(2)
+            ok = s.recv(64).startswith(b":")
+            s.close()
+            if ok:
+                return proc, port
+        except OSError:
+            time.sleep(0.3)
+    proc.kill()
+    raise RuntimeError("bench node never came up")
+
+
+def _sharded_client_worker(port, client_ids, reps, bursts, barrier, q):
+    """One CLIENT process (multiprocessing spawn target): its share of
+    the pipelined connections, warmed up, then a barrier-synchronised
+    timed phase. The single-process harness behind `concurrent` is
+    client-bound once the server spans cores, so the sharded config's
+    load generator must span cores too. Reports (replies, wall_start,
+    wall_end) — wall clock, because perf_counter is per-process."""
+    import asyncio
+
+    async def run():
+        payloads = {i: _mix_burst(i, reps) for i in client_ids}
+        conns = {}
+        for i in client_ids:
+            conns[i] = await asyncio.open_connection("127.0.0.1", port)
+
+        async def burst(i, rounds):
+            payload, n_replies = payloads[i]
+            reader, writer = conns[i]
+            done = 0
+            for _ in range(rounds):
+                writer.write(payload)
+                await writer.drain()
+                counter = RespReplyCounter()
+                got = 0
+                while got < n_replies:
+                    chunk = await reader.read(1 << 20)
+                    if not chunk:
+                        raise ConnectionError("server closed")
+                    got = counter.feed(chunk)
+                assert got == n_replies, (got, n_replies)
+                done += got
+            return done
+
+        await asyncio.gather(*(burst(i, 1) for i in client_ids))  # warmup
+        barrier.wait()
+        t0 = time.time()
+        done = await asyncio.gather(*(burst(i, bursts) for i in client_ids))
+        t1 = time.time()
+        for _, writer in conns.values():
+            writer.close()
+        return sum(done), t0, t1
+
+    q.put(asyncio.run(run()))
+
+
+def _sharded_rate(
+    port: int, conns: int, reps: int = 60, bursts: int = 8,
+    workers: int | None = None,
+) -> float:
+    """Aggregate commands/sec against an already-running node at
+    `port`, with the connections spread over multiple client
+    PROCESSES. Rate = total replies / the union wall-clock window."""
+    import multiprocessing as mp
+
+    import os
+
+    # one client process per SPARE core half, never more than the
+    # connection count: oversubscribing a small host with client
+    # processes measures scheduler thrash, not the node (a 4-worker
+    # load generator on a 2-core box collapsed the 64-conn point 6×
+    # below the 1-conn point)
+    workers = workers or max(1, min(conns, 4, (os.cpu_count() or 2) // 2))
+    ctx = mp.get_context("spawn")
+    barrier = ctx.Barrier(workers)
+    q = ctx.Queue()
+    ids = [list(range(conns))[w::workers] for w in range(workers)]
+    procs = [
+        ctx.Process(
+            target=_sharded_client_worker,
+            args=(port, ids[w], reps, bursts, barrier, q),
+        )
+        for w in range(workers)
+    ]
+    for p in procs:
+        p.start()
+    results = [q.get(timeout=600) for _ in range(workers)]
+    for p in procs:
+        p.join(timeout=60)
+    total = sum(r[0] for r in results)
+    window = max(r[2] for r in results) - min(r[1] for r in results)
+    return total / window
+
+
+def _stop_sharded_node(proc) -> None:
+    import subprocess
+
+    proc.terminate()
+    try:
+        proc.wait(timeout=60)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait(timeout=10)
+
+
+def config_concurrent_sharded() -> dict:
+    """Multi-lane serving, recorded (ROADMAP item 1): the SAME
+    all-commands mix as `concurrent`, against a REAL spawned node —
+    `--lanes N` (one lane per host core, ≥ 2) vs `--lanes 1` on the
+    same harness — with the load generator itself spread over client
+    processes (the in-process `concurrent` harness shares one loop
+    between server and clients, which is exactly the single-lane
+    ceiling this config exists to break). Records the full connection
+    sweep, the lanes-vs-single-lane ratio (`vs_baseline`), and the
+    non-pipelined TREG GET p99 at 64 connections for both, plus
+    `host_cores` — on a small host the kernel, the lanes, AND the
+    clients contend for the same cores, so the scaling headroom is
+    bounded by the machine and the record says so."""
+    import os
+
+    lanes = max(2, min(os.cpu_count() or 2, 8))
+    out: dict = {
+        "metric": f"mixed-type serving, {lanes}-lane node vs single-lane "
+        "(concurrent-sharded)",
+        "unit": "commands/sec",
+        "lanes": lanes,
+        "host_cores": os.cpu_count(),
+        # the scaling question this config answers is only answerable
+        # where there are cores to scale onto; the record says where it
+        # was taken so a small-host ratio reads as a floor, not a verdict
+        "note": "lanes, client processes, and kernel share host_cores; "
+        "on few-core hosts the ratio is host-bound",
+    }
+    sweeps: dict[int, dict[str, float]] = {}
+    p99s: dict[int, float] = {}
+    for n_lanes in (lanes, 1):
+        proc, port = _spawn_sharded_node(n_lanes)
+        try:
+            sweeps[n_lanes] = {
+                str(c): round(
+                    statistics.median(
+                        _sharded_rate(port, c) for _ in range(3)
+                    ),
+                    1,
+                )
+                for c in (1, 4, 16, 64)
+            }
+            lat = _latency_once(64, rounds=40, port=port)
+            p99s[n_lanes] = lat["treg_get"][1]
+        finally:
+            _stop_sharded_node(proc)
+    sharded, single = sweeps[lanes], sweeps[1]
+    out.update(
+        value=sharded["64"],
+        vs_baseline=round(sharded["64"] / single["64"], 2),
+        sweep=sharded,
+        vs_one_conn_sweep={
+            c: round(r / sharded["1"], 2)
+            for c, r in sharded.items() if c != "1"
+        },
+        single_lane_sweep=single,
+        p99_us_treg_get_64=p99s[lanes],
+        single_lane_p99_us_treg_get_64=p99s[1],
+        p99_speedup_64=round(p99s[1] / p99s[lanes], 2),
+    )
+    return out
 
 
 def config_serving_demotion() -> dict:
@@ -552,11 +782,15 @@ _LAT_CLASSES = (
 )
 
 
-def _latency_once(n_clients: int, rounds: int) -> dict[str, tuple]:
+def _latency_once(
+    n_clients: int, rounds: int, port: int | None = None
+) -> dict[str, tuple]:
     """{class: (p50_us, p99_us)} at n_clients concurrent NON-pipelined
     request/response connections: each client writes one command, waits
     for its complete reply (RespReplyCounter), and records the RTT —
-    what an un-batched caller actually experiences, queuing included."""
+    what an un-batched caller actually experiences, queuing included.
+    With ``port`` the clients hit an already-running external node (the
+    sharded config) instead of an in-process server."""
     import asyncio
 
     from jylis_tpu.models.database import Database
@@ -565,17 +799,20 @@ def _latency_once(n_clients: int, rounds: int) -> dict[str, tuple]:
     from jylis_tpu.utils.log import Log
 
     async def measure():
-        cfg = Config()
-        cfg.port = "0"
-        cfg.log = Log.create_none()
-        db = Database(identity=1)
-        server = Server(cfg, db)
-        await server.start()
+        server = None
+        if port is None:
+            cfg = Config()
+            cfg.port = "0"
+            cfg.log = Log.create_none()
+            db = Database(identity=1)
+            server = Server(cfg, db)
+            await server.start()
+        target = port if port is not None else server.port
         samples: dict[str, list[float]] = {n: [] for n, _ in _LAT_CLASSES}
         try:
             async def client(i: int) -> None:
                 reader, writer = await asyncio.open_connection(
-                    "127.0.0.1", server.port
+                    "127.0.0.1", target
                 )
                 try:
                     # prime per-key state and the UJSON render memo, then
@@ -608,7 +845,8 @@ def _latency_once(n_clients: int, rounds: int) -> dict[str, tuple]:
 
             await asyncio.gather(*(client(i) for i in range(n_clients)))
         finally:
-            await server.dispose()
+            if server is not None:
+                await server.dispose()
         return samples
 
     samples = asyncio.run(measure())
@@ -1284,6 +1522,7 @@ def config_pallas_join() -> dict:
 CONFIGS = {
     "gcount-smoke": config_gcount_smoke,
     "concurrent": config_concurrent,
+    "concurrent-sharded": config_concurrent_sharded,
     "serving-demotion": config_serving_demotion,
     "serving-latency": config_serving_latency,
     "pncount-100k": config_pncount_100k,
@@ -1324,6 +1563,17 @@ def smoke() -> None:
     assert ro > 0, ro
     lat = _latency_once(2, rounds=6)
     assert all(p50 > 0 and p99 >= p50 for p50, p99 in lat.values()), lat
+    # the sharded harness plumbing: a real 2-lane spawn, multi-process
+    # clients, the external-port latency loop — tiny iterations, so the
+    # machinery behind the concurrent-sharded record can't rot either
+    proc, port = _spawn_sharded_node(2)
+    try:
+        rs = _sharded_rate(port, 4, reps=4, bursts=2)
+        assert rs > 0, rs
+        slat = _latency_once(2, rounds=4, port=port)
+        assert all(p50 > 0 and p99 >= p50 for p50, p99 in slat.values()), slat
+    finally:
+        _stop_sharded_node(proc)
     print(
         json.dumps(
             {
@@ -1331,6 +1581,7 @@ def smoke() -> None:
                 "concurrent_cps": round(r, 1),
                 "fallback_frac": round(fb, 4),
                 "demoted_cps": round(rd, 1),
+                "sharded_cps": round(rs, 1),
                 "latency_us": lat,
             }
         )

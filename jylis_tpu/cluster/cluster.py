@@ -184,7 +184,7 @@ class _Conn:
         "writer", "active_addr", "peer_addr", "established", "task",
         "sync_served_tick",
         "sync_digests", "sync_defer_streak", "sync_defer_last_tick",
-        "pong_sent",
+        "pong_sent", "last_write_dropped",
     )
 
     def __init__(self, writer, active_addr: Address | None):
@@ -221,6 +221,12 @@ class _Conn:
         # idle-evicted within IDLE_TICKS_LIMIT ticks, and the deque dies
         # with the conn.
         self.pong_sent: deque = deque()
+        # True when the LAST send_raw "succeeded" only because an
+        # injected cluster.write=drop swallowed it: no frame reached
+        # the peer, so no Pong will answer — the rtt path must not
+        # stamp, or every later FIFO match shifts by one for the
+        # connection's lifetime
+        self.last_write_dropped = False
 
     # a peer that keeps ponging but stops reading would otherwise grow the
     # transport write buffer without bound
@@ -241,7 +247,9 @@ class _Conn:
             # by the periodic digest sync — the drill's loss-window case
             data = faults.point("cluster.write", data)
             if data is None:
+                self.last_write_dropped = True
                 return True  # injected send loss: pretend delivered
+            self.last_write_dropped = False
             self.writer.write(data)
             return True
         except (ConnectionError, RuntimeError):
@@ -255,10 +263,41 @@ class _Conn:
 
 
 class Cluster:
-    def __init__(self, config, database):
+    def __init__(
+        self,
+        config,
+        database,
+        drive_flush: bool = True,
+        register_system: bool = True,
+    ):
         self._config = config
         self._database = database
         self._log = config.log
+        # multi-lane bridge hooks (lanes.py). A node running N serving
+        # lanes has TWO Cluster instances on lane 0 — the external mesh
+        # on config.addr and the loopback lane bus — sharing ONE
+        # Database whose delta buffer must drain exactly once per
+        # flush: `drive_flush=False` makes this instance's heartbeat
+        # skip the database flush (dials/eviction/announce/sync still
+        # run), and `flush_sink` (when set on the driving instance)
+        # replaces broadcast_deltas as the flush sink so one drain can
+        # tee to both meshes. `on_push` is called after every converged
+        # MsgPushDeltas with (name, batch) — the bridge relays inbound
+        # deltas to the OTHER mesh there (converge never re-exports, so
+        # relaying cannot echo). `register_system=False` keeps this
+        # instance from claiming the SYSTEM METRICS CLUSTER section.
+        self._drive_flush = drive_flush
+        self.flush_sink = None
+        self.on_push = None
+        # the node's PRIMARY cluster view owns the shared observability
+        # names (cluster.rtt histogram, converge_lag_ms/backlog_ms
+        # gauges, SYSTEM METRICS CLUSTER section). On lane 0 the
+        # loopback bus instance is secondary (register_system=False):
+        # letting it record would drown the external mesh's
+        # microsecond-loopback-free rtt/lag signal — the exact
+        # cross-node staleness surface the gauges exist to expose —
+        # and flap the gauges last-writer-wins between the instances.
+        self._obs_primary = register_system
         self._addr: Address = config.addr
         self._known_addrs: P2Set = P2Set([self._addr])
         for seed in config.seed_addrs:
@@ -337,7 +376,7 @@ class Cluster:
         # instance (wired here, not in main, so in-process test nodes
         # get the same observability as spawned ones)
         system = getattr(database, "system", None)
-        if system is not None:
+        if system is not None and register_system:
             system.cluster_fn = self.metrics_totals
             system.lag_fn = self.lag_snapshot
 
@@ -413,12 +452,17 @@ class Cluster:
         # flush as a task taking each repo's lock: a repo mid-drain delays
         # only its own flush, never the tick (eviction/announce/dial
         # above). Hold a strong reference — asyncio keeps only weak task
-        # refs — and surface exceptions through the log
-        task = asyncio.get_running_loop().create_task(
-            self._database.flush_deltas_async(self.broadcast_deltas)
-        )
-        self._flush_tasks.add(task)
-        task.add_done_callback(self._flush_task_done)
+        # refs — and surface exceptions through the log. On a lane-0
+        # bridge the non-driving instance skips this (the driving
+        # instance's flush_sink tees the one drain to both meshes).
+        if self._drive_flush:
+            task = asyncio.get_running_loop().create_task(
+                self._database.flush_deltas_async(
+                    self.flush_sink or self.broadcast_deltas
+                )
+            )
+            self._flush_tasks.add(task)
+            task.add_done_callback(self._flush_task_done)
         self._sync_actives()
 
     def metrics_totals(self) -> dict[str, int]:
@@ -468,8 +512,8 @@ class Cluster:
     LAG_ALPHA = 0.5
 
     def _note_lag(self, peer: str, lag_ms: float) -> None:
-        if not self._reg.enabled:
-            return  # the obs kill switch covers the lag surface too
+        if not self._reg.enabled or not self._obs_primary:
+            return  # obs kill switch / secondary (lane-bus) instance
         old = self._lag_ms.get(peer)
         self._lag_ms[peer] = (
             lag_ms if old is None
@@ -748,7 +792,7 @@ class Cluster:
             # never strand stamps and shift later matches
             if conn.pong_sent:
                 dt = time.perf_counter() - conn.pong_sent.popleft()
-                if self._reg.enabled:
+                if self._reg.enabled and self._obs_primary:
                     self._h_rtt.record(dt)
             return  # liveness only
         if isinstance(msg, MsgSyncDone):
@@ -764,6 +808,8 @@ class Cluster:
             self._sync_rx_tick = self._tick  # mid-heal: defer serving dumps
             await self._database.converge_async((msg.name, list(msg.batch)))
             self._record_push_lag(conn, origin_ms)
+            if self.on_push is not None:
+                self.on_push(msg.name, list(msg.batch))
             return
         self._log.err() and self._log.e(
             f"unexpected active message: {type(msg).__name__}"
@@ -789,6 +835,8 @@ class Cluster:
             self._send(conn, MsgPong())
             await self._database.converge_async((msg.name, list(msg.batch)))
             self._record_push_lag(conn, origin_ms)
+            if self.on_push is not None:
+                self.on_push(msg.name, list(msg.batch))
             return
         if isinstance(msg, MsgAnnounceAddrs):
             self._converge_addrs(msg.known_addrs)
@@ -1161,12 +1209,15 @@ class Cluster:
             if conn.established:
                 if conn.send_raw(data):
                     sent = True
-                    if expect_pong:
+                    if expect_pong and not conn.last_write_dropped:
                         # stamp unconditionally (one float append — not
                         # the serving hot path the enabled switch
                         # guards): stamping only-while-enabled would mix
                         # stamped and unstamped sends on one conn and
-                        # desync the FIFO when the switch flips mid-conn
+                        # desync the FIFO when the switch flips mid-conn.
+                        # EXCEPT an injected-drop "send": no frame left,
+                        # no Pong comes, the stamp would strand and
+                        # shift every later match by one
                         conn.pong_sent.append(time.perf_counter())
                 else:
                     self._drop(conn, Drop.WRITE_FAILED)
@@ -1253,11 +1304,14 @@ class Cluster:
         if tracked:
             # the lag gauge tracks LIVE peers: a departed conn's EWMA
             # must not pin the node-wide max forever (a rejoin restarts
-            # sampling immediately)
+            # sampling immediately). Secondary (lane-bus) instances
+            # never own the gauge — writing their always-empty max
+            # here would zero the primary's value on every bus drop.
             self._lag_ms.pop(self._peer_key(conn), None)
-            self._reg.gauge_set(
-                "cluster.converge_lag_ms", self._worst_lag_ms()
-            )
+            if self._obs_primary:
+                self._reg.gauge_set(
+                    "cluster.converge_lag_ms", self._worst_lag_ms()
+                )
         self._last_activity.pop(conn, None)
         self._passives.discard(conn)
         if conn.active_addr is not None:

@@ -7,6 +7,9 @@ from .journal import (  # noqa: F401
     Journal,
     JournalError,
     MAGIC,
+    list_segments,
     recover,
+    recover_all,
+    segment_name,
     replay_journal,
 )

@@ -617,6 +617,71 @@ def _db_registry(database):
     return metrics.resolve_registry(database)
 
 
+def segment_name(lane_id: int | None) -> str:
+    """The journal segment filename for a lane (None / lane-less nodes
+    keep the classic ``journal.jylis``; lane k writes
+    ``journal.lane<k>.jylis`` so N lanes append independently)."""
+    if lane_id is None:
+        return "journal.jylis"
+    return f"journal.lane{lane_id}.jylis"
+
+
+def list_segments(data_dir: str) -> list[str]:
+    """Every journal segment path in ``data_dir``, ANY lane naming —
+    the classic ``journal.jylis`` plus every ``journal.lane<k>.jylis``
+    (``.retiring``/``.unreadable`` variants are handled by recover,
+    not listed here). Sorted for deterministic replay order (order is
+    a formality: replay is lattice join)."""
+    out = []
+    for fname in sorted(os.listdir(data_dir)):  # jlint: blocking-ok (boot)
+        if fname == "journal.jylis" or (
+            fname.startswith("journal.lane") and fname.endswith(".jylis")
+        ):
+            out.append(os.path.join(data_dir, fname))
+    return out
+
+
+def recover_all(database, data_dir: str, own_path: str, log=None) -> int:
+    """Boot-path MERGE replay for multi-lane nodes: every lane's
+    segment (and its ``.retiring`` sibling) converges into this
+    database. Lattice join makes cross-segment overlap harmless, and a
+    node rebooted with a DIFFERENT lane count (or ``--lanes 1``) still
+    recovers every lane's accepted writes — segments are disjoint by
+    acceptance (each lane journals only batches its own serving path
+    flushed), and their union is the node's whole journaled state.
+
+    Only the lane's OWN segment (``own_path``) gets the mutating
+    recovery (torn-tail truncation, ``.unreadable`` move-aside): a lane
+    restarting while its siblings are still serving reads THEIR
+    segments mid-append, so a foreign segment's torn tail is the
+    owner's live write, not a crash artifact — foreign segments replay
+    best-effort with no truncation and no rename, and whatever the
+    read missed converges in over the lane bus sync instead."""
+    # the own segment recovers unconditionally (its .retiring sibling
+    # can exist even when the active file does not — a crash between
+    # rotate_begin's rename and the fresh open)
+    total = recover(database, own_path, log)
+    try:
+        segments = list_segments(data_dir)
+    except OSError:
+        return total
+    for path in segments:
+        if path == own_path:
+            continue
+        for p in (path + ".retiring", path):
+            try:
+                total += replay_journal(database, p, truncate_tail=False)
+            except JournalError as e:
+                # a foreign lane's problem (or its live mid-write tail):
+                # never mutate another lane's file; the owner heals it
+                # and the bus sync heals us
+                if log is not None:
+                    log.warn() and log.w(
+                        f"foreign journal segment skipped ({p}): {e}"
+                    )
+    return total
+
+
 def recover(database, path: str, log=None) -> int:
     """THE boot-path entry (main.py): replay the retiring segment first
     (present only when a crash interrupted compaction), then the active

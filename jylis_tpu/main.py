@@ -149,18 +149,47 @@ class Dispose:
 
 async def run(argv: list[str] | None = None) -> None:
     config = config_from_cli(argv)
+    if config.lanes > 1 and config.lane_id is None:
+        # multi-lane node: THIS process becomes the lane supervisor —
+        # it spawns one worker per lane (SO_REUSEPORT on the RESP port,
+        # loopback delta bus between them), restarts crashed lanes, and
+        # aggregates their metrics endpoints (lanes.py)
+        from . import lanes as lanes_mod
+
+        print(LOGO)
+        # argv=None means "parsed from sys.argv" (python -m jylis_tpu):
+        # the supervisor re-spawns workers from the SAME flag list, so
+        # it must see what argparse saw
+        await lanes_mod.run_supervisor(
+            config, sys.argv[1:] if argv is None else argv
+        )
+        return
     if config.failpoints:
         # flag arming lands on top of any JYLIS_FAILPOINTS env arming
         # (faults.py parses the env at import); same spec syntax
         faults.arm_spec(config.failpoints)
+    lane_id = config.lane_id
+    if lane_id is not None:
+        from . import lanes as lanes_mod
+
+        # each lane is a distinct CRDT replica with a RESTART-STABLE
+        # identity (advertised address + lane ordinal, lanes.py)
+        identity = lanes_mod.lane_identity(config, lane_id)
+    else:
+        identity = config.addr.hash64()
     system = System(config)
     database_mod.warmup()  # compile serving kernels before going live
     # (warmup's throwaway Database records its compile-time drains into
     # its OWN registry, so the serving registry starts clean by
     # construction — the old process-global clear() is gone with the
     # globals it cleared)
-    database = Database(identity=config.addr.hash64(), system_repo=system.repo)
+    database = Database(identity=identity, system_repo=system.repo)
     log = config.log
+    if lane_id is not None:
+        # SYSTEM METRICS' LANE section: which lane this connection
+        # landed on, out of how many (clients pin lane-affine reads by
+        # reconnecting until the id matches)
+        system.repo.lane_fn = lambda: {"id": lane_id, "count": config.lanes}
 
     snapshot_path = ""
     journal = None
@@ -169,29 +198,48 @@ async def run(argv: list[str] | None = None) -> None:
     # has no clients to stall, and sequencing recovery before serving is
     # the point. jlint: blocking-ok
     if config.data_dir:
+        from . import lanes as lanes_mod
+
         os.makedirs(config.data_dir, exist_ok=True)  # jlint: blocking-ok
-        snapshot_path = os.path.join(config.data_dir, "snapshot.jylis")
-        if os.path.exists(snapshot_path):
+        snapshot_path = os.path.join(
+            config.data_dir, lanes_mod.snapshot_name(lane_id)
+        )
+        # restore EVERY snapshot present (own lane's plus any sibling
+        # or previous-lane-count file): restore is lattice convergence,
+        # so overlap is a no-op and a changed --lanes never strands
+        # state. Only the OWN file is moved aside when unreadable — a
+        # sibling lane may be alive and writing its own.
+        for spath in lanes_mod.list_snapshots(config.data_dir):
             try:
-                n = persist.load_snapshot(database, snapshot_path)
-                log.info() and log.i(f"snapshot restored ({n} type batches)")
+                n = persist.load_snapshot(database, spath)
+                log.info() and log.i(
+                    f"snapshot restored ({n} type batches, {spath})"
+                )
             except persist.SnapshotError as e:
                 log.err() and log.e(f"snapshot not restored: {e}")
+                if spath != snapshot_path:
+                    continue
                 # preserve the unreadable file: the next clean shutdown will
                 # write snapshot_path fresh, and overwriting the only copy
                 # of un-restored data would destroy it
-                aside = snapshot_path + ".unreadable"
+                aside = spath + ".unreadable"
                 try:
-                    os.replace(snapshot_path, aside)  # jlint: blocking-ok
+                    os.replace(spath, aside)  # jlint: blocking-ok
                     log.err() and log.e(f"moved aside to {aside}")
                 except OSError:
                     pass
         if config.journal:
             # recovery ordering: snapshot first, then the journal tail —
             # though lattice join makes the order a formality (overlap
-            # between snapshot and journal converges to the same state)
-            journal_path = os.path.join(config.data_dir, "journal.jylis")
-            n = journal_mod.recover(database, journal_path, log)
+            # between snapshot and journal converges to the same state).
+            # Merge replay: every lane segment converges (the own one
+            # with truncation/move-aside, live siblings' read-only).
+            journal_path = os.path.join(
+                config.data_dir, journal_mod.segment_name(lane_id)
+            )
+            n = journal_mod.recover_all(
+                database, config.data_dir, journal_path, log
+            )
             if n:
                 log.info() and log.i(f"journal replayed ({n} delta batches)")
             journal = journal_mod.Journal(
@@ -205,7 +253,40 @@ async def run(argv: list[str] | None = None) -> None:
             database.set_journal(journal)
 
     server = Server(config, database)
-    cluster = Cluster(config, database)
+    lane_tick_task = None
+    if lane_id is None:
+        cluster = Cluster(config, database)
+    else:
+        from . import lanes as lanes_mod
+
+        # the lane bus: the existing cluster engine on loopback — wire
+        # framing, CRC, delta broadcast, digest-checked rejoin sync and
+        # dial backoff all inherited. Lane 0 additionally runs the
+        # node's ONE external cluster identity and bridges the meshes.
+        bus = Cluster(
+            lanes_mod.bus_config(config, lane_id),
+            database,
+            register_system=(lane_id != 0),
+        )
+        external = None
+        if lane_id == 0:
+            external = Cluster(config, database, drive_flush=False)
+            lanes_mod.wire_bridge(bus, external)
+        cluster = lanes_mod.LaneClusters(bus, external)
+
+        async def _lane_tick() -> None:
+            # the lane-crash drill seam: arming `lane.tick=crash` in ONE
+            # lane's env (supervisor: JYLIS_LANE_FAILPOINTS="1:lane.tick
+            # =crash:1") kills that worker mid-traffic, deterministically.
+            # error degrades to a log line, sleep just delays the tick.
+            while True:
+                await asyncio.sleep(0.25)
+                try:
+                    await faults.async_point("lane.tick")
+                except faults.FaultError:
+                    log.warn() and log.w("lane.tick failpoint fired")
+
+        lane_tick_task = asyncio.create_task(_lane_tick())
     await server.start()
     await cluster.start()
     metrics_http = None
@@ -229,12 +310,15 @@ async def run(argv: list[str] | None = None) -> None:
             )
         )
 
-    print(LOGO)
+    if lane_id is None:
+        print(LOGO)  # lane workers skip it: one logo per NODE, not per lane
     log = config.log
     from . import __version__
 
     log.info() and log.i(f"jylis-tpu version: {__version__}")
     log.info() and log.i(f"cluster address: {config.addr}")
+    if lane_id is not None:
+        log.info() and log.i(f"serving lane {lane_id}/{config.lanes}")
     log.info() and log.i(f"serving clients on port: {server.port}")
     if metrics_http is not None:
         log.info() and log.i(f"metrics endpoint on port: {metrics_http.port}")
@@ -247,6 +331,8 @@ async def run(argv: list[str] | None = None) -> None:
         _dump_trace(database, log)
         raise
     finally:
+        if lane_tick_task is not None:
+            lane_tick_task.cancel()
         if metrics_http is not None:
             await metrics_http.dispose()
 

@@ -77,6 +77,11 @@ class Database:
         self._sync_xor: dict[str, bytes] = {
             n: bytes(32) for n in self.DATA_TYPES
         }
+        # SYSTEM DIGEST (the drill matrix's convergence probe, exposed
+        # to any Redis client): the async serving path computes it
+        # under the repo locks (apply_async intercept below); the sync
+        # single-threaded path goes through this hook on RepoSYSTEM
+        self.system.digest_fn = self._sync_digest_blocking
 
     def _served_totals(self) -> dict[str, int]:
         """Commands served per type on BOTH paths (SYSTEM METRICS)."""
@@ -145,6 +150,17 @@ class Database:
             b"".join(await self.sync_type_digests_async())
         ).digest()
 
+    def _sync_digest_blocking(self) -> bytes:
+        """The combined digest for SINGLE-THREADED callers (warmup,
+        direct drives, tests): same bytes as sync_digest_async, no
+        locks — the serving path never reaches this (apply_async
+        intercepts SYSTEM DIGEST before repo dispatch)."""
+        for name in self.DATA_TYPES:
+            self._sync_update_repo(name, self._map[name.encode()].repo)
+        return hashlib.sha256(
+            b"".join(self._sync_xor[n] for n in self.DATA_TYPES)
+        ).digest()
+
     def set_journal(self, journal) -> None:
         """Attach the delta write-ahead journal (journal/): every repo's
         flushed delta batches append to it before reaching the network
@@ -171,6 +187,16 @@ class Database:
 
     async def apply_async(self, resp, cmd: list[bytes]) -> None:
         """Serving path: per-repo locking + threaded drains (manager.py)."""
+        if len(cmd) == 2 and cmd[0] == b"SYSTEM" and cmd[1] == b"DIGEST":
+            # served here (not in RepoSYSTEM.apply, which is sync):
+            # the digest takes every DATA repo's lock in turn, which
+            # only the async path can await. The hex of the combined
+            # per-type digest — equal bytes on converged replicas, so
+            # "are these nodes (or lanes) converged?" is answerable
+            # from any Redis client.
+            digest = await self.sync_digest_async()
+            resp.string(digest.hex().encode())
+            return
         mgr = self._map.get(cmd[0]) if cmd else None
         if mgr is None:
             respond_help(resp, DATATYPE_HELP)

@@ -26,6 +26,7 @@ SYSTEM_HELP = LeafHelp(
     "  SYSTEM METRICS\n"
     "  SYSTEM LATENCY\n"
     "  SYSTEM TRACE [count]\n"
+    "  SYSTEM DIGEST\n"
     "  SYSTEM VERSION"
 )
 
@@ -60,6 +61,14 @@ class RepoSYSTEM:
         # wired as `metrics` like every repo. None (a standalone
         # RepoSYSTEM) reads the process DEFAULT via resolve_registry.
         self.metrics = None
+        # main.py wires this on lane workers: {"id": k, "count": n} for
+        # the LANE section of SYSTEM METRICS (which lane a connection
+        # landed on); None on single-lane nodes — no section
+        self.lane_fn = None
+        # the owning Database wires this to its single-threaded digest
+        # computation (the async serving path intercepts SYSTEM DIGEST
+        # in Database.apply_async instead — it must await repo locks)
+        self.digest_fn = None
 
     def apply(self, resp, args: list[bytes]) -> bool:
         op = need(args, 0)
@@ -86,6 +95,7 @@ class RepoSYSTEM:
                 self.serving_fn() if self.serving_fn else None,
                 self.cluster_fn() if self.cluster_fn else None,
                 registry=self.metrics,
+                lane=self.lane_fn() if self.lane_fn else None,
             )
             resp.array_start(len(lines))
             for line in lines:
@@ -120,6 +130,14 @@ class RepoSYSTEM:
 
             for entry in entries:
                 resp.string(TraceRing.format(entry))
+            return False
+        if op == b"DIGEST":
+            # single-threaded path only (warmup/tests/direct drives):
+            # the serving path's SYSTEM DIGEST is intercepted by
+            # Database.apply_async, which awaits the repo locks
+            if self.digest_fn is None:
+                raise ParseError()
+            resp.string(self.digest_fn().hex().encode())
             return False
         if op == b"VERSION":
             from .. import __version__

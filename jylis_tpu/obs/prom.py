@@ -93,13 +93,22 @@ def render(database) -> str:
 
 class MetricsHTTP:
     """GET /metrics on ``port`` (0 = ephemeral; the bound port is
-    `.port`). Anything else is a 404; malformed requests just close."""
+    `.port`). Anything else is a 404; malformed requests just close.
 
-    def __init__(self, database, port: int, log=None):
+    ``render_async`` swaps the body producer (an async () -> str): the
+    lane supervisor's aggregated endpoint (lanes.py) reuses this whole
+    responder — request parse, bounded header drain, status handling —
+    with its own multi-lane render."""
+
+    def __init__(self, database, port: int, log=None, render_async=None):
         self._database = database
         self._want_port = port
         self._log = log
         self._server: asyncio.base_events.Server | None = None
+        self._render = render_async or self._render_default
+
+    async def _render_default(self) -> str:
+        return render(self._database)
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -128,7 +137,7 @@ class MetricsHTTP:
             if len(parts) >= 2 and parts[0] == b"GET" and (
                 parts[1] == b"/metrics" or parts[1].startswith(b"/metrics?")
             ):
-                body = render(self._database).encode()
+                body = (await self._render()).encode()
                 head = (
                     b"HTTP/1.1 200 OK\r\n"
                     b"Content-Type: text/plain; version=0.0.4; charset=utf-8\r\n"

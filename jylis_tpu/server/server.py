@@ -46,9 +46,26 @@ class Server:
 
     async def start(self) -> None:
         try:
-            self._server = await asyncio.start_server(
-                self._handle_client, host=None, port=int(self._config.port)
-            )
+            if getattr(self._config, "lanes", 1) > 1:
+                # multi-lane serving: every lane binds the SAME port
+                # with SO_REUSEPORT and the kernel shards accepted
+                # connections across the lane processes — no userspace
+                # acceptor, no fd passing. IPv4-only in this mode (each
+                # family would otherwise need its own shared socket).
+                import socket as _socket
+
+                sock = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+                sock.setsockopt(
+                    _socket.SOL_SOCKET, _socket.SO_REUSEPORT, 1
+                )
+                sock.bind(("0.0.0.0", int(self._config.port)))
+                self._server = await asyncio.start_server(
+                    self._handle_client, sock=sock
+                )
+            else:
+                self._server = await asyncio.start_server(
+                    self._handle_client, host=None, port=int(self._config.port)
+                )
         except OSError as e:
             self._log.err() and self._log.e(f"server listen failed: {e}")
             raise

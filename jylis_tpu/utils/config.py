@@ -48,12 +48,31 @@ class Config:
     # extension: opt-in Prometheus text-exposition endpoint (obs/prom.py);
     # 0 disables, -1 asks for an ephemeral port (logged at boot)
     metrics_port: int = 0
+    # extension: multi-lane serving (lanes.py) — N worker processes
+    # sharing the RESP port via SO_REUSEPORT, converging over a loopback
+    # delta bus. lanes=1 is the classic single-process node; lane_id is
+    # set ONLY in spawned lane workers (None = supervisor / single-lane);
+    # lane_bus is the comma-joined list of every lane's bus port.
+    lanes: int = 1
+    lane_id: int | None = None
+    lane_bus: list[int] = field(default_factory=list)
+    lane_bus_heartbeat: float = 0.25
     log: Log = field(default_factory=Log.create_none)
 
     def normalize(self) -> None:
         if not self.addr.name:
             rng = random.Random(time.time_ns())
             self.addr = Address(self.addr.host, self.addr.port, generate_name(rng))
+
+
+def resolve_auto_lanes(cpus: int | None = None) -> int:
+    """``--lanes auto``: 1 below 4 host cores (a lane split would just
+    contend), else the core count capped at 8 (past that the loopback
+    bus and the shared accelerator dominate)."""
+    import os
+
+    n = cpus if cpus is not None else (os.cpu_count() or 1)
+    return 1 if n < 4 else min(n, 8)
 
 
 def config_from_cli(argv: list[str] | None = None, log_out=None) -> Config:
@@ -154,6 +173,29 @@ def config_from_cli(argv: list[str] | None = None, log_out=None) -> Config:
         "ephemeral port (logged at boot); 0 (default) disables.",
     )
     parser.add_argument(
+        "--lanes", default="1",
+        help="Serving lanes: N worker processes each owning a full "
+        "ServeEngine/Database/journal-segment/metrics stack, sharing "
+        "the RESP port via SO_REUSEPORT and converging over a loopback "
+        "delta bus (the same wire-delta plumbing the cluster uses — "
+        "CRDT join makes the lanes coordination-free). 'auto' picks "
+        "from the host core count (1 on hosts with < 4 cores, else "
+        "cores capped at 8); 1 (default) is the classic single-process "
+        "node. See docs/operations.md, 'Serving and host cores'.",
+    )
+    parser.add_argument(
+        "--lane-id", type=int, default=None, help=argparse.SUPPRESS,
+    )  # internal: set by the lane supervisor on spawned workers
+    parser.add_argument(
+        "--lane-bus", default="", help=argparse.SUPPRESS,
+    )  # internal: comma-joined bus ports, one per lane, supervisor-set
+    parser.add_argument(
+        "--lane-bus-heartbeat", type=float, default=0.25,
+        help="Heartbeat seconds for the intra-node lane bus (cross-lane "
+        "convergence cadence; the proactive flush still ships deltas "
+        "within 500 ms of a write). Only meaningful with --lanes > 1.",
+    )
+    parser.add_argument(
         "-L", "--log-level", default="info",
         help="Maximum level of detail for logging (error, warn, info, or debug).",
     )
@@ -184,6 +226,20 @@ def config_from_cli(argv: list[str] | None = None, log_out=None) -> Config:
     config.dial_backoff_cap = args.dial_backoff_cap
     config.failpoints = args.failpoints
     config.metrics_port = args.metrics_port
+    if args.lanes == "auto":
+        config.lanes = resolve_auto_lanes()
+    else:
+        try:
+            config.lanes = int(args.lanes)
+        except ValueError:
+            parser.error(f"--lanes must be an integer or 'auto': {args.lanes}")
+        if config.lanes < 1:
+            parser.error("--lanes must be >= 1")
+    config.lane_id = args.lane_id
+    config.lane_bus = [int(p) for p in args.lane_bus.split(",") if p]
+    config.lane_bus_heartbeat = args.lane_bus_heartbeat
+    if config.lane_id is not None and len(config.lane_bus) != config.lanes:
+        parser.error("--lane-id requires --lane-bus with one port per lane")
 
     level = {"error": "err", "warn": "warn", "info": "info", "debug": "debug"}.get(
         args.log_level

@@ -85,6 +85,8 @@ RULES = {
     "JL402": (None, "failpoints manifest entry stale, missing, or undescribed"),
     "JL501": (None, "metric name non-literal, not declared in metrics_manifest.json, or not pre-registered in obs"),
     "JL502": (None, "metrics manifest / obs declaration stale, missing, or undescribed"),
+    "JL601": ("lane-shared-ok", "module-level mutable (per-LANE state under --lanes N) not declared in lanes_manifest.json"),
+    "JL602": (None, "lanes manifest entry stale, missing, or undescribed"),
     "JL900": (None, "stale or malformed baseline suppression entry"),
 }
 

@@ -19,7 +19,14 @@ from . import (
     iter_py_files,
     load_baseline,
 )
-from . import pass_async, pass_failpoints, pass_jax, pass_metrics, pass_parity
+from . import (
+    pass_async,
+    pass_failpoints,
+    pass_jax,
+    pass_lanes,
+    pass_metrics,
+    pass_parity,
+)
 
 # pass 1 + JL001 cover the product and its scripts; tests are excluded
 # (fixtures deliberately violate the rules), and jlint's own fixtures
@@ -47,6 +54,9 @@ def run_all(root: str = ROOT, verbose: bool = False) -> int:
     ]
     findings = pass_async.run(async_sources)
     findings += pass_jax.run(jax_sources)
+    # pass 6 runs before suppression handling: its JL601 findings live
+    # in product files and honor `# jlint: lane-shared-ok`
+    findings += pass_lanes.check()
     by_rel = {s.rel: s for s in async_sources}
     apply_suppressions(findings, by_rel)
     problems = apply_baseline(findings, load_baseline())
@@ -63,7 +73,7 @@ def run_all(root: str = ROOT, verbose: bool = False) -> int:
     n_sup = sum(1 for f in findings if f.suppressed)
     print(
         f"jlint: {len(bad)} finding(s), {n_sup} suppressed "
-        f"({len(async_sources)} files, 5 passes)"
+        f"({len(async_sources)} files, 6 passes)"
     )
     return 1 if bad else 0
 
@@ -95,6 +105,12 @@ def main(argv=None) -> int:
         todo = sum(1 for d in mets.values() if d == pass_metrics.PLACEHOLDER)
         print(
             f"metrics manifest written: {len(mets)} metrics"
+            + (f" ({todo} need descriptions)" if todo else "")
+        )
+        lns = pass_lanes.write_manifest()
+        todo = sum(1 for d in lns.values() if d == pass_lanes.PLACEHOLDER)
+        print(
+            f"lanes manifest written: {len(lns)} module-level mutables"
             + (f" ({todo} need descriptions)" if todo else "")
         )
         return 0

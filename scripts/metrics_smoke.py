@@ -4,10 +4,17 @@ gauge declared in scripts/jlint/metrics_manifest.json is present from
 boot (zero counts included — the observability surface must not depend
 on traffic having happened).
 
-Run via `make metrics-smoke` (part of `make ci`). Exit 0 = a live
-node's scrape is grammatically valid Prometheus exposition and carries
-the full declared metric surface plus non-trivial serving activity
-(the script issues a few RESP commands first, so at least one seam has
+Then boot a MULTI-LANE node (`--lanes N`, N from JYLIS_SMOKE_LANES,
+default 4) and scrape the supervisor's AGGREGATED endpoint: every
+manifest histogram must be present per lane (`lane="k"` labels for
+every k), the counter families must also appear as aggregate
+(lane-less) sums, every lane must report `jylis_lane_up 1`, and the
+whole body must still be grammatically valid exposition — the per-lane
+and aggregate metric surfaces can't rot independently.
+
+Run via `make metrics-smoke` (part of `make ci`). Exit 0 = both
+scrapes valid and complete, with non-trivial serving activity (the
+script issues a few RESP commands first, so at least one seam has
 samples).
 """
 
@@ -69,7 +76,7 @@ def scrape(port: int, timeout_s: float = 240.0) -> str:
     raise RuntimeError(f"metrics endpoint never came up: {last!r}")
 
 
-def resp_traffic(port: int, timeout_s: float = 60.0) -> None:
+def resp_traffic(port: int, timeout_s: float = 180.0) -> None:
     """A few real commands so the dispatch seams have samples."""
     deadline = time.time() + timeout_s
     while time.time() < deadline:
@@ -91,26 +98,33 @@ def resp_traffic(port: int, timeout_s: float = 60.0) -> None:
     s.close()
 
 
-def main() -> int:
-    manifest = json.load(open(MANIFEST))["metrics"]
-    hists = sorted(n[5:] for n in manifest if n.startswith("hist:"))
-    gauges = sorted(n[6:] for n in manifest if n.startswith("gauge:"))
-
+def _boot_and_scrape(lanes: int) -> str:
     resp_port = free_port()
     mport = free_port()
-    proc = subprocess.Popen(
-        [
-            sys.executable, "-c", SPAWN,
-            "--port", str(resp_port),
-            "--addr", "127.0.0.1:0:metrics-smoke",
-            "--metrics-port", str(mport),
-            "--log-level", "warn",
-        ],
-        cwd=ROOT,
-    )
+    args = [
+        sys.executable, "-c", SPAWN,
+        "--port", str(resp_port),
+        "--addr", "127.0.0.1:0:metrics-smoke",
+        "--metrics-port", str(mport),
+        "--log-level", "warn",
+    ]
+    if lanes > 1:
+        args += ["--lanes", str(lanes), "-T", "0.5"]
+    proc = subprocess.Popen(args, cwd=ROOT, stdout=subprocess.DEVNULL)
     try:
         resp_traffic(resp_port)
         body = scrape(mport)
+        # the aggregator answers as soon as IT is up, with whatever
+        # lanes answer — re-scrape until every lane reports in (the
+        # slowest lane can still be importing jax for a while on a
+        # loaded CI host), then validate the complete surface
+        deadline = time.time() + 240
+        while lanes > 1 and time.time() < deadline and not all(
+            f'jylis_lane_up{{lane="{k}"}} 1' in body for k in range(lanes)
+        ):
+            time.sleep(2.0)
+            body = scrape(mport)
+        return body
     finally:
         proc.terminate()
         try:
@@ -119,15 +133,28 @@ def main() -> int:
             proc.kill()
             proc.wait(timeout=10)
 
-    failures = []
+
+def _check_exposition(body: str, failures: list, tag: str) -> int:
     n_samples = 0
     for line in body.splitlines():
         if not line or line.startswith("#"):
             continue
         if not SAMPLE_RE.match(line):
-            failures.append(f"  bad exposition line: {line!r}")
+            failures.append(f"  [{tag}] bad exposition line: {line!r}")
         else:
             n_samples += 1
+    return n_samples
+
+
+def main() -> int:
+    manifest = json.load(open(MANIFEST))["metrics"]
+    hists = sorted(n[5:] for n in manifest if n.startswith("hist:"))
+    gauges = sorted(n[6:] for n in manifest if n.startswith("gauge:"))
+
+    body = _boot_and_scrape(lanes=1)
+
+    failures = []
+    n_samples = _check_exposition(body, failures, "single")
     for name in hists:
         if f'seam="{name}"' not in body:
             failures.append(f"  manifest histogram absent from scrape: {name}")
@@ -148,13 +175,41 @@ def main() -> int:
         failures.append("  no dispatch-seam samples after RESP traffic")
     if "jylis_cmds_total" not in body:
         failures.append("  jylis_cmds_total family missing")
+
+    # ---- the multi-lane aggregated scrape ----------------------------------
+    lanes = int(os.environ.get("JYLIS_SMOKE_LANES", "4"))
+    lane_body = _boot_and_scrape(lanes=lanes)
+    n_lane_samples = _check_exposition(lane_body, failures, f"lanes={lanes}")
+    for k in range(lanes):
+        if f'jylis_lane_up{{lane="{k}"}} 1' not in lane_body:
+            failures.append(f"  lane {k} not up in the aggregated scrape")
+        for name in hists:
+            if f'lane="{k}",seam="{name}"' not in lane_body:
+                failures.append(
+                    f"  manifest histogram absent for lane {k}: {name}"
+                )
+        for name in gauges:
+            if f'lane="{k}",name="{name}"' not in lane_body:
+                failures.append(
+                    f"  manifest gauge absent for lane {k}: {name}"
+                )
+    # counter families must ALSO exist as lane-less aggregate sums
+    for family in ("jylis_cmds_total", "jylis_serving_total"):
+        agg = [
+            line for line in lane_body.splitlines()
+            if line.startswith(family) and 'lane="' not in line
+        ]
+        if not agg:
+            failures.append(f"  no aggregate (lane-less) {family} series")
+
     if failures:
         print("metrics-smoke FAILED:")
         print("\n".join(failures))
         return 1
     print(
         f"metrics-smoke: {n_samples} valid samples; {len(hists)} histograms"
-        f" + {len(gauges)} gauges all present"
+        f" + {len(gauges)} gauges all present; lanes={lanes} aggregate "
+        f"scrape: {n_lane_samples} samples, per-lane + aggregate series ok"
     )
     return 0
 
