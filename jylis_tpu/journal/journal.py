@@ -156,6 +156,8 @@ class Journal:
                 self._f = open(self._path, "ab")
                 self._size = os.path.getsize(self._path)
             else:
+                # jlint: lockio-ok — boot: no writer thread, no serving
+                # loop; nothing else can contend for _cv yet
                 self._open_fresh_locked()
             self._stop = False
             if self._worker is None or not self._worker.is_alive():
@@ -633,7 +635,7 @@ def list_segments(data_dir: str) -> list[str]:
     not listed here). Sorted for deterministic replay order (order is
     a formality: replay is lattice join)."""
     out = []
-    for fname in sorted(os.listdir(data_dir)):  # jlint: blocking-ok (boot)
+    for fname in sorted(os.listdir(data_dir)):
         if fname == "journal.jylis" or (
             fname.startswith("journal.lane") and fname.endswith(".jylis")
         ):

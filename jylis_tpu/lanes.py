@@ -141,7 +141,7 @@ def list_snapshots(data_dir: str) -> list[str]:
     """Every snapshot file under any lane naming, sorted — boot restores
     all of them (restore is lattice convergence; overlap is a no-op)."""
     out = []
-    for fname in sorted(os.listdir(data_dir)):  # jlint: blocking-ok (boot)
+    for fname in sorted(os.listdir(data_dir)):
         if fname == "snapshot.jylis" or (
             fname.startswith("snapshot.lane") and fname.endswith(".jylis")
         ):
@@ -234,6 +234,7 @@ class Supervisor:
             os.environ.get(LANE_FAILPOINTS_ENV, "")
         )
         self._shutdown = False
+        self._manifest_lock = asyncio.Lock()
         self.done = asyncio.Event()
 
     # ---- spawning ---------------------------------------------------------
@@ -295,18 +296,32 @@ class Supervisor:
         }
         path = os.path.join(self.config.data_dir, MANIFEST_NAME)
         tmp = path + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as f:  # jlint: blocking-ok
+        with open(tmp, "w", encoding="utf-8") as f:
             json.dump(manifest, f, indent=1)
-        os.replace(tmp, path)  # jlint: blocking-ok (supervisor, no loop I/O)
+        os.replace(tmp, path)
+
+    async def write_manifest_async(self) -> None:
+        """The supervisor-loop entry: the write-then-rename runs in a
+        worker thread. The loop this method runs on carries every
+        lane's death-watcher, signal handling, and the aggregated
+        metrics endpoint — jlint's interprocedural JL101 caught the
+        previous direct call: a contended disk during a crash-respawn
+        storm stalled all three behind the manifest write. The lock
+        restores what the on-loop call had implicitly: two lanes dying
+        near-simultaneously must not interleave writes on the one
+        fixed ``lanes.json.tmp`` path."""
+        async with self._manifest_lock:
+            await asyncio.to_thread(self.write_manifest)
 
     # ---- lifecycle --------------------------------------------------------
 
     async def run(self) -> None:
         if self.config.data_dir:
-            os.makedirs(self.config.data_dir, exist_ok=True)  # jlint: blocking-ok
+            # jlint: blocking-ok — startup, before any lane or client exists
+            os.makedirs(self.config.data_dir, exist_ok=True)
         for k in range(self.n):
             self._spawn(k)
-        self.write_manifest()
+        await self.write_manifest_async()
         loop = asyncio.get_running_loop()
         for sig in (signal.SIGINT, signal.SIGTERM):
             loop.add_signal_handler(sig, self._on_signal)
@@ -388,7 +403,7 @@ class Supervisor:
         if self._shutdown:
             return
         self._spawn(lane_id)
-        self.write_manifest()
+        await self.write_manifest_async()
 
     def _on_signal(self) -> None:
         self._shutdown = True

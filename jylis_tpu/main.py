@@ -183,6 +183,8 @@ async def run(argv: list[str] | None = None) -> None:
     # its OWN registry, so the serving registry starts clean by
     # construction — the old process-global clear() is gone with the
     # globals it cleared)
+    # jlint: blocking-ok — pre-serving boot; warmup above already built
+    # and memoised the native lib, so this resolves from cache
     database = Database(identity=identity, system_repo=system.repo)
     log = config.log
     if lane_id is not None:
@@ -193,14 +195,15 @@ async def run(argv: list[str] | None = None) -> None:
 
     snapshot_path = ""
     journal = None
-    # boot-path disk I/O below (makedirs / snapshot move-aside / journal
+    # boot-path disk I/O below (makedirs / snapshot restore / journal
     # open) runs before the server or cluster listeners exist: the loop
     # has no clients to stall, and sequencing recovery before serving is
-    # the point. jlint: blocking-ok
+    # the point — each site carries its own suppression
     if config.data_dir:
         from . import lanes as lanes_mod
 
-        os.makedirs(config.data_dir, exist_ok=True)  # jlint: blocking-ok
+        # jlint: blocking-ok — pre-serving boot, no clients on the loop
+        os.makedirs(config.data_dir, exist_ok=True)
         snapshot_path = os.path.join(
             config.data_dir, lanes_mod.snapshot_name(lane_id)
         )
@@ -209,6 +212,7 @@ async def run(argv: list[str] | None = None) -> None:
         # so overlap is a no-op and a changed --lanes never strands
         # state. Only the OWN file is moved aside when unreadable — a
         # sibling lane may be alive and writing its own.
+        # jlint: blocking-ok — pre-serving boot, no clients on the loop
         for spath in lanes_mod.list_snapshots(config.data_dir):
             try:
                 n = persist.load_snapshot(database, spath)
@@ -224,7 +228,8 @@ async def run(argv: list[str] | None = None) -> None:
                 # of un-restored data would destroy it
                 aside = spath + ".unreadable"
                 try:
-                    os.replace(spath, aside)  # jlint: blocking-ok
+                    # jlint: blocking-ok — pre-serving boot recovery
+                    os.replace(spath, aside)
                     log.err() and log.e(f"moved aside to {aside}")
                 except OSError:
                     pass

@@ -214,6 +214,8 @@ class Database:
             await mgr.flush_async(fn)
 
     def flush_deltas(self, fn) -> None:
+        # jlint: order-ok — _map is built in the fixed constructor order,
+        # identical on every replica; flush order is deterministic
         for mgr in self._map.values():
             mgr.flush_deltas(fn)
 

@@ -353,6 +353,8 @@ class RepoGCOUNT(_CounterRepo):
     def dump_state(self):
         self.drain()
         counts = gcount.to_counts(self._state)
+        # jlint: order-ok — builds a col->rid LOOKUP map (order unused);
+        # the wire encoder sorts every span by rid before any byte ships
         cols = {col: rid for rid, col in self._rids.items()}
         out = []
         for key, row in self._sorted_keys():
@@ -370,6 +372,8 @@ class RepoGCOUNT(_CounterRepo):
             self.converge(key, delta)
             # my own column is my private monotonic state: losing it would
             # make future INCs disappear under the pending max
+            # jlint: ridbranch-ok — boot-only own-column repair; the
+            # lattice value converged above is identity-independent
             if self._identity in delta:
                 self._tbl.own_max(
                     self._tbl.upsert(key), 0, delta[self._identity]
@@ -496,6 +500,8 @@ class RepoPNCOUNT(_CounterRepo):
 
     def dump_state(self):
         self.drain()
+        # jlint: order-ok — builds a col->rid LOOKUP map (order unused);
+        # the wire encoder sorts every span by rid before any byte ships
         cols = {col: rid for rid, col in self._rids.items()}
         p = planes.combine64_np(
             np.asarray(self._state.p_hi), np.asarray(self._state.p_lo)
@@ -515,7 +521,9 @@ class RepoPNCOUNT(_CounterRepo):
         for key, (dp, dn) in batch:
             self.converge(key, (dp, dn))
             row = self._tbl.upsert(key)
+            # jlint: ridbranch-ok — boot-only own-column repair (above)
             if self._identity in dp:
                 self._tbl.own_max(row, 0, dp[self._identity])
+            # jlint: ridbranch-ok — boot-only own-column repair (above)
             if self._identity in dn:
                 self._tbl.own_max(row, 1, dn[self._identity])

@@ -116,7 +116,12 @@ def _encode_counters(cdll, msg: MsgPushDeltas, ndicts: int) -> bytes | None:
             return None
         for dct in dicts:
             counts_l.append(len(dct))
+            # jlint: order-ok — spans ship in dict order on purpose (the
+            # comment above); the NATIVE encoder sorts each span by rid
+            # before emitting, byte-pinned against the sorting oracle by
+            # tests/test_native_codec.py fuzz
             rids.extend(dct.keys())
+            # jlint: order-ok — same: value order rides the rid sort
             vals.extend(dct.values())
     counts = np.asarray(counts_l, np.int64)
     rid_arr = _u64_array(rids)

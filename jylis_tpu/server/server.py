@@ -84,6 +84,9 @@ class Server:
             # this writer yet, and wait_closed would wait on it forever
             writer.close()
             return
+        # jlint: blocking-ok — lib() is memoised at boot (warmup builds
+        # an auto-engine Database before serving starts), so this never
+        # reaches the loader's listdir/compile path on the loop
         parser = make_parser()  # native scanner when built, Python fallback
         # Python-path replies buffer here and flush once per parsed batch
         # (bounded below): a reply per write() was one tiny TCP segment
@@ -203,8 +206,14 @@ class Server:
                     # native.scan_apply: a failure AT the FFI burst
                     # boundary must demote this connection to the Python
                     # oracle path (replies stay correct, at the measured
-                    # demotion cliff), never kill the connection
-                    faults.point("native.scan_apply")
+                    # demotion cliff), never kill the connection. The
+                    # ASYNC point: an injected sleep must simulate a slow
+                    # burst for THIS connection — the sync point's
+                    # time.sleep stalled the whole loop (heartbeats and
+                    # Pongs included), turning the drill into a node-wide
+                    # freeze that idle-evicts our peer connections
+                    # (caught by jlint's interprocedural JL101)
+                    await faults.async_point("native.scan_apply")
                     t0 = time.perf_counter() if self._reg.enabled else 0.0
                     rc, consumed, replies, unhandled, changed = (
                         engine.scan_apply(buf)

@@ -143,11 +143,43 @@ CLAIMS = [
 ]
 
 
+# claims whose source of truth is a committed repo record OTHER than
+# BENCH_full.json (the jlint v2 round: budget/manifest-derived numbers
+# cited in docs/development.md must track the committed artifacts):
+# (file, source json, extractor, formatter, anchor template, label)
+REPO_CLAIMS = [
+    ("docs/development.md", "scripts/jlint/budget.json",
+     lambda d: d["recorded_seconds"], lambda v: f"~{v:.1f} s",
+     "a full cold run records {}", "development doc lint recorded time"),
+    ("docs/development.md", "scripts/jlint/budget.json",
+     lambda d: d["budget_seconds"], lambda v: f"{v:.0f} s bound",
+     "against a {}", "development doc lint budget bound"),
+    ("docs/development.md", "scripts/jlint/lattice_manifest.json",
+     lambda d: len(d["merge_roots"]), str,
+     "({} merge roots)", "development doc merge-root count"),
+    ("docs/development.md", "scripts/jlint/codec_manifest.json",
+     lambda d: len(d["units"]), str,
+     "({} units:", "development doc codec unit count"),
+]
+
+
 def main() -> int:
     with open(os.path.join(ROOT, "BENCH_full.json")) as f:
         record = {row["config"]: row for row in json.load(f)}
     texts = {}
     failures = []
+    for fname, source, extract, fmt, template, label in REPO_CLAIMS:
+        if fname not in texts:
+            with open(os.path.join(ROOT, fname)) as f:
+                texts[fname] = f.read()
+        with open(os.path.join(ROOT, source)) as f:
+            value = extract(json.load(f))
+        expect = template.format(fmt(value))
+        if expect not in texts[fname]:
+            failures.append(
+                f"  {label}: {fname} lacks '{expect}' "
+                f"({source} says {value})"
+            )
     for fname, config, field, fmt, template, label in CLAIMS:
         if fname not in texts:
             with open(os.path.join(ROOT, fname)) as f:
@@ -170,8 +202,8 @@ def main() -> int:
         print("\n".join(failures))
         return 1
     print(
-        f"check-prose: {len(CLAIMS)} claims across {len(texts)} files "
-        "match BENCH_full.json"
+        f"check-prose: {len(CLAIMS)} bench claims + {len(REPO_CLAIMS)} "
+        f"repo-record claims across {len(texts)} files match their records"
     )
     return 0
 

@@ -34,16 +34,47 @@ re-record, and each gets a dedicated analysis pass:
   the committed ``scripts/jlint/metrics_manifest.json`` AND
   pre-registered in ``jylis_tpu/obs/__init__.py``; stale entries and
   dead declarations fail, so the scrapeable surface stays reviewed.
+* **Pass 6 — cross-lane shared-state discipline** (`pass_lanes`, rules
+  JL6xx): every module-level mutable in ``jylis_tpu/`` is per-LANE
+  state under ``--lanes N`` and must be declared in the committed
+  ``lanes_manifest.json`` with why per-process copies are correct.
 
-Plus one hygiene rule, JL001: ``except Exception`` / bare ``except``
-without an explicit justification, so hot-path errors can't be silently
-swallowed.
+jlint v2 adds a shared INTERPROCEDURAL core (``core.py`` +
+``graph.py``: per-project module/call graph with no-false-edge
+resolution, per-function held-locks/blocking/await summaries,
+content-hash-cached ASTs) that upgrades pass 1's JL101 to see blocking
+calls through the call graph and powers three semantic passes:
+
+* **Pass 7 — codec round-trip symmetry** (`pass_codec`, JL70x): the
+  paired encoders/decoders of all three wire/disk formats extract to
+  field-sequence tokens committed in ``codec_manifest.json``; order/
+  width/endianness drift, unconsumed fields, over-reads, and manifest
+  drift fail. The manifest drives the golden corpus
+  (``tests/golden/codec_corpus.json``, ``--write-corpus``).
+* **Pass 8 — CRDT lattice-law discipline** (`pass_lattice`, JL80x):
+  wall-clock reads reachable from merge/join/apply paths, unordered
+  iteration feeding digests/wire/flushes, delta mutation after sink
+  aliasing, replica-id branches in joins; ``lattice_manifest.json``
+  documents each obligation and GENERATES the dynamic property harness
+  (``tests/test_lattice_laws.py``: join commutativity/associativity/
+  idempotence over seeded random deltas for all five types).
+* **Pass 9 — cross-thread lock order** (`pass_locks`, JL90x): await
+  while holding a threading lock, lock-acquisition cycles over the
+  global lock graph, and blocking I/O reachable under a held lock
+  interprocedurally (the case pass 1's syntactic JL104 missed).
+
+Plus the hygiene rules: JL001 (``except Exception`` / bare ``except``
+without justification), JL002 (an inline suppression carrying no
+reason), JL003 (a stale inline suppression whose rule no longer fires
+at that site), and JL000 (stale/malformed baseline entries).
 
 Suppression works at two levels, both requiring a human-readable reason:
 
-* inline: a ``# jlint: <slug>`` comment on the flagged line or the line
-  above (slugs per rule in ``RULES``; e.g. ``# jlint: shared-ok —
-  writer-owns-file protocol``);
+* inline: a ``# jlint: <slug>`` comment on the flagged line, or
+  anywhere in the contiguous comment block directly above it (slugs
+  per rule in ``RULES``; e.g. ``# jlint: shared-ok —
+  writer-owns-file protocol``). Reason-less markers fail (JL002);
+  markers whose rule no longer fires at the site fail (JL003);
 * the committed baseline (``scripts/jlint/baseline.json``): entries of
   ``{"rule", "file", "match", "reason"}`` where ``match`` must appear in
   the flagged source line. A baseline entry that no longer matches any
@@ -51,7 +82,9 @@ Suppression works at two levels, both requiring a human-readable reason:
   code they excuse.
 
 Run ``python -m scripts.jlint`` from the repo root (what ``make lint``
-does); ``--write-manifest`` regenerates the pass-3 parity manifest.
+does, plus ``--budget --out lint_findings.json``); ``--write-manifest``
+regenerates every committed manifest and the generated lattice harness,
+``--write-corpus`` re-records the golden codec corpus.
 """
 
 from __future__ import annotations
@@ -70,6 +103,9 @@ MANIFEST_PATH = os.path.join(
 
 # rule id -> (inline suppression slug, one-line description)
 RULES = {
+    "JL000": (None, "stale or malformed baseline suppression entry"),
+    "JL002": (None, "inline `# jlint:` suppression carries no reason"),
+    "JL003": (None, "stale inline suppression: its rule no longer fires at that line"),
     "JL001": ("broad-ok", "broad `except Exception`/bare except without justification"),
     "JL101": ("blocking-ok", "known-blocking call inside `async def` without executor dispatch"),
     "JL102": ("shared-ok", "attribute mutated from both a worker thread and the event loop without a declared guard"),
@@ -87,8 +123,26 @@ RULES = {
     "JL502": (None, "metrics manifest / obs declaration stale, missing, or undescribed"),
     "JL601": ("lane-shared-ok", "module-level mutable (per-LANE state under --lanes N) not declared in lanes_manifest.json"),
     "JL602": (None, "lanes manifest entry stale, missing, or undescribed"),
-    "JL900": (None, "stale or malformed baseline suppression entry"),
+    "JL701": (None, "codec encoder/decoder field sequences diverge (order/width/endianness drift)"),
+    "JL702": (None, "codec field written but never consumed, or decoder reads past the wire shape"),
+    "JL703": (None, "codec manifest drift or missing (--write-manifest regenerates)"),
+    "JL801": ("wallclock-ok", "wall-clock read reachable from a merge/join/apply path"),
+    "JL802": ("order-ok", "unordered dict/set iteration feeding a digest, wire encoding, or flush export"),
+    "JL803": ("alias-ok", "delta/batch mutated in place after aliasing into a journal/broadcast/held sink"),
+    "JL804": ("ridbranch-ok", "replica-id-dependent branch inside a join path"),
+    "JL805": (None, "lattice manifest or generated property harness stale, missing, or undescribed"),
+    "JL901": ("awaitlock-ok", "`await` while holding a threading lock"),
+    "JL902": (None, "lock-acquisition cycle across the thread/loop seams (potential deadlock)"),
+    "JL903": ("lockio-ok", "blocking call reachable under a held lock through the call graph"),
 }
+
+# slug -> every rule that honors it (JL104/JL903 share lockio-ok; the
+# inline-staleness check JL003 treats a suppression as live when ANY of
+# its slug's rules fires at the site)
+SLUG_RULES: dict[str, set[str]] = {}
+for _rule, (_slug, _desc) in RULES.items():
+    if _slug:
+        SLUG_RULES.setdefault(_slug, set()).add(_rule)
 
 
 @dataclass
@@ -117,10 +171,15 @@ class Source:
     comments: dict[int, str] = field(default_factory=dict)  # line -> comment text
 
     @classmethod
-    def load(cls, path: str, root: str = ROOT) -> "Source":
+    def load(cls, path: str, root: str = ROOT, tree: ast.AST | None = None) -> "Source":
+        """Parse `path` (or adopt a pre-parsed `tree` — the core's
+        content-hash AST cache passes one) into a Source. ONE
+        construction path: field additions and comment-scan rules live
+        here only."""
         with open(path, encoding="utf-8") as f:
             text = f.read()
-        tree = ast.parse(text, filename=path)
+        if tree is None:
+            tree = ast.parse(text, filename=path)
         src = cls(
             path=path,
             rel=os.path.relpath(path, root),
@@ -142,13 +201,36 @@ class Source:
             return self.lines[lineno - 1].strip()
         return ""
 
+    def _is_comment_line(self, lineno: int) -> bool:
+        """True when the line holds nothing but a comment."""
+        return lineno in self.comments and self.line_src(lineno).startswith("#")
+
     def has_suppression(self, lineno: int, slug: str) -> bool:
-        """`# jlint: <slug>` on the line, or on the line above it."""
-        for ln in (lineno, lineno - 1):
-            c = self.comments.get(ln, "")
-            if "jlint:" in c and slug in c.split("jlint:", 1)[1]:
+        """`# jlint: <slug>` on the line itself, or anywhere in the
+        contiguous comment block directly above it (multi-line
+        justifications are encouraged, not penalised). The slug is
+        matched as an exact token — the same parse the JL002/JL003
+        hygiene uses — so a typo'd slug never suppresses by substring
+        while being invisible to the staleness check."""
+        if comment_slug(self.comments.get(lineno, "")) == slug:
+            return True
+        ln = lineno - 1
+        while ln >= 1 and self._is_comment_line(ln):
+            if comment_slug(self.comments.get(ln, "")) == slug:
                 return True
+            ln -= 1
         return False
+
+    def suppression_target(self, lineno: int) -> int:
+        """The code line a suppression comment at `lineno` covers: the
+        line itself when the comment rides code, else the first code
+        line below the comment block."""
+        if not self._is_comment_line(lineno):
+            return lineno
+        ln = lineno + 1
+        while ln <= len(self.lines) and self._is_comment_line(ln):
+            ln += 1
+        return ln
 
 
 def parent_map(tree: ast.AST) -> dict[ast.AST, ast.AST]:
@@ -214,7 +296,7 @@ def apply_baseline(
         if not (rule and file_ and match) or not reason.strip():
             problems.append(
                 Finding(
-                    "JL900", BASELINE_PATH_REL, i + 1,
+                    "JL000", BASELINE_PATH_REL, i + 1,
                     f"baseline entry {i} malformed or missing a reason: {entry!r}",
                 )
             )
@@ -233,7 +315,7 @@ def apply_baseline(
         if not hit:
             problems.append(
                 Finding(
-                    "JL900", BASELINE_PATH_REL, i + 1,
+                    "JL000", BASELINE_PATH_REL, i + 1,
                     f"stale baseline entry {i}: no current {rule} finding in "
                     f"{file_} matches {match!r} — delete the entry",
                 )
@@ -242,3 +324,79 @@ def apply_baseline(
 
 
 BASELINE_PATH_REL = os.path.relpath(BASELINE_PATH, ROOT)
+
+
+def comment_slug(comment: str) -> str | None:
+    """The exact `jlint: <slug>` token in a comment, or None. One
+    parser for suppression matching AND the JL002/JL003 hygiene, so a
+    slug that suppresses is always one the hygiene can see."""
+    if "jlint:" not in comment:
+        return None
+    after = comment.split("jlint:", 1)[1].strip()
+    slug = ""
+    for ch in after:
+        if ch.isalnum() or ch == "-":
+            slug += ch
+        else:
+            break
+    return slug or None
+
+
+def _suppression_sites(src: "Source"):
+    """(line, slug, reason) for every `# jlint: <slug>` comment. The
+    reason is whatever explanatory text the comment carries besides the
+    marker itself — before it (`# boot path — jlint: lockio-ok`) or
+    after it (`# jlint: shared-ok (caller holds _cv)`)."""
+    for line, comment in sorted(src.comments.items()):
+        slug = comment_slug(comment)
+        if slug is None or slug not in SLUG_RULES:
+            continue
+        before, after = comment.split("jlint:", 1)
+        after = after.strip()
+        reason = (before.lstrip("#").strip() + " " + after[len(slug):].strip()).strip()
+        yield line, slug, reason
+
+
+def check_inline_suppressions(
+    all_findings: list[Finding], sources: dict[str, "Source"]
+) -> list[Finding]:
+    """Inline-suppression hygiene (the baseline-staleness discipline
+    extended to inline sites): every `# jlint: <slug>` must carry a
+    reason (JL002), and must still have a matching finding on its line
+    or the line below (JL003 — a suppression outliving the code it
+    excused is deleted, not inherited by whatever lands there next)."""
+    # (rel, line, rule) for every PRE-suppression finding
+    fired: set[tuple[str, int, str]] = {
+        (f.rule, f.path, f.line) for f in all_findings
+    }
+    out: list[Finding] = []
+    for rel, src in sorted(sources.items()):
+        for line, slug, reason in _suppression_sites(src):
+            if len([c for c in reason if c.isalpha()]) < 4:
+                out.append(
+                    Finding(
+                        "JL002", rel, line,
+                        f"inline suppression `jlint: {slug}` carries no "
+                        "reason — say WHY the rule does not apply here "
+                        "(e.g. `# jlint: "
+                        f"{slug} — writer-owns-file protocol`)",
+                        src.line_src(line),
+                    )
+                )
+            target = src.suppression_target(line)
+            live = any(
+                (rule, rel, ln) in fired
+                for rule in SLUG_RULES[slug]
+                for ln in (line, target)
+            )
+            if not live:
+                out.append(
+                    Finding(
+                        "JL003", rel, line,
+                        f"stale inline suppression `jlint: {slug}`: no "
+                        f"{'/'.join(sorted(SLUG_RULES[slug]))} finding "
+                        "fires at this line any more — delete the comment",
+                        src.line_src(line),
+                    )
+                )
+    return out

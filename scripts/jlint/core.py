@@ -1,0 +1,499 @@
+"""The jlint semantic core: one Project, shared by every pass.
+
+Passes 1-6 each re-read and re-parsed the tree independently; the three
+semantic passes (7-9) need whole-program facts no single parse can give.
+This module is the shared substrate:
+
+* **content-hash AST cache** — each file parses once per content hash;
+  parsed trees are memoised in-process for the run AND pickled under
+  ``scripts/jlint/.cache/`` keyed by sha256(text), so an unchanged file
+  never re-parses across runs (the `make lint` time-budget rides this).
+* **function summaries** (:class:`FuncInfo`) — per function: every call
+  site with the set of locks held at it, every known-blocking primitive
+  with the locks held at it, every lock acquisition (with what was
+  already held — the lock-order edges), every ``await`` with the
+  thread-locks held across it, and whether the function is handed to a
+  thread (``threading.Thread(target=...)`` / ``asyncio.to_thread`` /
+  ``run_in_executor``).
+* **interprocedural queries** — the transitive blocking closure over
+  resolved sync call edges (pass 1's JL101 upgrade and pass 9's JL903
+  both consume it) and the global lock-acquisition graph (pass 9's
+  JL902 cycle check).
+
+Resolution discipline is graph.py's: an edge exists only when the
+receiver is certain, so interprocedural findings never rest on a
+guessed callee.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import os
+import pickle
+from dataclasses import dataclass, field
+
+from . import ROOT, Source, dotted_name, iter_py_files
+from .graph import ClassInfo, ModuleInfo, ProjectGraph
+
+CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".cache")
+
+# mirror of pass_async's blocking model (kept in one place here so the
+# intra- and inter-procedural checks can never disagree about what
+# "blocking" means)
+BLOCKING_CALLS = {
+    "time.sleep",
+    "os.fsync",
+    "os.fdatasync",
+    "os.replace",
+    "os.rename",
+    "os.remove",
+    "os.truncate",
+    "os.makedirs",
+    "os.listdir",
+    "socket.create_connection",
+    "subprocess.run",
+    "subprocess.check_output",
+    "subprocess.check_call",
+}
+BLOCKING_METHOD_NAMES = {"fsync", "fdatasync", "scan_apply"}
+JOURNAL_METHODS = {"open", "close", "flush", "rotate_begin", "rotate_commit"}
+BLOCKING_BUILTINS = {"open"}
+
+LOCKISH = ("lock", "_cv", "cond", "mutex")
+
+
+def is_lockish(expr_src: str) -> bool:
+    low = expr_src.lower()
+    return any(tok in low for tok in LOCKISH)
+
+
+def blocking_call_name(call: ast.Call) -> str | None:
+    """The pass-1 blocking model, shared verbatim."""
+    name = dotted_name(call.func)
+    if name in BLOCKING_CALLS or name in BLOCKING_BUILTINS:
+        return name
+    if isinstance(call.func, ast.Attribute):
+        meth = call.func.attr
+        if meth in BLOCKING_METHOD_NAMES:
+            return name or meth
+        recv = dotted_name(call.func.value).lower()
+        if meth in JOURNAL_METHODS and "journal" in recv:
+            return name or meth
+    return None
+
+
+@dataclass
+class CallSite:
+    raw: str  # dotted source form, for messages
+    targets: tuple[str, ...]  # resolved qualnames ((): opaque)
+    lineno: int
+    locks: tuple[str, ...]  # thread locks held (sync `with`)
+    alocks: tuple[str, ...]  # asyncio locks held (`async with`)
+
+
+@dataclass
+class FuncInfo:
+    qual: str  # "rel::Class.method" or "rel::func"
+    rel: str
+    cls: str | None
+    name: str
+    node: ast.AST
+    is_async: bool
+    lineno: int
+    calls: list[CallSite] = field(default_factory=list)
+    # (blocking-name, lineno, thread locks held at the call)
+    blocking: list[tuple[str, int, tuple[str, ...]]] = field(default_factory=list)
+    # (lock, lineno, locks already held, acquired-via-async-with)
+    acquires: list[tuple[str, int, tuple[str, ...], bool]] = field(
+        default_factory=list
+    )
+    # (lineno, thread locks held across the await)
+    awaits: list[tuple[int, tuple[str, ...]]] = field(default_factory=list)
+    # names this function dispatches to threads (resolved qualnames)
+    thread_dispatch: list[str] = field(default_factory=list)
+
+
+def _sha(text: str) -> str:
+    # the interpreter version rides the key: pickled ast nodes from one
+    # Python unpickle under another as subtly-wrong objects (missing
+    # fields like FunctionDef.type_params) that crash far from here
+    import sys
+
+    tag = f"{sys.version_info.major}.{sys.version_info.minor}:"
+    return hashlib.sha256((tag + text).encode()).hexdigest()
+
+
+_MEM_CACHE: dict[str, ast.AST] = {}
+
+
+def parse_cached(text: str, path: str) -> ast.AST:
+    """Parse with the two-level content-hash cache (memory, then disk)."""
+    key = _sha(text)
+    tree = _MEM_CACHE.get(key)
+    if tree is not None:
+        return tree
+    cache_path = os.path.join(CACHE_DIR, key[:2], key + ".ast")
+    try:
+        with open(cache_path, "rb") as f:
+            tree = pickle.load(f)
+    except (OSError, pickle.PickleError, EOFError, AttributeError):
+        tree = None
+    if tree is None:
+        tree = ast.parse(text, filename=path)
+        try:
+            os.makedirs(os.path.dirname(cache_path), exist_ok=True)
+            tmp = cache_path + f".tmp{os.getpid()}"
+            with open(tmp, "wb") as f:
+                pickle.dump(tree, f)
+            os.replace(tmp, cache_path)
+            _prune_cache()
+        except OSError:
+            pass  # cache is best-effort; lint correctness never depends on it
+    _MEM_CACHE[key] = tree
+    return tree
+
+
+_CACHE_MAX_ENTRIES = 1024
+
+
+def _prune_cache(max_entries: int = _CACHE_MAX_ENTRIES) -> None:
+    """Bound the on-disk cache: it is keyed by content hash, so every
+    file version ever linted would otherwise accumulate forever on a
+    long-lived checkout. Oldest-by-mtime entries go first; runs only on
+    a cache write (rare once warm)."""
+    entries = []
+    for dirpath, _dirs, files in os.walk(CACHE_DIR):
+        for f in files:
+            if f.endswith(".ast"):
+                p = os.path.join(dirpath, f)
+                try:
+                    entries.append((os.path.getmtime(p), p))
+                except OSError:
+                    pass
+    if len(entries) <= max_entries:
+        return
+    entries.sort()
+    for _mtime, p in entries[: len(entries) - max_entries]:
+        try:
+            os.remove(p)
+        except OSError:
+            pass
+
+
+def load_source(path: str, root: str = ROOT) -> Source:
+    """Source.load through the content-hash AST cache. A file that no
+    longer parses is a clean one-line diagnostic + exit 2, never a
+    traceback (the pre-core CLI promised the same)."""
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    try:
+        tree = parse_cached(text, path)
+    except SyntaxError as e:
+        import sys
+
+        print(f"jlint: cannot parse {path}: {e}", file=sys.stderr)
+        raise SystemExit(2) from None
+    return Source.load(path, root, tree=tree)
+
+
+class Project:
+    """All sources in scope + the call graph + per-function summaries."""
+
+    def __init__(self, sources: list[Source]):
+        self.sources = sources
+        self.by_rel: dict[str, Source] = {s.rel: s for s in sources}
+        self.graph = ProjectGraph(sources)
+        self.functions: dict[str, FuncInfo] = {}
+        self._thread_roots: set[str] | None = None
+        self._blocking_closure: dict[str, tuple[str, ...]] | None = None
+        for src in sources:
+            self._summarise(src)
+
+    @classmethod
+    def load(cls, root: str = ROOT, subdirs: tuple[str, ...] = ("jylis_tpu", "scripts")) -> "Project":
+        out = []
+        for path in iter_py_files(root, subdirs):
+            out.append(load_source(path, root))
+        return cls(out)
+
+    # ---- summaries ---------------------------------------------------------
+
+    def _summarise(self, src: Source) -> None:
+        mi = self.graph.by_rel[src.rel]
+        for node in src.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._summarise_func(src, mi, None, node)
+            elif isinstance(node, ast.ClassDef):
+                ci = mi.classes.get(node.name)
+                for m in node.body:
+                    if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        self._summarise_func(src, mi, ci, m)
+
+    @staticmethod
+    def _nested_defs(fn: ast.AST) -> dict[str, ast.AST]:
+        """Function defs nested directly inside `fn`'s body (one level —
+        deeper nesting summarises recursively from there)."""
+        out: dict[str, ast.AST] = {}
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out[node.name] = node
+                continue  # its own nested defs belong to IT
+            if isinstance(node, ast.Lambda):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+        return out
+
+    def _lock_name(
+        self, item: ast.withitem, mi: ModuleInfo, ci: ClassInfo | None,
+        local_types: dict[str, str],
+    ) -> str | None:
+        expr = item.context_expr
+        # unwrap lock.acquire-style helpers: `with self._cv:` is the idiom
+        src_txt = ast.unparse(expr)
+        if not is_lockish(src_txt):
+            return None
+        parts = dotted_name(expr).split(".")
+        if parts and parts[0] in ("self", "cls") and ci is not None and len(parts) >= 2:
+            # normalise per-class: Journal._cv — instance identity is not
+            # statically visible; same-class self-edges are ignored by the
+            # cycle check for exactly that reason
+            return f"{ci.name}.{parts[-1]}"
+        if parts and parts[0] in local_types and len(parts) == 2:
+            return f"{local_types[parts[0]]}.{parts[1]}"
+        if len(parts) == 1 and parts[0]:
+            return f"{mi.rel}::{parts[0]}"
+        # untyped receiver (mgr._lock where mgr's class is unknown): keep
+        # the attribute tail so held-ness still registers
+        return f"?.{parts[-1]}" if parts else f"?.{src_txt}"
+
+    def _summarise_func(
+        self,
+        src: Source,
+        mi: ModuleInfo,
+        ci: ClassInfo | None,
+        fn: ast.AST,
+        parent_qual: str | None = None,
+    ) -> None:
+        if parent_qual is not None:
+            qual = f"{parent_qual}.<locals>.{fn.name}"
+        else:
+            qual = (
+                f"{src.rel}::{ci.name}.{fn.name}" if ci is not None
+                else f"{src.rel}::{fn.name}"
+            )
+        # nested defs summarise on their own quals, and bare-name calls
+        # to them from THIS body resolve locally — blocking I/O hidden
+        # in a local helper must not escape the interprocedural checks
+        nested = self._nested_defs(fn)
+        local_funcs = {
+            name: f"{qual}.<locals>.{name}" for name in nested
+        }
+        info = FuncInfo(
+            qual=qual,
+            rel=src.rel,
+            cls=ci.name if ci is not None else None,
+            name=fn.name,
+            node=fn,
+            is_async=isinstance(fn, ast.AsyncFunctionDef),
+            lineno=fn.lineno,
+        )
+        # local constructor types: x = ClassName(...)
+        local_types: dict[str, str] = {}
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+            ):
+                cname = dotted_name(node.value.func).split(".")[-1]
+                if cname[:1].isupper():
+                    local_types[node.targets[0].id] = cname
+
+        def visit(node: ast.AST, locks: tuple[str, ...], alocks: tuple[str, ...]):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                return  # nested defs are summarised on their own
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                is_async_with = isinstance(node, ast.AsyncWith)
+                new_locks, new_alocks = locks, alocks
+                for item in node.items:
+                    lname = self._lock_name(item, mi, ci, local_types)
+                    if lname is not None:
+                        info.acquires.append(
+                            (lname, node.lineno, locks + alocks, is_async_with)
+                        )
+                        if is_async_with:
+                            new_alocks = new_alocks + (lname,)
+                        else:
+                            new_locks = new_locks + (lname,)
+                    # the with-item expression itself may contain calls
+                    visit(item.context_expr, locks, alocks)
+                for stmt in node.body:
+                    visit(stmt, new_locks, new_alocks)
+                return
+            if isinstance(node, ast.Await):
+                info.awaits.append((node.lineno, locks))
+            if isinstance(node, ast.Call):
+                self._record_call(
+                    info, node, mi, ci, local_types, locks, alocks, local_funcs
+                )
+            for child in ast.iter_child_nodes(node):
+                visit(child, locks, alocks)
+
+        for stmt in fn.body:
+            visit(stmt, (), ())
+        self.functions[qual] = info
+        for sub in nested.values():
+            self._summarise_func(src, mi, ci, sub, parent_qual=qual)
+
+    def _record_call(
+        self,
+        info: FuncInfo,
+        call: ast.Call,
+        mi: ModuleInfo,
+        ci: ClassInfo | None,
+        local_types: dict[str, str],
+        locks: tuple[str, ...],
+        alocks: tuple[str, ...],
+        local_funcs: dict[str, str] | None = None,
+    ) -> None:
+        raw = dotted_name(call.func)
+        bname = blocking_call_name(call)
+        if bname:
+            info.blocking.append((bname, call.lineno, locks))
+        # a bare name naming a NESTED def wins over any module symbol:
+        # that is what the call binds to at runtime
+        if (
+            local_funcs
+            and isinstance(call.func, ast.Name)
+            and call.func.id in local_funcs
+        ):
+            targets: tuple[str, ...] = (local_funcs[call.func.id],)
+        else:
+            targets = self.graph.resolve_call(call.func, mi, ci, local_types)
+        info.calls.append(
+            CallSite(
+                raw=raw, targets=targets, lineno=call.lineno,
+                locks=locks, alocks=alocks,
+            )
+        )
+        # thread dispatch: the CALLABLE argument runs on a thread
+        cands: list[ast.AST] = []
+        if raw.endswith("Thread"):
+            cands += [kw.value for kw in call.keywords if kw.arg == "target"]
+        elif raw.endswith("to_thread"):
+            cands += call.args[:1]
+        elif raw.endswith("run_in_executor"):
+            cands += call.args[1:2]
+        for c in cands:
+            for t in self.graph.resolve_call(c, mi, ci, local_types):
+                info.thread_dispatch.append(t)
+
+    # ---- interprocedural queries ------------------------------------------
+
+    def thread_roots(self) -> set[str]:
+        """Every function dispatched to a thread anywhere in the project."""
+        if self._thread_roots is None:
+            roots: set[str] = set()
+            for fi in self.functions.values():
+                roots.update(fi.thread_dispatch)
+            self._thread_roots = roots
+        return self._thread_roots
+
+    def blocking_closure(self) -> dict[str, tuple[str, ...]]:
+        """qual -> a witness call chain to a blocking primitive, for every
+        SYNC function whose transitive sync callees block. The chain is
+        ('callee-qual', ..., 'blocking-name'), shortest-first discovery
+        order; async callees are excluded (they are analysed on their
+        own and awaiting them does not block the loop)."""
+        if self._blocking_closure is not None:
+            return self._blocking_closure
+        closure: dict[str, tuple[str, ...]] = {}
+        # seed: direct blockers
+        for q, fi in self.functions.items():
+            if fi.is_async:
+                continue
+            if fi.blocking:
+                closure[q] = (fi.blocking[0][0],)
+        changed = True
+        while changed:
+            changed = False
+            for q, fi in self.functions.items():
+                if fi.is_async or q in closure:
+                    continue
+                for site in fi.calls:
+                    for t in site.targets:
+                        callee = self.functions.get(t)
+                        if callee is None or callee.is_async:
+                            continue
+                        if t in closure:
+                            closure[q] = (t,) + closure[t]
+                            changed = True
+                            break
+                    if q in closure:
+                        break
+        self._blocking_closure = closure
+        return closure
+
+    def lock_edges(self) -> dict[tuple[str, str], tuple[str, int, str]]:
+        """(held, acquired) -> one witness (rel, line, via-description)
+        over the whole project, including acquisitions that happen inside
+        callees entered with a lock held (one call level deep per
+        iteration, to a fixpoint over call-chain summaries)."""
+        # per-function: locks it may acquire (directly or transitively),
+        # as {lock: witness}
+        acq: dict[str, dict[str, tuple[str, int, str]]] = {}
+        for q, fi in self.functions.items():
+            own = {}
+            for lname, line, _held, _is_async in fi.acquires:
+                own.setdefault(lname, (fi.rel, line, f"in {q}"))
+            acq[q] = own
+        changed = True
+        while changed:
+            changed = False
+            for q, fi in self.functions.items():
+                mine = acq[q]
+                for site in fi.calls:
+                    for t in site.targets:
+                        for lname, wit in acq.get(t, {}).items():
+                            if lname not in mine:
+                                mine[lname] = (
+                                    fi.rel, site.lineno,
+                                    f"via {site.raw} -> {wit[2]}",
+                                )
+                                changed = True
+        # '?.<attr>' identities (untyped receivers) stay OUT of the
+        # edge set: the wildcard merges every same-named attribute lock
+        # across unrelated classes into one node, which would fabricate
+        # cycle edges between locks that can never be the same object —
+        # the exact false-edge class this module's resolution discipline
+        # forbids. (They still count as HELD for the JL903 blocking
+        # analysis, where over-approximation is conservative.)
+        def concrete(name: str) -> bool:
+            return not name.startswith("?.")
+
+        edges: dict[tuple[str, str], tuple[str, int, str]] = {}
+        for q, fi in self.functions.items():
+            # direct: acquire B while holding A in one function
+            for lname, line, held, _is_async in fi.acquires:
+                for h in held:
+                    if h != lname and concrete(h) and concrete(lname):
+                        edges.setdefault((h, lname), (fi.rel, line, f"in {q}"))
+            # interprocedural: call with A held, callee acquires B
+            for site in fi.calls:
+                held = site.locks + site.alocks
+                if not held:
+                    continue
+                for t in site.targets:
+                    for lname, wit in acq.get(t, {}).items():
+                        for h in held:
+                            if h != lname and concrete(h) and concrete(lname):
+                                edges.setdefault(
+                                    (h, lname),
+                                    (fi.rel, site.lineno,
+                                     f"{site.raw} acquires {lname} ({wit[2]})"),
+                                )
+        return edges

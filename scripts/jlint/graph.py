@@ -1,0 +1,271 @@
+"""Module / call graph for the jlint semantic core (scripts/jlint/core.py).
+
+Passes 1-6 were six independent token-level walks: each saw one function
+at a time, so anything that crossed a call boundary — blocking I/O two
+frames below an ``async def``, a lock acquired inside a callee while the
+caller already holds another — was invisible (JL104's journal-rotation
+stall was exactly that shape). This module builds the project-wide view
+those checks need:
+
+* **module graph**: every source file under the analysis scope becomes a
+  :class:`ModuleInfo` with its import table resolved *within the
+  project* (``from ..cluster import codec`` → ``jylis_tpu/cluster/
+  codec.py``). Imports that leave the project (stdlib, jax, numpy)
+  resolve to nothing — the analyses treat them as opaque.
+* **symbol tables**: per module, the classes (with base-class names and
+  an attribute-type map inferred from ``self.x = ClassName(...)``
+  assignments) and module-level functions.
+* **call resolution**: a best-effort, *no-false-edge* discipline. A call
+  is resolved only when the receiver is certain: ``self.m()`` /
+  ``cls.m()`` (searching project base classes), a module-level or
+  imported function, ``module.func()`` through the import table, or
+  ``obj.m()`` where ``obj`` is a local/attribute whose type was pinned
+  by a direct constructor assignment. Everything else yields no edge —
+  the consumers (blocking closure, lock graph) prefer missing an edge
+  to inventing one.
+
+The graph is rebuilt per run from the content-hash-cached ASTs
+(core.py); at repo scale this is milliseconds.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+
+from . import Source, dotted_name
+
+
+def rel_to_module(rel: str) -> str:
+    """'jylis_tpu/cluster/codec.py' -> 'jylis_tpu.cluster.codec'."""
+    mod = rel[:-3] if rel.endswith(".py") else rel
+    mod = mod.replace(os.sep, ".").replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    rel: str
+    bases: list[str] = field(default_factory=list)  # names as written
+    methods: dict[str, ast.AST] = field(default_factory=dict)
+    # self.<attr> = <ClassName>(...) constructor assignments: attr ->
+    # class name as written (resolved lazily through the import table)
+    attr_types: dict[str, str] = field(default_factory=dict)
+
+    def qual(self, method: str) -> str:
+        return f"{self.rel}::{self.name}.{method}"
+
+
+@dataclass
+class ModuleInfo:
+    rel: str
+    modname: str
+    # import table: local alias -> project module name ('codec' ->
+    # 'jylis_tpu.cluster.codec'); only project-internal targets kept
+    imports: dict[str, str] = field(default_factory=dict)
+    # from-import table: local name -> (project module name, symbol)
+    from_imports: dict[str, tuple[str, str]] = field(default_factory=dict)
+    functions: dict[str, ast.AST] = field(default_factory=dict)
+    classes: dict[str, ClassInfo] = field(default_factory=dict)
+
+
+class ProjectGraph:
+    """Module + symbol tables over a set of Sources, with call resolution."""
+
+    def __init__(self, sources: list[Source]):
+        self.modules: dict[str, ModuleInfo] = {}  # modname -> info
+        self.by_rel: dict[str, ModuleInfo] = {}
+        for src in sources:
+            mi = self._index_module(src)
+            self.modules[mi.modname] = mi
+            self.by_rel[mi.rel] = mi
+        # class name -> [ClassInfo] (cross-module base-class lookup)
+        self.classes_by_name: dict[str, list[ClassInfo]] = {}
+        for mi in self.modules.values():
+            for ci in mi.classes.values():
+                self.classes_by_name.setdefault(ci.name, []).append(ci)
+
+    # ---- indexing ----------------------------------------------------------
+
+    def _index_module(self, src: Source) -> ModuleInfo:
+        mi = ModuleInfo(rel=src.rel, modname=rel_to_module(src.rel))
+        pkg_parts = mi.modname.split(".")[:-1]
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    name = alias.asname or alias.name.split(".")[0]
+                    target = alias.name if alias.asname else alias.name.split(".")[0]
+                    mi.imports[name] = target
+            elif isinstance(node, ast.ImportFrom):
+                base = self._resolve_from(node, pkg_parts)
+                if base is None:
+                    continue
+                for alias in node.names:
+                    name = alias.asname or alias.name
+                    # `from pkg import mod` can import a MODULE: prefer
+                    # that reading when pkg.mod exists in the project
+                    submod = f"{base}.{alias.name}"
+                    if self._project_has(submod):
+                        mi.imports[name] = submod
+                    else:
+                        mi.from_imports[name] = (base, alias.name)
+        for node in src.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                mi.functions[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                ci = ClassInfo(name=node.name, rel=src.rel)
+                for b in node.bases:
+                    nm = dotted_name(b)
+                    if nm:
+                        ci.bases.append(nm.split(".")[-1])
+                for m in node.body:
+                    if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        ci.methods[m.name] = m
+                        self._scan_attr_types(m, ci)
+                mi.classes[node.name] = ci
+        return mi
+
+    def _scan_attr_types(self, m: ast.AST, ci: ClassInfo) -> None:
+        """self.<attr> = ClassName(...) pins the attribute's type (the
+        alias-tracking seed: `self._journal.close()` then resolves into
+        Journal.close)."""
+        for node in ast.walk(m):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                continue
+            t = node.targets[0]
+            if not (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+            ):
+                continue
+            v = node.value
+            if isinstance(v, ast.Call):
+                cname = dotted_name(v.func).split(".")[-1]
+                if cname and cname[0].isupper():
+                    ci.attr_types[t.attr] = cname
+
+    def _resolve_from(self, node: ast.ImportFrom, pkg_parts: list[str]) -> str | None:
+        if node.level == 0:
+            return node.module
+        # relative import: climb `level` packages from this module's package
+        if node.level > len(pkg_parts):
+            return None
+        base_parts = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+        if node.module:
+            base_parts = base_parts + node.module.split(".")
+        return ".".join(base_parts)
+
+    def _project_has(self, modname: str) -> bool:
+        return modname in self.modules
+
+    # ---- call resolution ---------------------------------------------------
+
+    def resolve_class(self, name: str, mi: ModuleInfo) -> ClassInfo | None:
+        """A class NAME as visible from module `mi` (local, from-import,
+        unique-in-project fallback for base classes)."""
+        if name in mi.classes:
+            return mi.classes[name]
+        fi = mi.from_imports.get(name)
+        if fi is not None:
+            target = self.modules.get(fi[0])
+            if target is not None and fi[1] in target.classes:
+                return target.classes[fi[1]]
+        cands = self.classes_by_name.get(name, [])
+        if len(cands) == 1:
+            return cands[0]
+        return None
+
+    def method_in_hierarchy(
+        self, ci: ClassInfo, method: str, _seen: frozenset = frozenset()
+    ) -> str | None:
+        """Qualname of `method` on `ci` or its project base classes."""
+        if ci.name in _seen:
+            return None
+        if method in ci.methods:
+            return ci.qual(method)
+        mi = self.by_rel.get(ci.rel)
+        for base in ci.bases:
+            bci = self.resolve_class(base, mi) if mi is not None else None
+            if bci is not None:
+                q = self.method_in_hierarchy(
+                    bci, method, _seen | {ci.name}
+                )
+                if q is not None:
+                    return q
+        return None
+
+    def resolve_call(
+        self,
+        func: ast.AST,
+        mi: ModuleInfo,
+        cls: ClassInfo | None,
+        local_types: dict[str, str],
+    ) -> tuple[str, ...]:
+        """Resolved callee qualname(s) for a call expression, or () when
+        the receiver cannot be pinned (no false edges)."""
+        # bare name: local function / from-import / class constructor
+        if isinstance(func, ast.Name):
+            name = func.id
+            if name in mi.functions:
+                return (f"{mi.rel}::{name}",)
+            fi = mi.from_imports.get(name)
+            if fi is not None:
+                target = self.modules.get(fi[0])
+                if target is not None:
+                    if fi[1] in target.functions:
+                        return (f"{target.rel}::{fi[1]}",)
+                    if fi[1] in target.classes:
+                        tci = target.classes[fi[1]]
+                        if "__init__" in tci.methods:
+                            return (tci.qual("__init__"),)
+                        return ()
+            ci = self.resolve_class(name, mi)
+            if ci is not None and name[:1].isupper() and "__init__" in ci.methods:
+                return (ci.qual("__init__"),)
+            return ()
+        if not isinstance(func, ast.Attribute):
+            return ()
+        parts = dotted_name(func).split(".")
+        if len(parts) < 2:
+            return ()
+        head, meth = parts[0], parts[-1]
+        # self.m() / cls.m() — also self.attr.m() via attr_types
+        if head in ("self", "cls") and cls is not None:
+            if len(parts) == 2:
+                q = self.method_in_hierarchy(cls, meth)
+                return (q,) if q is not None else ()
+            if len(parts) == 3:
+                tname = cls.attr_types.get(parts[1])
+                if tname is not None:
+                    tci = self.resolve_class(tname, mi)
+                    if tci is not None:
+                        q = self.method_in_hierarchy(tci, meth)
+                        return (q,) if q is not None else ()
+            return ()
+        # module alias: codec.encode() / journal.replay_journal()
+        if head in mi.imports and len(parts) == 2:
+            target = self.modules.get(mi.imports[head])
+            if target is not None and meth in target.functions:
+                return (f"{target.rel}::{meth}",)
+            if target is not None and meth in target.classes:
+                tci = target.classes[meth]
+                if "__init__" in tci.methods:
+                    return (tci.qual("__init__"),)
+            return ()
+        # local variable with a constructor-pinned type: j = Journal(...)
+        if head in local_types and len(parts) == 2:
+            tci = self.resolve_class(local_types[head], mi)
+            if tci is not None:
+                q = self.method_in_hierarchy(tci, meth)
+                return (q,) if q is not None else ()
+        # ClassName.method (direct, e.g. for staticmethod-style calls)
+        ci = self.resolve_class(head, mi)
+        if ci is not None and head[:1].isupper() and len(parts) == 2:
+            q = self.method_in_hierarchy(ci, meth)
+            return (q,) if q is not None else ()
+        return ()

@@ -35,30 +35,20 @@ import ast
 
 from . import Finding, Source, dotted_name, parent_map
 
-# fully-dotted calls that block the calling thread
-BLOCKING_CALLS = {
-    "time.sleep",
-    "os.fsync",
-    "os.fdatasync",
-    "os.replace",
-    "os.rename",
-    "os.remove",
-    "os.truncate",
-    "os.makedirs",
-    "socket.create_connection",
-    "subprocess.run",
-    "subprocess.check_output",
-    "subprocess.check_call",
-}
-# method names that block regardless of receiver
-BLOCKING_METHOD_NAMES = {"fsync", "fdatasync", "scan_apply"}
-# method names that block when the receiver looks like the journal (its
-# lifecycle methods join the writer thread and/or fsync)
-JOURNAL_METHODS = {"open", "close", "flush", "rotate_begin", "rotate_commit"}
-# builtins that block (open hits the filesystem)
-BLOCKING_BUILTINS = {"open"}
+# ONE blocking model, owned by the semantic core so the syntactic and
+# interprocedural JL101 can never disagree about what "blocking" means
+# (they HAD diverged when this was a local copy: os.listdir was known
+# only to the core, so inlining a flagged helper hid the finding)
+from .core import (  # noqa: F401  (re-exported for fixtures/tests)
+    BLOCKING_BUILTINS,
+    BLOCKING_CALLS,
+    BLOCKING_METHOD_NAMES,
+    JOURNAL_METHODS,
+    LOCKISH,
+    blocking_call_name as _blocking_call_name,
+    is_lockish as _is_lockish,
+)
 
-LOCKISH = ("lock", "_cv", "cond", "mutex")
 # disk-touching calls that must not run under a held thread lock
 LOCK_IO_CALLS = {
     "os.fsync",
@@ -69,11 +59,6 @@ LOCK_IO_CALLS = {
     "os.truncate",
 }
 LOCK_IO_METHOD_NAMES = {"fsync", "fdatasync"}
-
-
-def _is_lockish(expr_src: str) -> bool:
-    low = expr_src.lower()
-    return any(tok in low for tok in LOCKISH)
 
 
 def _self_attr(node: ast.AST) -> str | None:
@@ -107,22 +92,6 @@ def _under_lock_with(node: ast.AST, parents) -> bool:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             return False
     return False
-
-
-def _blocking_call_name(call: ast.Call) -> str | None:
-    name = dotted_name(call.func)
-    if name in BLOCKING_CALLS:
-        return name
-    if name in BLOCKING_BUILTINS:
-        return name
-    if isinstance(call.func, ast.Attribute):
-        meth = call.func.attr
-        if meth in BLOCKING_METHOD_NAMES:
-            return name or meth
-        recv = dotted_name(call.func.value).lower()
-        if meth in JOURNAL_METHODS and "journal" in recv:
-            return name or meth
-    return None
 
 
 # ---- JL101: blocking calls inside async def ---------------------------------
@@ -398,4 +367,40 @@ def run(sources: list[Source]) -> list[Finding]:
         _check_rmw_across_await(src, out)
         _check_lock_io(src, out)
         _check_broad_except(src, out)
+    return out
+
+
+def run_interprocedural(project) -> list[Finding]:
+    """JL101 beyond the enclosing function (the jlint-v2 upgrade): a
+    call inside an ``async def`` whose resolved SYNC callee transitively
+    reaches a blocking primitive stalls the loop just like a direct
+    ``os.fsync`` — the syntactic walk above cannot see it (JL104's
+    journal-rotation stall was the same shape one domain over). Uses the
+    core's no-false-edge call graph, so every finding names the chain."""
+    closure = project.blocking_closure()
+    out: list[Finding] = []
+    for fi in project.functions.values():
+        if not fi.is_async:
+            continue
+        src = project.by_rel.get(fi.rel)
+        direct_lines = {line for _n, line, _l in fi.blocking}
+        for site in fi.calls:
+            if site.lineno in direct_lines:
+                continue  # the syntactic JL101 already owns this line
+            for t in site.targets:
+                chain = closure.get(t)
+                callee = project.functions.get(t)
+                if chain is None or callee is None or callee.is_async:
+                    continue
+                out.append(
+                    Finding(
+                        "JL101", fi.rel, site.lineno,
+                        f"call `{site.raw}` inside `async def {fi.name}` "
+                        f"reaches blocking `{chain[-1]}` via "
+                        f"{' -> '.join(chain)} — the event loop stalls "
+                        "for its duration; dispatch via asyncio.to_thread",
+                        src.line_src(site.lineno) if src is not None else "",
+                    )
+                )
+                break
     return out

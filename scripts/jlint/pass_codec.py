@@ -1,0 +1,915 @@
+"""Pass 7 — codec round-trip symmetry (rules JL701/JL702/JL703).
+
+Three wire/disk formats carry every byte this system persists or
+gossips: the cluster transport (cluster/codec.py + framing.py +
+cluster.py's CRC/origin wire frame), the delta journal
+(journal/journal.py), and snapshots (persist.py). Their encoders and
+decoders are separate functions whose field order, widths, and
+endianness must agree EXACTLY — and until this pass, nothing checked
+that statically: an encoder gaining a field whose decoder was not
+updated ships as silent corruption detected only when a peer (or a
+reboot) reads the bytes. Schema v7 (digest-driven delta sync) and the
+native RESP port will both edit these functions; this pass is the rail
+they get built under.
+
+Mechanics — two extraction grades:
+
+* **token units** (the cluster codec's message and per-type delta
+  shapes): a symbolic evaluator walks the paired encode/decode function
+  bodies in Python evaluation order and emits the primitive field
+  sequence — ``varint`` / ``bytes`` / ``str`` / ``u8:<tag-const>`` /
+  struct widths (``u32be``, ``u64be``…) — expanding helper calls
+  (``_w_addr`` ↔ ``_r_addr``, ``read_ujson``) and folding loops and
+  comprehensions into ``rep[...]`` groups. Encoder and decoder
+  sequences must be identical: a mismatch is JL701 (order / width /
+  endianness drift); one side being a strict prefix of the other is
+  JL702 (encoder writes a field no decoder consumes, or a decoder
+  reads past what the encoder produced).
+* **atom units** (framing header, cluster wire frame, journal file,
+  snapshot file): the writer and reader are scanned for an ordered
+  first-touch sequence over a per-unit vocabulary (struct formats,
+  ``MAGIC``, ``delta_signature``, framing, crc, body codec); the
+  reader's atom set must cover the writer's exactly (JL702), with the
+  loader's legacy-signature acceptance recorded as a flag, not a field.
+
+Everything extracted is committed to ``scripts/jlint/
+codec_manifest.json`` keyed by the schema version (plus the legacy
+snapshot-signature versions the loader still accepts); any drift
+between the committed manifest and the extracted truth fails (JL703 —
+``--write-manifest`` regenerates, the git diff is the review surface).
+The manifest also drives the golden round-trip corpus
+(``tests/golden/codec_corpus.json``, regenerated via
+``--write-corpus``): the corpus records the manifest's sha256, so a
+schema edit that regenerates the manifest without re-recording the
+corpus fails in tier-1.
+
+The native codec wrapper (native/codec.py) is pinned at a coarser
+grade: the FFI call argument order per type (the flattened field
+layout the C++ side consumes) is recorded in the manifest, so a layout
+change is a reviewed manifest diff; byte-level equivalence with the
+oracle stays with the existing differential fuzz
+(tests/test_native_codec.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+
+from . import Finding, ROOT, dotted_name
+
+CODEC_MANIFEST_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "codec_manifest.json"
+)
+
+CODEC_REL = os.path.join("jylis_tpu", "cluster", "codec.py")
+FRAMING_REL = os.path.join("jylis_tpu", "cluster", "framing.py")
+CLUSTER_REL = os.path.join("jylis_tpu", "cluster", "cluster.py")
+JOURNAL_REL = os.path.join("jylis_tpu", "journal", "journal.py")
+PERSIST_REL = os.path.join("jylis_tpu", "persist.py")
+UJSON_WIRE_REL = os.path.join("jylis_tpu", "ops", "ujson_wire.py")
+NATIVE_CODEC_REL = os.path.join("jylis_tpu", "native", "codec.py")
+
+# message tag constant <-> unit name (both sides must use the constant)
+TAG_UNITS = {
+    "_TAG_PONG": "Pong",
+    "_TAG_EXCHANGE": "ExchangeAddrs",
+    "_TAG_ANNOUNCE": "AnnounceAddrs",
+    "_TAG_PUSH": "PushDeltas",
+    "_TAG_SYNC_REQ": "SyncRequest",
+    "_TAG_SYNC_DONE": "SyncDone",
+}
+
+DELTA_TYPES = ("TREG", "TLOG", "SYSTEM", "GCOUNT", "PNCOUNT", "UJSON")
+
+_STRUCT_TOKENS = {"B": "u8", "H": "u16", "I": "u32", "Q": "u64", "i": "i32", "q": "i64"}
+
+
+class ExtractError(Exception):
+    """The codec idiom this extractor understands was not found — fail
+    loudly so a refactor cannot silently skate past the symmetry check."""
+
+
+def _parse(rel: str, root: str = ROOT) -> ast.Module:
+    from .core import parse_cached
+
+    path = os.path.join(root, rel)
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    return parse_cached(text, path)
+
+
+def _functions(tree: ast.Module) -> dict[str, ast.AST]:
+    return {
+        n.name: n
+        for n in tree.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+
+
+# one dotted-name walker for the whole package (scripts/jlint/__init__)
+_dotted = dotted_name
+
+
+def _struct_tokens(fmt: str) -> list:
+    """'>BQ' -> ['u8', 'u64be']; endianness rides the token so a '<'
+    flip is drift, not noise."""
+    if not fmt:
+        return []
+    endian = ""
+    chars = fmt
+    if fmt[0] in "<>!=@":
+        endian = {"<": "le", ">": "be", "!": "be"}.get(fmt[0], "")
+        chars = fmt[1:]
+    out = []
+    for ch in chars:
+        base = _STRUCT_TOKENS.get(ch)
+        if base is None:
+            raise ExtractError(f"unhandled struct format char {ch!r} in {fmt!r}")
+        out.append(base + endian if base not in ("u8",) else base)
+    return out
+
+
+# ---- the token-unit symbolic evaluator -------------------------------------
+
+
+class _Emitter:
+    """Walks a function body in Python evaluation order, emitting wire
+    field tokens. `helpers` maps expandable helper names to their defs
+    (cross-module: cluster/codec.py + ops/ujson_wire.py)."""
+
+    def __init__(self, helpers: dict[str, ast.AST]):
+        self.helpers = helpers
+        self._stack: list[str] = []
+
+    # -- entry points
+
+    def sequence(self, fn: ast.AST) -> list:
+        out: list = []
+        for stmt in fn.body:
+            out.extend(self.stmt(stmt))
+        return out
+
+    def expand(self, name: str) -> list:
+        if name in self._stack:
+            raise ExtractError(f"recursive helper expansion: {name}")
+        fn = self.helpers.get(name)
+        if fn is None:
+            raise ExtractError(f"unknown codec helper: {name}")
+        self._stack.append(name)
+        try:
+            return self.sequence(fn)
+        finally:
+            self._stack.pop()
+
+    # -- statements
+
+    def stmt(self, node: ast.AST) -> list:
+        if isinstance(node, ast.Expr):
+            return self.expr(node.value)
+        if isinstance(node, ast.Assign):
+            return self.expr(node.value) + sum(
+                (self.expr(t) for t in node.targets), []
+            )
+        if isinstance(node, ast.AugAssign):
+            return self.expr(node.value)
+        if isinstance(node, ast.AnnAssign):
+            return self.expr(node.value) if node.value is not None else []
+        if isinstance(node, ast.Return):
+            return self.expr(node.value) if node.value is not None else []
+        if isinstance(node, ast.For):
+            body = []
+            for s in node.body:
+                body.extend(self.stmt(s))
+            # a loop over a LITERAL tuple/list runs a known number of
+            # times: unroll (the p2set writer iterates (adds, removes))
+            if isinstance(node.iter, (ast.Tuple, ast.List)):
+                return self.expr(node.iter) + body * len(node.iter.elts)
+            head = self.expr(node.iter)
+            return head + ([["rep", body]] if body else [])
+        if isinstance(node, ast.If):
+            test = self.expr(node.test)
+            then = []
+            for s in node.body:
+                then.extend(self.stmt(s))
+            other = []
+            for s in node.orelse:
+                other.extend(self.stmt(s))
+            if then or other:
+                raise ExtractError(
+                    f"conditional field at line {node.lineno}: branch-"
+                    "dependent wire shapes need a dispatch unit, not an "
+                    "inline if"
+                )
+            return test
+        if isinstance(node, ast.While):
+            raise ExtractError(f"while-loop in codec body at line {node.lineno}")
+        if isinstance(node, ast.Raise):
+            return []
+        if isinstance(node, (ast.Pass, ast.Break, ast.Continue, ast.Global)):
+            return []
+        if isinstance(node, ast.Try):
+            out = []
+            for s in node.body:
+                out.extend(self.stmt(s))
+            return out
+        if isinstance(node, ast.With):
+            out = []
+            for s in node.body:
+                out.extend(self.stmt(s))
+            return out
+        raise ExtractError(
+            f"unhandled statement {type(node).__name__} at line {node.lineno}"
+        )
+
+    # -- expressions (evaluation order)
+
+    def expr(self, node: ast.AST) -> list:
+        if node is None or isinstance(node, (ast.Constant, ast.Name)):
+            return []
+        if isinstance(node, ast.Call):
+            return self.call(node)
+        if isinstance(node, ast.Attribute):
+            return self.expr(node.value)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return sum((self.expr(e) for e in node.elts), [])
+        if isinstance(node, ast.Dict):
+            out = []
+            for k, v in zip(node.keys, node.values):
+                out.extend(self.expr(k) if k is not None else [])
+                out.extend(self.expr(v))
+            return out
+        if isinstance(node, ast.BinOp):
+            return self.expr(node.left) + self.expr(node.right)
+        if isinstance(node, ast.BoolOp):
+            return sum((self.expr(v) for v in node.values), [])
+        if isinstance(node, ast.UnaryOp):
+            return self.expr(node.operand)
+        if isinstance(node, ast.Compare):
+            return self.expr(node.left) + sum(
+                (self.expr(c) for c in node.comparators), []
+            )
+        if isinstance(node, ast.Subscript):
+            return self.expr(node.value) + self.expr(node.slice)
+        if isinstance(node, ast.Slice):
+            out = []
+            for part in (node.lower, node.upper, node.step):
+                if part is not None:
+                    out.extend(self.expr(part))
+            return out
+        if isinstance(node, (ast.GeneratorExp, ast.ListComp, ast.SetComp)):
+            head, body = self._comp_parts(node.generators)
+            body.extend(self.expr(node.elt))
+            return head + ([["rep", body]] if body else [])
+        if isinstance(node, ast.DictComp):
+            head, body = self._comp_parts(node.generators)
+            body.extend(self.expr(node.key))
+            body.extend(self.expr(node.value))
+            return head + ([["rep", body]] if body else [])
+        if isinstance(node, ast.IfExp):
+            return self.expr(node.test) + self.expr(node.body) + self.expr(
+                node.orelse
+            )
+        if isinstance(node, ast.Starred):
+            return self.expr(node.value)
+        if isinstance(node, ast.JoinedStr):
+            return []
+        raise ExtractError(
+            f"unhandled expression {type(node).__name__} at line "
+            f"{getattr(node, 'lineno', '?')}"
+        )
+
+    def _comp_parts(self, generators) -> tuple[list, list]:
+        if len(generators) != 1:
+            raise ExtractError("nested comprehension in codec body")
+        gen = generators[0]
+        head = self.expr(gen.iter)
+        body: list = []
+        for cond in gen.ifs:
+            body.extend(self.expr(cond))
+        return head, body
+
+    def call(self, node: ast.Call) -> list:
+        name = _dotted(node.func)
+        tail = name.split(".")[-1]
+        # writer primitives
+        if tail == "_w_varint":
+            return self._args_tokens(node, skip=2) + ["varint"]
+        if tail == "_w_bytes":
+            return self._args_tokens(node, skip=2) + ["bytes"]
+        if tail == "_w_str":
+            return self._args_tokens(node, skip=2) + ["str"]
+        if name.endswith("out.append"):
+            arg = node.args[0] if node.args else None
+            if isinstance(arg, ast.Name):
+                return [f"u8:{arg.id}"]
+            return ["u8"]
+        if name.endswith("out.extend"):
+            return self._args_tokens(node) + ["raw"]
+        # reader primitives (receiver named r)
+        if name == "r.varint":
+            return ["varint"]
+        if name == "r.bytes_":
+            return ["bytes"]
+        if name == "r.str_":
+            return ["str"]
+        # struct widths
+        if tail in ("pack", "unpack", "unpack_from") and name.startswith("struct."):
+            fmt = node.args[0]
+            if not (isinstance(fmt, ast.Constant) and isinstance(fmt.value, str)):
+                raise ExtractError(f"non-literal struct format at {node.lineno}")
+            return _struct_tokens(fmt.value) + sum(
+                (self.expr(a) for a in node.args[1:]), []
+            )
+        # the per-type delta dispatchers are their own units: emit one
+        # abstract token here so msg/PushDeltas stays comparable
+        if tail in ("_w_delta", "_r_delta"):
+            return ["delta"]
+        # expandable helpers (cluster codec writers/readers + read_ujson)
+        if tail.startswith(("_w_", "_r_")) or tail == "read_ujson":
+            args = self._args_tokens(node)
+            return args + self.expand(tail)
+        # anything else: a value-level call — walk args for nested reads
+        return self._args_tokens(node)
+
+    def _args_tokens(self, node: ast.Call, skip: int = 0) -> list:
+        out = []
+        for a in node.args[skip:]:
+            out.extend(self.expr(a))
+        for kw in node.keywords:
+            out.extend(self.expr(kw.value))
+        return out
+
+
+def _branch_key_encode(test: ast.AST) -> list[str]:
+    """isinstance(msg, MsgPong) -> ['Pong']."""
+    if (
+        isinstance(test, ast.Call)
+        and _dotted(test.func) == "isinstance"
+        and len(test.args) == 2
+    ):
+        cname = _dotted(test.args[1])
+        if cname.startswith("Msg"):
+            return [cname[3:]]
+    return []
+
+
+def _branch_key_tag(test: ast.AST) -> list[str]:
+    """tag == _TAG_PONG -> ['Pong']."""
+    if isinstance(test, ast.Compare) and len(test.comparators) == 1:
+        for side in (test.left, test.comparators[0]):
+            name = _dotted(side)
+            if name in TAG_UNITS:
+                return [name]
+    return []
+
+
+def _branch_key_name(test: ast.AST) -> list[str]:
+    """name == "TREG" / name in ("TLOG", "SYSTEM") -> the type keys."""
+    if isinstance(test, ast.Compare) and len(test.comparators) == 1:
+        comp = test.comparators[0]
+        keys = []
+        cands = comp.elts if isinstance(comp, (ast.Tuple, ast.List, ast.Set)) else [comp]
+        for c in cands:
+            if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                keys.append(c.value)
+        return [k for k in keys if k in DELTA_TYPES]
+    return []
+
+
+def _dispatch_branches(fn: ast.AST, keyer) -> dict[str, list[ast.stmt]]:
+    """Split a dispatcher function into {branch key: body statements}
+    from its top-level if/elif chain (both the statement-chain and the
+    early-return styles)."""
+    out: dict[str, list[ast.stmt]] = {}
+
+    def eat(node: ast.If):
+        keys = keyer(node.test)
+        for k in keys:
+            out[k] = node.body
+        for e in node.orelse:
+            if isinstance(e, ast.If):
+                eat(e)
+
+    for stmt in fn.body:
+        if isinstance(stmt, ast.If):
+            eat(stmt)
+    return out
+
+
+def extract_message_units(
+    codec_tree: ast.Module | None = None, wire_tree: ast.Module | None = None
+) -> dict[str, dict[str, list]]:
+    """{unit: {encode: seq, decode: seq}} for the six cluster messages
+    and the per-type delta payloads."""
+    codec_tree = codec_tree if codec_tree is not None else _parse(CODEC_REL)
+    wire_tree = wire_tree if wire_tree is not None else _parse(UJSON_WIRE_REL)
+    fns = _functions(codec_tree)
+    helpers = dict(fns)
+    helpers.update(_functions(wire_tree))
+    em = _Emitter(helpers)
+
+    units: dict[str, dict[str, list]] = {}
+    enc = _dispatch_branches(fns["_encode_oracle"], _branch_key_encode)
+    dec = _dispatch_branches(fns["_decode_oracle"], _branch_key_tag)
+    # remap decode branch keys (_TAG_X) to unit names, prefixing the tag
+    # byte the shared `tag = body[0]` read consumed
+    dec_by_unit = {}
+    for tag_const, unit in TAG_UNITS.items():
+        body = dec.get(tag_const)
+        if body is None:
+            raise ExtractError(f"no decode branch for {tag_const}")
+        seq = []
+        for s in body:
+            seq.extend(em.stmt(s))
+        dec_by_unit[unit] = [f"u8:{tag_const}"] + seq
+    for unit in TAG_UNITS.values():
+        if unit not in enc:
+            raise ExtractError(f"no encode branch for Msg{unit}")
+        seq = []
+        for s in enc[unit]:
+            seq.extend(em.stmt(s))
+        units[f"msg/{unit}"] = {
+            "encode": seq, "decode": dec_by_unit[unit]
+        }
+
+    enc_d = _dispatch_branches(fns["_w_delta"], _branch_key_name)
+    dec_d = _dispatch_branches(fns["_r_delta"], _branch_key_name)
+    for t in DELTA_TYPES:
+        if t not in enc_d or t not in dec_d:
+            raise ExtractError(f"no delta branch for {t}")
+        e, d = [], []
+        for s in enc_d[t]:
+            e.extend(em.stmt(s))
+        for s in dec_d[t]:
+            d.extend(em.stmt(s))
+        units[f"delta/{t}"] = {"encode": e, "decode": d}
+    return units
+
+
+# ---- atom units ------------------------------------------------------------
+
+# canonical atom vocabulary: dotted-name tail -> atom
+_ATOM_CALLS = {
+    "delta_signature": "delta_signature",
+    "legacy_snapshot_signatures": "legacy_accepted",
+    "frame": "framing",
+    "FrameReader": "framing",
+    "build_header": "framing",
+    "parse_header": "framing",
+    "encode": "body",
+    "decode": "body",
+    "crc32": "crc",
+}
+_ATOM_NAMES = {"MAGIC": "MAGIC", "HEADER_LEN": "", "header": ""}
+
+
+def _atoms(fn: ast.AST) -> list[str]:
+    """First-touch-ordered canonical atoms in one function (pre-order:
+    a call's atom lands before its arguments')."""
+    seen: list[str] = []
+
+    def touch(a: str):
+        if a and a not in seen:
+            seen.append(a)
+
+    def walk(node: ast.AST):
+        if isinstance(node, ast.Call):
+            name = _dotted(node.func)
+            tail = name.split(".")[-1]
+            if tail in ("pack", "unpack", "unpack_from") and name.startswith(
+                "struct."
+            ):
+                fmt = node.args[0]
+                if isinstance(fmt, ast.Constant) and isinstance(fmt.value, str):
+                    for tok in _struct_tokens(fmt.value):
+                        touch(tok)
+            elif tail in _ATOM_CALLS:
+                touch(_ATOM_CALLS[tail])
+        elif isinstance(node, ast.Name) and node.id in _ATOM_NAMES:
+            touch(_ATOM_NAMES[node.id])
+        for child in ast.iter_child_nodes(node):
+            walk(child)
+
+    walk(fn)
+    return seen
+
+
+def _class_method(tree: ast.Module, cls: str, method: str) -> ast.AST:
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == cls:
+            for m in node.body:
+                if (
+                    isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and m.name == method
+                ):
+                    return m
+    raise ExtractError(f"{cls}.{method} not found")
+
+
+def extract_atom_units(root: str = ROOT) -> dict[str, dict]:
+    framing = _functions(_parse(FRAMING_REL, root))
+    cluster = _functions(_parse(CLUSTER_REL, root))
+    journal_tree = _parse(JOURNAL_REL, root)
+    journal = _functions(journal_tree)
+    persist = _functions(_parse(PERSIST_REL, root))
+
+    units: dict[str, dict] = {}
+    units["frame/header"] = {
+        "grade": "atoms",
+        "encode": _atoms(framing["build_header"]),
+        "decode": _atoms(framing["parse_header"]),
+    }
+    # the writer-side framing header is added by frame() here; the
+    # reader side's FrameReader lives in the cluster read loop, one
+    # function out — ignore the framing atom rather than invent an edge
+    units["frame/wire"] = {
+        "grade": "atoms",
+        "ignore": ["framing"],
+        "encode": _atoms(cluster["wire_frame"]),
+        "decode": _atoms(cluster["check_frame"]),
+    }
+    # journal: header written by _open_fresh_file, frames by _run;
+    # read_journal consumes both
+    writer = _atoms(_class_method(journal_tree, "Journal", "_open_fresh_file"))
+    for a in _atoms(_class_method(journal_tree, "Journal", "_run")):
+        if a not in writer:
+            writer.append(a)
+    units["file/journal"] = {
+        "grade": "atoms",
+        "encode": writer,
+        "decode": _atoms(journal["read_journal"]),
+    }
+    loader = _atoms(persist["load_snapshot"])
+    units["file/snapshot"] = {
+        "grade": "atoms",
+        "encode": _atoms(persist["write_snapshot"]),
+        "decode": [a for a in loader if a != "legacy_accepted"],
+        "accepts_legacy": "legacy_accepted" in loader,
+    }
+    return units
+
+
+# ---- schema identity + native pins -----------------------------------------
+
+
+def _module_const(tree: ast.Module, name: str):
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == name
+        ):
+            return node.value
+    return None
+
+
+def _eval_schema_text(tree: ast.Module) -> tuple[int, str]:
+    """SCHEMA_VERSION plus the rendered _SCHEMA_TEXT (its only
+    interpolation is SCHEMA_VERSION itself)."""
+    vnode = _module_const(tree, "SCHEMA_VERSION")
+    if not (isinstance(vnode, ast.Constant) and isinstance(vnode.value, int)):
+        raise ExtractError("SCHEMA_VERSION not a literal int")
+    version = vnode.value
+    tnode = _module_const(tree, "_SCHEMA_TEXT")
+    if isinstance(tnode, ast.Constant) and isinstance(tnode.value, str):
+        return version, tnode.value
+    if isinstance(tnode, ast.JoinedStr):
+        parts = []
+        for v in tnode.values:
+            if isinstance(v, ast.Constant):
+                parts.append(str(v.value))
+            elif (
+                isinstance(v, ast.FormattedValue)
+                and isinstance(v.value, ast.Name)
+                and v.value.id == "SCHEMA_VERSION"
+            ):
+                parts.append(str(version))
+            else:
+                raise ExtractError("_SCHEMA_TEXT interpolates more than the version")
+        return version, "".join(parts)
+    raise ExtractError("_SCHEMA_TEXT not found")
+
+
+def _legacy_versions(tree: ast.Module) -> list[int]:
+    out = []
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id.startswith("_LEGACY_V")
+            and node.targets[0].id.endswith("_TEXT")
+        ):
+            try:
+                out.append(int(node.targets[0].id[len("_LEGACY_V"):-len("_TEXT")]))
+            except ValueError:
+                pass
+    return sorted(out)
+
+
+def extract_native_pins(root: str = ROOT) -> dict[str, dict]:
+    """Per-type FFI argument layout of native/codec.py: the order of the
+    flattened field buffers each _encode_*/_decode_* hands to C++."""
+    tree = _parse(NATIVE_CODEC_REL, root)
+    pins: dict[str, dict] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        if not node.name.startswith(("_encode_", "_decode_")):
+            continue
+        for call in ast.walk(node):
+            if not isinstance(call, ast.Call):
+                continue
+            name = _dotted(call.func)
+            if not name.startswith("cdll.jy_"):
+                continue
+            args = [ast.unparse(a) for a in call.args]
+            pins[node.name] = {"ffi": name.split(".", 1)[1], "args": args}
+            break
+    return pins
+
+
+# ---- manifest --------------------------------------------------------------
+
+
+def build_manifest(root: str = ROOT) -> dict:
+    codec_tree = _parse(CODEC_REL, root)
+    version, schema_text = _eval_schema_text(codec_tree)
+    units = extract_message_units(codec_tree, _parse(UJSON_WIRE_REL, root))
+    units.update(extract_atom_units(root))
+    return {
+        "_comment": (
+            "Generated by `python -m scripts.jlint --write-manifest` from "
+            "the paired encoder/decoder sources (cluster/codec.py + "
+            "framing.py + cluster.py wire frame, journal/journal.py, "
+            "persist.py, native/codec.py FFI layout, ops/ujson_wire.py) — "
+            "do not edit by hand. `make lint` fails on encoder/decoder "
+            "field-sequence asymmetry (JL701/JL702) and on any drift "
+            "between this file and the extracted truth (JL703). The "
+            "golden corpus (tests/golden/codec_corpus.json) pins this "
+            "file's sha256; regenerate it with --write-corpus after any "
+            "manifest change."
+        ),
+        "schema_version": version,
+        "schema_sha256": hashlib.sha256(schema_text.encode()).hexdigest(),
+        "legacy_snapshot_versions": _legacy_versions(codec_tree),
+        "units": {k: units[k] for k in sorted(units)},
+        "native": extract_native_pins(root),
+    }
+
+
+def write_manifest(path: str = CODEC_MANIFEST_PATH) -> dict:
+    manifest = build_manifest()
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return manifest
+
+
+def manifest_sha(path: str = CODEC_MANIFEST_PATH) -> str:
+    with open(path, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+def _flat(seq: list) -> list[str]:
+    out = []
+    for item in seq:
+        if isinstance(item, list) and item and item[0] == "rep":
+            out.append("rep[")
+            out.extend(_flat(item[1]))
+            out.append("]")
+        else:
+            out.append(str(item))
+    return out
+
+
+def unit_findings(units: dict[str, dict]) -> list[Finding]:
+    """JL701/JL702 symmetry findings over extracted units (split out so
+    the classification is pinnable on fixtures)."""
+    out: list[Finding] = []
+    for unit, entry in units.items():
+        enc, dec = _flat(entry["encode"]), _flat(entry["decode"])
+        if entry.get("grade") == "atoms":
+            # multi-function writer/reader pairs: first-touch ORDER is a
+            # construction artifact (a payload is encoded before it is
+            # framed; a reader parses the frame first) — the invariant is
+            # that both sides touch exactly the same atoms
+            ignore = set(entry.get("ignore", ()))
+            missing = (set(enc) - set(dec)) - ignore
+            extra = (set(dec) - set(enc)) - ignore
+            for atom, side in ((missing, "reader"), (extra, "writer")):
+                if atom:
+                    out.append(
+                        Finding(
+                            "JL702", CODEC_REL, 1,
+                            f"`{unit}`: the {side} never touches "
+                            f"{sorted(atom)} — a written field no reader "
+                            "consumes (or a reader expecting bytes the "
+                            "writer never produces)",
+                            unit,
+                        )
+                    )
+            continue
+        if enc == dec:
+            continue
+        n = min(len(enc), len(dec))
+        if enc[:n] == dec[:n]:
+            longer, shorter = ("encoder", "decoder") if len(enc) > len(dec) else (
+                "decoder", "encoder"
+            )
+            extra = (enc if len(enc) > len(dec) else dec)[n:]
+            out.append(
+                Finding(
+                    "JL702", CODEC_REL, 1,
+                    f"`{unit}`: the {longer} handles trailing field(s) "
+                    f"{extra} the {shorter} never touches — an encoded "
+                    "field no decoder consumes (or a decoder reading "
+                    "past the wire shape)",
+                    unit,
+                )
+            )
+        else:
+            i = next(
+                (k for k in range(n) if enc[k] != dec[k]), n
+            )
+            out.append(
+                Finding(
+                    "JL701", CODEC_REL, 1,
+                    f"`{unit}`: encoder/decoder field sequences diverge at "
+                    f"position {i}: encode={enc[max(0, i - 2): i + 3]} vs "
+                    f"decode={dec[max(0, i - 2): i + 3]} — order/width/"
+                    "endianness drift",
+                    unit,
+                )
+            )
+    return out
+
+
+def check(
+    manifest_path: str = CODEC_MANIFEST_PATH, root: str = ROOT
+) -> list[Finding]:
+    out: list[Finding] = []
+    rel = os.path.relpath(manifest_path, ROOT)
+    try:
+        current = build_manifest(root)
+    except ExtractError as e:
+        out.append(
+            Finding(
+                "JL701", CODEC_REL, 1,
+                f"codec extraction failed — the encoder/decoder idiom "
+                f"drifted outside what pass 7 can prove symmetric: {e}",
+                "",
+            )
+        )
+        return out
+    out += unit_findings(current["units"])
+
+    if not os.path.exists(manifest_path):
+        out.append(
+            Finding(
+                "JL703", rel, 1,
+                "codec manifest missing — run `python -m scripts.jlint "
+                "--write-manifest` and commit it",
+                "",
+            )
+        )
+        return out
+    with open(manifest_path, encoding="utf-8") as f:
+        committed = json.load(f)
+    for key in (
+        "schema_version", "schema_sha256", "legacy_snapshot_versions",
+        "units", "native",
+    ):
+        if committed.get(key) != current[key]:
+            out.append(
+                Finding(
+                    "JL703", rel, 1,
+                    f"codec manifest drift in `{key}` — the committed "
+                    "manifest no longer matches the extracted "
+                    "encoder/decoder truth; run `python -m scripts.jlint "
+                    "--write-manifest`, review the diff, commit (and "
+                    "re-record the golden corpus with --write-corpus)",
+                    key,
+                )
+            )
+    return out
+
+
+# ---- golden corpus ---------------------------------------------------------
+
+CORPUS_PATH = os.path.join(ROOT, "tests", "golden", "codec_corpus.json")
+
+
+def build_corpus() -> dict:
+    """Deterministic golden bytes for every unit and every live schema
+    version. Imports the product (jax-free modules only at import time
+    for the codec path) — corpus generation and the tier-1 test pay
+    that, `make lint` never does."""
+    import sys
+
+    if ROOT not in sys.path:
+        sys.path.insert(0, ROOT)
+    from jylis_tpu.cluster import codec
+    from jylis_tpu.cluster.framing import frame
+    from jylis_tpu.cluster.msg import (
+        MsgAnnounceAddrs,
+        MsgExchangeAddrs,
+        MsgPong,
+        MsgPushDeltas,
+        MsgSyncDone,
+        MsgSyncRequest,
+    )
+    from jylis_tpu.ops.p2set import P2Set
+    from jylis_tpu.ops.ujson_host import UJSON
+    from jylis_tpu.utils.address import Address
+    import struct
+    import zlib
+
+    def ujson_delta() -> UJSON:
+        u = UJSON()
+        u.entries[(1, 1)] = (("a", "b"), '"x"')
+        u.entries[(2, 5)] = (("a",), "42")
+        u.ctx.vv = {1: 1, 2: 5}
+        u.ctx.cloud = {(3, 9)}
+        return u
+
+    p2 = P2Set()
+    p2.adds = {Address("h1", "6001", "n1"), Address("h2", "6002", "n2")}
+    p2.removes = {Address("h3", "6003", "n3")}
+
+    messages = {
+        "msg/Pong": MsgPong(),
+        "msg/SyncDone": MsgSyncDone(),
+        "msg/ExchangeAddrs": MsgExchangeAddrs(p2),
+        "msg/AnnounceAddrs": MsgAnnounceAddrs(p2),
+        "msg/SyncRequest": MsgSyncRequest((b"\x01" * 32, b"\x02" * 32)),
+        "delta/TREG": MsgPushDeltas("TREG", ((b"k1", (b"v1", 7)),)),
+        "delta/TLOG": MsgPushDeltas(
+            "TLOG", ((b"k1", ([(b"e2", 9), (b"e1", 3)], 2)),)
+        ),
+        "delta/SYSTEM": MsgPushDeltas(
+            "SYSTEM", ((b"_log", ([(b"boot", 11)], 0)),)
+        ),
+        "delta/GCOUNT": MsgPushDeltas("GCOUNT", ((b"k1", {1: 10, 2: 20}),)),
+        "delta/PNCOUNT": MsgPushDeltas(
+            "PNCOUNT", ((b"k1", ({1: 10}, {2: 4})),)
+        ),
+        "delta/UJSON": MsgPushDeltas("UJSON", ((b"k1", ujson_delta()),)),
+    }
+    entries: dict[str, dict] = {}
+    for name, msg in sorted(messages.items()):
+        body = codec._encode_oracle(msg)
+        entries[name] = {"hex": body.hex()}
+
+    # frame/wire: CRC+origin transport frame at a FIXED origin stamp
+    from jylis_tpu.cluster.cluster import wire_frame
+
+    body = codec._encode_oracle(MsgPong())
+    entries["frame/wire"] = {
+        "hex": wire_frame(body, origin_ms=1234567890123).hex(),
+        "origin_ms": 1234567890123,
+    }
+    # file/journal: header + two CRC frames (one per type family)
+    payload1 = codec._encode_oracle(messages["delta/GCOUNT"])
+    payload2 = codec._encode_oracle(messages["delta/TREG"])
+    journal_blob = b"JYLJRNL1" + codec.delta_signature()
+    for p in (payload1, payload2):
+        journal_blob += frame(struct.pack(">I", zlib.crc32(p)) + p)
+    entries["file/journal"] = {"hex": journal_blob.hex()}
+    # file/snapshot: header + one frame per data type (wire-delta dump)
+    snap_blob = b"JYLSNAP1" + codec.delta_signature()
+    for name in ("TREG", "TLOG", "GCOUNT", "PNCOUNT", "UJSON", "SYSTEM"):
+        key = "delta/" + name
+        snap_blob += frame(codec._encode_oracle(messages[key]))
+    entries["file/snapshot"] = {"hex": snap_blob.hex()}
+
+    return {
+        "_comment": (
+            "Golden codec corpus, generated by `python -m scripts.jlint "
+            "--write-corpus` — do not edit by hand. "
+            "tests/test_codec_corpus.py round-trips every entry through "
+            "the oracle codec and (where present) the native fast path, "
+            "and pins manifest_sha256 against "
+            "scripts/jlint/codec_manifest.json: a schema/manifest edit "
+            "without a corpus re-record fails in tier-1."
+        ),
+        "manifest_sha256": manifest_sha(),
+        "delta_signature": codec.delta_signature().hex(),
+        "legacy_snapshot_signatures": [
+            s.hex() for s in codec.legacy_snapshot_signatures()
+        ],
+        "entries": entries,
+    }
+
+
+def write_corpus(path: str = CORPUS_PATH) -> dict:
+    corpus = build_corpus()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(corpus, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return corpus

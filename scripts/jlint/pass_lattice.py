@@ -1,0 +1,670 @@
+"""Pass 8 — CRDT lattice-law discipline (rules JL801-JL805).
+
+Convergence and digest-matching rest on four properties of every merge
+path that no test suite can prove and one stray line can break: joins
+must be commutative, associative, idempotent, and free of wall-clock or
+iteration-order dependence. This pass holds the STATIC half of that
+contract over ``jylis_tpu/models/`` and ``jylis_tpu/ops/`` (+ the wire
+encoders that feed digests), using the interprocedural core:
+
+* **JL801** — a wall-clock read (``time.time`` / ``time.time_ns`` /
+  ``now_ms`` / ``datetime.now``) reachable from any merge/join/apply
+  path (call-graph closure over the core's resolved edges). Timestamps
+  in the lattice come from CLIENTS (or the one documented SYSTEM
+  minting site); a join that reads the clock diverges replicas.
+  Suppress with ``# jlint: wallclock-ok — <why>`` at the root.
+* **JL802** — unordered ``dict``/``set`` iteration
+  (``.items()/.keys()/.values()``) feeding a digest canon, a wire
+  encoding, or a flush, without ``sorted()`` (or another
+  order-insensitive consumer: ``sum``/``min``/``max``/``len``/
+  ``set``/``any``/``all``). Two converged replicas with different
+  insertion histories iterate differently; bytes derived from that
+  iteration diverge. Suppress with ``# jlint: order-ok — <why>`` (e.g.
+  the native encoder sorts on the wire).
+* **JL803** — in-place mutation of a batch/delta object AFTER it
+  aliased into a sink (``journal.append``, ``broadcast_deltas``, a held
+  queue): the sink's consumer sees the mutated object — flush output
+  must be export-then-freeze. Intraprocedural dataflow: a name passed
+  to a sink is poisoned for the rest of the function; any mutating
+  method/subscript-store on it fires. Suppress ``# jlint: alias-ok``.
+* **JL804** — a replica-id-dependent branch inside a join path: two
+  replicas joining identical states must take identical branches, or
+  the lattice is not a lattice. The deliberate own-column repairs in
+  ``load_state`` carry ``# jlint: ridbranch-ok — <why>``.
+* **JL805** — lattice manifest / property-harness drift (the dynamic
+  half): ``scripts/jlint/lattice_manifest.json`` records each rule's
+  obligation, the extracted merge-root inventory, and the five types'
+  harness bindings; ``tests/test_lattice_laws.py`` is GENERATED from
+  that manifest (``--write-manifest`` regenerates both) and runs the
+  three join laws over seeded random delta pairs per type in tier-1 —
+  the static rules and the dynamic laws pin each other.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+
+from . import Finding, ROOT, dotted_name
+
+LATTICE_MANIFEST_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "lattice_manifest.json"
+)
+HARNESS_PATH = os.path.join(ROOT, "tests", "test_lattice_laws.py")
+
+SCOPE_PREFIXES = (
+    os.path.join("jylis_tpu", "models"),
+    os.path.join("jylis_tpu", "ops"),
+)
+
+# function names that constitute a merge/join/apply path root
+MERGE_ROOT_NAMES = ("join", "fold_in", "load_state", "apply")
+MERGE_ROOT_PREFIXES = ("converge", "join", "merge")
+
+WALL_CLOCK = {
+    "time.time", "time.time_ns", "now_ms", "_now_ms",
+    "datetime.now", "datetime.utcnow", "datetime.datetime.now",
+}
+
+# functions whose output becomes digest canon / wire bytes / flush export
+ORDER_SENSITIVE_FUNCS = ("sync_canon", "dump_state", "flush_deltas")
+ORDER_SAFE_WRAPPERS = {
+    "sorted", "sum", "min", "max", "len", "set", "frozenset", "any", "all",
+}
+
+SINK_RECEIVERS = ("journal", "held")
+SINK_FUNCS = ("broadcast_deltas",)
+MUTATORS = {
+    "append", "appendleft", "extend", "insert", "remove", "pop", "popleft",
+    "clear", "update", "setdefault", "add", "discard", "sort", "reverse",
+}
+
+RID_MARKERS = ("identity", "replica_id", "_rid")
+
+PLACEHOLDER = "(describe this obligation)"
+
+# the static obligations, one per rule — preserved across regeneration
+DEFAULT_RULES = {
+    "JL801": (
+        "No wall-clock read may be reachable from a merge/join/apply "
+        "path: lattice timestamps come from clients (or the one "
+        "documented SYSTEM minting site); a join that reads the clock "
+        "diverges replicas."
+    ),
+    "JL802": (
+        "No unordered dict/set iteration may feed a digest canon, a "
+        "wire encoding, or a flush export without sorted() — converged "
+        "replicas iterate in different orders and the derived bytes "
+        "diverge."
+    ),
+    "JL803": (
+        "A delta/batch object that aliased into a sink (journal append, "
+        "broadcast, held queue) is frozen: later in-place mutation "
+        "reaches the sink's consumer — export-then-freeze."
+    ),
+    "JL804": (
+        "No replica-id-dependent branch inside a join path: two "
+        "replicas joining identical states must take identical "
+        "branches (own-column boot repairs in load_state are the "
+        "reviewed exception)."
+    ),
+}
+
+# the five types' dynamic-law harness bindings: lattice import path,
+# canonical-form recipe, and generator name (rendered into
+# tests/test_lattice_laws.py by write_harness)
+HARNESS_TYPES = {
+    "TREG": {"lattice": "jylis_tpu.ops.hostref:TReg", "gen": "gen_treg"},
+    "TLOG": {"lattice": "jylis_tpu.ops.hostref:TLog", "gen": "gen_tlog"},
+    "GCOUNT": {"lattice": "jylis_tpu.ops.hostref:GCounter", "gen": "gen_gcount"},
+    "PNCOUNT": {"lattice": "jylis_tpu.ops.hostref:PNCounter", "gen": "gen_pncount"},
+    "UJSON": {"lattice": "jylis_tpu.ops.ujson_host:UJSON", "gen": "gen_ujson"},
+}
+
+
+def _in_scope(rel: str) -> bool:
+    return rel.startswith(SCOPE_PREFIXES)
+
+
+def _is_merge_root(name: str) -> bool:
+    return name in MERGE_ROOT_NAMES or name.startswith(MERGE_ROOT_PREFIXES)
+
+
+def merge_roots(project) -> list:
+    """Every merge/join/apply entry point in models/ and ops/."""
+    return sorted(
+        (fi for fi in project.functions.values()
+         if _in_scope(fi.rel) and _is_merge_root(fi.name)),
+        key=lambda fi: fi.qual,
+    )
+
+
+# ---- JL801: wall-clock reachability ----------------------------------------
+
+
+def _clock_closure(project) -> dict[str, tuple[str, ...]]:
+    """qual -> witness chain to a wall-clock read, transitively over
+    resolved call edges (async and sync alike: a clocked coroutine in a
+    join path is just as divergent)."""
+    closure: dict[str, tuple[str, ...]] = {}
+    for q, fi in project.functions.items():
+        for site in fi.calls:
+            raw_tail = site.raw.split(".")[-1] if site.raw else ""
+            if site.raw in WALL_CLOCK or raw_tail in ("now_ms", "_now_ms") or (
+                raw_tail in ("time", "time_ns") and site.raw.startswith("time.")
+            ):
+                closure[q] = (site.raw,)
+                break
+    changed = True
+    while changed:
+        changed = False
+        for q, fi in project.functions.items():
+            if q in closure:
+                continue
+            for site in fi.calls:
+                for t in site.targets:
+                    if t in closure:
+                        closure[q] = (t,) + closure[t]
+                        changed = True
+                        break
+                if q in closure:
+                    break
+    return closure
+
+
+def check_wall_clock(project) -> list[Finding]:
+    out: list[Finding] = []
+    closure = _clock_closure(project)
+    for fi in merge_roots(project):
+        chain = closure.get(fi.qual)
+        if chain is None:
+            continue
+        src = project.by_rel.get(fi.rel)
+        out.append(
+            Finding(
+                "JL801", fi.rel, fi.lineno,
+                f"merge path `{fi.qual.split('::', 1)[1]}` reaches a "
+                f"wall-clock read via {' -> '.join(chain)} — joins must "
+                "not depend on local time; suppress only with a "
+                "documented minting-site justification",
+                src.line_src(fi.lineno) if src is not None else "",
+            )
+        )
+    return out
+
+
+# ---- JL802: unordered iteration feeding digest/wire/flush ------------------
+
+
+def _order_sensitive_functions(project):
+    for fi in project.functions.values():
+        if not (_in_scope(fi.rel) or "codec.py" in fi.rel):
+            continue
+        if fi.name in ORDER_SENSITIVE_FUNCS or (
+            fi.name.startswith("_w_") and "cluster" in fi.rel
+        ) or (
+            fi.name.startswith("_encode_") and "native" in fi.rel
+        ) or fi.name == "_sync_update_repo":
+            yield fi
+
+
+def check_iteration_order(project) -> list[Finding]:
+    out: list[Finding] = []
+    for fi in _order_sensitive_functions(project):
+        src = project.by_rel.get(fi.rel)
+        # every .items()/.keys()/.values() call whose IMMEDIATE consumer
+        # is not order-insensitive
+        safe_args: set[int] = set()
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Call):
+                fname = dotted_name(node.func).split(".")[-1]
+                if fname in ORDER_SAFE_WRAPPERS:
+                    for a in ast.walk(node):
+                        safe_args.add(id(a))
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            if not isinstance(node.func, ast.Attribute):
+                continue
+            if node.func.attr not in ("items", "keys", "values"):
+                continue
+            if node.args or node.keywords:
+                continue  # not the dict-view idiom
+            if id(node) in safe_args:
+                continue
+            recv = dotted_name(node.func.value) or "<expr>"
+            out.append(
+                Finding(
+                    "JL802", fi.rel, node.lineno,
+                    f"`{recv}.{node.func.attr}()` iterates in insertion "
+                    f"order inside `{fi.name}`, which feeds a digest/"
+                    "wire/flush — wrap in sorted() or justify with "
+                    "`# jlint: order-ok`",
+                    src.line_src(node.lineno) if src is not None else "",
+                )
+            )
+    return out
+
+
+# ---- JL803: mutation after aliasing into a sink ----------------------------
+
+
+def _sink_args(call: ast.Call) -> list[str]:
+    """Names aliased into a sink by this call, or []."""
+    fname = dotted_name(call.func)
+    tail = fname.split(".")[-1]
+    names: list[str] = []
+    is_sink = False
+    if tail in SINK_FUNCS:
+        is_sink = True
+    elif tail == "append" and isinstance(call.func, ast.Attribute):
+        recv = dotted_name(call.func.value).lower()
+        if any(s in recv for s in SINK_RECEIVERS):
+            is_sink = True
+    if not is_sink:
+        return names
+    for a in call.args:
+        for n in ast.walk(a):
+            if isinstance(n, ast.Name):
+                names.append(n.id)
+            elif (
+                isinstance(n, ast.Attribute)
+                and isinstance(n.value, ast.Name)
+                and n.value.id == "self"
+            ):
+                names.append(f"self.{n.attr}")
+    return names
+
+
+def check_sink_aliasing(project) -> list[Finding]:
+    out: list[Finding] = []
+    for fi in project.functions.values():
+        if not (_in_scope(fi.rel) or "cluster" in fi.rel or "journal" in fi.rel):
+            continue
+        src = project.by_rel.get(fi.rel)
+        # ordered walk: (line, kind, payload)
+        events: list[tuple[int, str, object]] = []
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Call):
+                aliased = _sink_args(node)
+                if aliased:
+                    events.append((node.lineno, "sink", aliased))
+                elif isinstance(node.func, ast.Attribute) and (
+                    node.func.attr in MUTATORS
+                ):
+                    tgt = node.func.value
+                    name = None
+                    if isinstance(tgt, ast.Name):
+                        name = tgt.id
+                    elif (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                    ):
+                        name = f"self.{tgt.attr}"
+                    if name is not None:
+                        events.append((node.lineno, "mutate", name))
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign) else [node.target]
+                )
+                for t in targets:
+                    if isinstance(t, ast.Subscript):
+                        base = t.value
+                        if isinstance(base, ast.Name):
+                            events.append((node.lineno, "mutate", base.id))
+                        elif (
+                            isinstance(base, ast.Attribute)
+                            and isinstance(base.value, ast.Name)
+                            and base.value.id == "self"
+                        ):
+                            events.append(
+                                (node.lineno, "mutate", f"self.{base.attr}")
+                            )
+        events.sort(key=lambda e: e[0])
+        poisoned: dict[str, int] = {}
+        for line, kind, payload in events:
+            if kind == "sink":
+                for name in payload:
+                    poisoned.setdefault(name, line)
+            elif kind == "mutate" and payload in poisoned:
+                out.append(
+                    Finding(
+                        "JL803", fi.rel, line,
+                        f"`{payload}` aliased into a journal/broadcast/"
+                        f"held sink at line {poisoned[payload]} and is "
+                        f"mutated in place here — the sink's consumer "
+                        "sees the mutation; copy before mutating or "
+                        "justify with `# jlint: alias-ok`",
+                        src.line_src(line) if src is not None else "",
+                    )
+                )
+    return out
+
+
+# ---- JL804: replica-id-dependent branches in joins -------------------------
+
+
+def check_rid_branches(project) -> list[Finding]:
+    out: list[Finding] = []
+    for fi in merge_roots(project):
+        if fi.name == "apply":
+            continue  # command dispatch handles per-replica ops by design
+        src = project.by_rel.get(fi.rel)
+        for node in ast.walk(fi.node):
+            if not isinstance(node, (ast.If, ast.IfExp)):
+                continue
+            test_src = ast.unparse(node.test).lower()
+            if any(m in test_src for m in RID_MARKERS):
+                out.append(
+                    Finding(
+                        "JL804", fi.rel, node.lineno,
+                        f"replica-id-dependent branch inside merge path "
+                        f"`{fi.name}` — two replicas joining identical "
+                        "states must take identical branches; justify "
+                        "with `# jlint: ridbranch-ok` if this is the "
+                        "documented own-column repair",
+                        src.line_src(node.lineno) if src is not None else "",
+                    )
+                )
+    return out
+
+
+# ---- manifest + generated property harness ---------------------------------
+
+
+def extract_roots(project) -> list[str]:
+    return [fi.qual for fi in merge_roots(project)]
+
+
+def load_manifest(path: str = LATTICE_MANIFEST_PATH) -> dict:
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def build_manifest(project) -> dict:
+    existing = load_manifest()
+    rules = {
+        rule: existing.get("rules", {}).get(rule, default)
+        for rule, default in DEFAULT_RULES.items()
+    }
+    return {
+        "_comment": (
+            "Generated by `python -m scripts.jlint --write-manifest`. "
+            "`rules` documents each JL80x obligation (human-edited, "
+            "preserved across regeneration); `merge_roots` is the "
+            "extracted merge/join/apply inventory the reachability "
+            "checks run from; `types` binds each of the five lattices "
+            "to the generated property harness "
+            "(tests/test_lattice_laws.py, ALSO regenerated by "
+            "--write-manifest) that proves join "
+            "commutativity/associativity/idempotence dynamically. "
+            "`make lint` fails on drift (JL805)."
+        ),
+        "rules": rules,
+        "merge_roots": extract_roots(project),
+        "types": HARNESS_TYPES,
+    }
+
+
+def write_manifest(project, path: str = LATTICE_MANIFEST_PATH) -> dict:
+    manifest = build_manifest(project)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
+        f.write("\n")
+    with open(HARNESS_PATH, "w", encoding="utf-8") as f:
+        f.write(render_harness(manifest))
+    return manifest
+
+
+def check_manifest(project, path: str = LATTICE_MANIFEST_PATH) -> list[Finding]:
+    out: list[Finding] = []
+    rel = os.path.relpath(path, ROOT)
+    manifest = load_manifest(path)
+    if not manifest:
+        out.append(
+            Finding(
+                "JL805", rel, 1,
+                "lattice manifest missing — run `python -m scripts.jlint "
+                "--write-manifest` and commit it (plus the generated "
+                "tests/test_lattice_laws.py)",
+                "",
+            )
+        )
+        return out
+    current = extract_roots(project)
+    committed = manifest.get("merge_roots", [])
+    for q in current:
+        if q not in committed:
+            out.append(
+                Finding(
+                    "JL805", rel, 1,
+                    f"merge root `{q}` is not recorded in the lattice "
+                    "manifest — run --write-manifest and review",
+                    q,
+                )
+            )
+    for q in committed:
+        if q not in current:
+            out.append(
+                Finding(
+                    "JL805", rel, 1,
+                    f"stale lattice manifest merge root `{q}`: no such "
+                    "function — run --write-manifest",
+                    q,
+                )
+            )
+    for rule in DEFAULT_RULES:
+        desc = manifest.get("rules", {}).get(rule, "")
+        if not desc.strip() or desc.strip() == PLACEHOLDER:
+            out.append(
+                Finding(
+                    "JL805", rel, 1,
+                    f"lattice rule `{rule}` has no documented obligation "
+                    "in the manifest",
+                    rule,
+                )
+            )
+    if manifest.get("types") != HARNESS_TYPES:
+        out.append(
+            Finding(
+                "JL805", rel, 1,
+                "lattice manifest `types` table drifted from the harness "
+                "bindings — run --write-manifest",
+                "types",
+            )
+        )
+    # the committed harness must be exactly what the manifest renders
+    try:
+        with open(HARNESS_PATH, encoding="utf-8") as f:
+            committed_harness = f.read()
+    except OSError:
+        committed_harness = None
+    rendered = render_harness(
+        {"rules": manifest.get("rules", {}), "merge_roots": committed,
+         "types": manifest.get("types", {})}
+    )
+    if committed_harness != rendered:
+        out.append(
+            Finding(
+                "JL805", os.path.relpath(HARNESS_PATH, ROOT), 1,
+                "tests/test_lattice_laws.py is stale: it is generated "
+                "from the lattice manifest — run `python -m scripts.jlint "
+                "--write-manifest` and commit the regenerated harness",
+                "",
+            )
+        )
+    return out
+
+
+def run(project) -> list[Finding]:
+    out = check_wall_clock(project)
+    out += check_iteration_order(project)
+    out += check_sink_aliasing(project)
+    out += check_rid_branches(project)
+    return out
+
+
+# ---- harness template ------------------------------------------------------
+
+
+def render_harness(manifest: dict) -> str:
+    types = manifest.get("types", HARNESS_TYPES)
+    type_rows = "\n".join(
+        f'    ("{name}", "{spec["lattice"]}", {spec["gen"]}),'
+        for name, spec in sorted(types.items())
+    )
+    return f'''"""GENERATED by `python -m scripts.jlint --write-manifest` from
+scripts/jlint/lattice_manifest.json — DO NOT EDIT BY HAND (jlint JL805
+fails on drift; edit the manifest/template in scripts/jlint/
+pass_lattice.py and regenerate).
+
+The dynamic half of the pass-8 lattice contract: for every one of the
+five CRDT lattices, the join must be commutative, associative, and
+idempotent over randomly generated delta states. Seeded RNG, no
+external property-testing dependency — hypothesis-style shrinking is
+traded for a fixed, replayable seed per case.
+"""
+
+from __future__ import annotations
+
+import copy
+import importlib
+import os
+import random
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+N_CASES = 60
+SEED = 0x1A771CE
+
+
+def _lattice(path):
+    mod, cls = path.split(":")
+    return getattr(importlib.import_module(mod), cls)
+
+
+def _canon(x):
+    """Canonical comparable form per lattice (representation-normal)."""
+    name = type(x).__name__
+    if name == "GCounter":
+        return ("G", tuple(sorted(x.counts.items())))
+    if name == "PNCounter":
+        return ("PN", _canon(x.p), _canon(x.n))
+    if name == "TReg":
+        return ("TR", x.is_set, x.ts, x.value)
+    if name == "TLog":
+        return ("TL", tuple(x.entries), x.cutoff)
+    # UJSON: entries + fully-compacted causal context
+    x.ctx.compact()
+    return (
+        "UJ",
+        tuple(sorted(x.entries.items())),
+        tuple(sorted(x.ctx.vv.items())),
+        tuple(sorted(x.ctx.cloud)),
+    )
+
+
+def _join(a, b):
+    out = copy.deepcopy(a)
+    out.converge(copy.deepcopy(b))
+    return out
+
+
+def gen_gcount(rng, cls):
+    g = cls()
+    for rid in rng.sample(range(1, 9), rng.randint(0, 5)):
+        g.counts[rid] = rng.randint(1, 1 << 40)
+    return g
+
+
+def gen_pncount(rng, cls):
+    pn = cls()
+    pn.p = gen_gcount(rng, type(pn.p))
+    pn.n = gen_gcount(rng, type(pn.n))
+    return pn
+
+
+def gen_treg(rng, cls):
+    t = cls()
+    if rng.random() < 0.85:
+        t.write(bytes(rng.choices(b"abcdef", k=rng.randint(0, 4))),
+                rng.randint(0, 5))
+    return t
+
+
+def gen_tlog(rng, cls):
+    t = cls()
+    for _ in range(rng.randint(0, 6)):
+        t.insert(bytes(rng.choices(b"xyz", k=rng.randint(1, 3))),
+                 rng.randint(0, 9))
+    if rng.random() < 0.3:
+        t.raise_cutoff(rng.randint(0, 9))
+    return t
+
+
+def gen_ujson(rng, cls):
+    u = cls()
+    paths = (("a",), ("a", "b"), ("c",))
+    tokens = ('"v"', "1", "true")
+    for _ in range(rng.randint(0, 5)):
+        rid = rng.randint(1, 4)
+        seq = rng.randint(1, 6)
+        # payload is a FUNCTION of the dot: a dot names one unique event,
+        # so two deltas that both carry it must agree on its payload —
+        # independent random payloads would violate the CRDT's dot-
+        # uniqueness invariant and "fail" laws the lattice does satisfy
+        u.entries[(rid, seq)] = (
+            paths[(rid + seq) % 3], tokens[(rid * 3 + seq) % 3],
+        )
+        u.ctx.add((rid, seq))
+    for _ in range(rng.randint(0, 3)):
+        u.ctx.add((rng.randint(1, 4), rng.randint(1, 6)))
+    u.ctx.compact()
+    return u
+
+
+LATTICES = [
+{type_rows}
+]
+
+
+@pytest.mark.parametrize("name,path,gen", LATTICES, ids=[t[0] for t in LATTICES])
+def test_join_commutative(name, path, gen):
+    cls = _lattice(path)
+    for case in range(N_CASES):
+        rng = random.Random(f"{{SEED}}:{{name}}:comm:{{case}}")
+        a, b = gen(rng, cls), gen(rng, cls)
+        assert _canon(_join(a, b)) == _canon(_join(b, a)), (name, case)
+
+
+@pytest.mark.parametrize("name,path,gen", LATTICES, ids=[t[0] for t in LATTICES])
+def test_join_associative(name, path, gen):
+    cls = _lattice(path)
+    for case in range(N_CASES):
+        rng = random.Random(f"{{SEED}}:{{name}}:assoc:{{case}}")
+        a, b, c = gen(rng, cls), gen(rng, cls), gen(rng, cls)
+        left = _join(_join(a, b), c)
+        right = _join(a, _join(b, c))
+        assert _canon(left) == _canon(right), (name, case)
+
+
+@pytest.mark.parametrize("name,path,gen", LATTICES, ids=[t[0] for t in LATTICES])
+def test_join_idempotent(name, path, gen):
+    cls = _lattice(path)
+    for case in range(N_CASES):
+        rng = random.Random(f"{{SEED}}:{{name}}:idem:{{case}}")
+        a = gen(rng, cls)
+        assert _canon(_join(a, a)) == _canon(a), (name, case)
+        b = gen(rng, cls)
+        ab = _join(a, b)
+        assert _canon(_join(ab, b)) == _canon(ab), (name, case)
+'''

@@ -37,7 +37,8 @@ import ast
 import json
 import os
 
-from . import Finding, ROOT, Source, iter_py_files
+from . import Finding, ROOT, iter_py_files
+from .core import load_source
 
 METRICS_MANIFEST_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "metrics_manifest.json"
@@ -85,7 +86,7 @@ def extract_sites(
     sites: dict[str, list[tuple[str, int]]] = {}
     problems: list[Finding] = []
     for path in iter_py_files(root, scope):
-        src = Source.load(path, root)
+        src = load_source(path, root)  # content-hash AST cache
         for node in ast.walk(src.tree):
             if not isinstance(node, ast.Call):
                 continue

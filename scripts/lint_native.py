@@ -1,0 +1,112 @@
+"""`make lint-native`: clang-tidy over native/ + NOLINT-reason policy.
+
+Two jobs, mirroring jlint's discipline for the C++ tree:
+
+1. Run clang-tidy with the committed curated check set (.clang-tidy,
+   warnings-as-errors) over every native/*.cpp translation unit. When
+   clang-tidy is not installed the step SKIPS with exit 0 and a loud
+   message — the container image may not carry it, CI installs it; the
+   repo's hard native gates (-Werror build, ASAN/UBSAN) run either way.
+2. Enforce the suppression-reason policy regardless of clang-tidy
+   availability: every inline ``NOLINT``/``NOLINTNEXTLINE`` in native/
+   must name its check(s) AND carry a ``-- <reason>`` trailer, exactly
+   like jlint's ``# jlint: <slug> — reason`` rule (JL002). A bare
+   NOLINT is an unreviewable hole and fails here even without
+   clang-tidy present.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(ROOT, "native")
+
+# NOLINT with a named check AND a reason: `// NOLINT(check) -- why`
+_NOLINT_RE = re.compile(r"//\s*NOLINT(NEXTLINE)?(?P<rest>.*)$")
+_GOOD_RE = re.compile(r"^\((?P<checks>[\w\-.,* ]+)\)\s*--\s*\S.*")
+
+
+def check_nolint_reasons() -> int:
+    bad = 0
+    for fname in sorted(os.listdir(NATIVE)):
+        if not fname.endswith((".cpp", ".h")):
+            continue
+        path = os.path.join(NATIVE, fname)
+        with open(path, encoding="utf-8") as f:
+            for i, line in enumerate(f, 1):
+                m = _NOLINT_RE.search(line)
+                if m is None:
+                    continue
+                if _GOOD_RE.match(m.group("rest").strip()) is None:
+                    bad += 1
+                    print(
+                        f"native/{fname}:{i}: NOLINT must name its "
+                        "check(s) and carry a reason — "
+                        "`// NOLINT(<check>) -- <why this is safe>` "
+                        "(same policy as jlint inline suppressions)",
+                        file=sys.stderr,
+                    )
+    return bad
+
+
+def find_clang_tidy() -> str | None:
+    cand = os.environ.get("CLANG_TIDY")
+    if cand and shutil.which(cand):
+        return cand
+    for name in ("clang-tidy", "clang-tidy-19", "clang-tidy-18", "clang-tidy-17"):
+        if shutil.which(name):
+            return name
+    return None
+
+
+def run_clang_tidy(exe: str) -> int:
+    sources = sorted(
+        os.path.join(NATIVE, f)
+        for f in os.listdir(NATIVE)
+        if f.endswith(".cpp")
+    )
+    if not sources:
+        print("lint-native: no native sources found", file=sys.stderr)
+        return 1
+    cmd = [exe, "--quiet", *sources, "--", "-std=c++17", "-x", "c++"]
+    print("lint-native:", " ".join(os.path.relpath(c, ROOT) if os.sep in c else c for c in cmd))
+    proc = subprocess.run(cmd, cwd=ROOT)
+    return proc.returncode
+
+
+def main() -> int:
+    rc = 0
+    bad = check_nolint_reasons()
+    if bad:
+        print(f"lint-native: {bad} bare NOLINT(s)", file=sys.stderr)
+        rc = 1
+    exe = find_clang_tidy()
+    if exe is None:
+        print(
+            "lint-native: clang-tidy not installed — SKIPPING the "
+            "static checks (CI installs it; the -Werror build and "
+            "ASAN/UBSAN gates still run). NOLINT-reason policy was "
+            "checked above."
+        )
+        return rc
+    tidy_rc = run_clang_tidy(exe)
+    if tidy_rc:
+        print(
+            "lint-native: clang-tidy found issues (warnings are errors "
+            "per .clang-tidy) — fix them or suppress with "
+            "`// NOLINT(<check>) -- <reason>`",
+            file=sys.stderr,
+        )
+        rc = rc or tidy_rc
+    else:
+        print("lint-native: clang-tidy clean")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -355,22 +355,39 @@ def test_chaos_ffi_fault_demotes_and_serves_correctly():
                 pytest.skip("no native toolchain: FFI seam absent")
             # demotions count in the serving Database's own registry
             before = node.database.metrics.serving_counters["demotions"]
-            faults.arm("native.scan_apply", "error", budget=1)
-            burst = b"".join(
-                b"*4\r\n$6\r\nGCOUNT\r\n$3\r\nINC\r\n$1\r\nk\r\n$1\r\n2\r\n"
-                for _ in range(3)
-            ) + b"*3\r\n$6\r\nGCOUNT\r\n$3\r\nGET\r\n$1\r\nk\r\n"
-            reader, writer = await asyncio.open_connection("127.0.0.1", node.server.port)
-            writer.write(burst)
-            await writer.drain()
-            got = b""
-            while got.count(b"\r\n") < 4:
-                chunk = await asyncio.wait_for(reader.read(1 << 16), timeout=5.0)
-                if not chunk:
+            h0 = faults.hits("native.scan_apply")
+            expected_total = 0
+            # a transiently-busy engine (a threaded drain holding a repo
+            # lock at burst time) routes commands down the Python path
+            # WITHOUT touching the FFI seam — replies stay correct, the
+            # failpoint just isn't reached; retry on a fresh connection
+            # until the burst actually met the seam
+            for attempt in range(10):
+                faults.arm("native.scan_apply", "error", budget=1)
+                burst = b"".join(
+                    b"*4\r\n$6\r\nGCOUNT\r\n$3\r\nINC\r\n$1\r\nk\r\n$1\r\n2\r\n"
+                    for _ in range(3)
+                ) + b"*3\r\n$6\r\nGCOUNT\r\n$3\r\nGET\r\n$1\r\nk\r\n"
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", node.server.port
+                )
+                writer.write(burst)
+                await writer.drain()
+                got = b""
+                while got.count(b"\r\n") < 4:
+                    chunk = await asyncio.wait_for(
+                        reader.read(1 << 16), timeout=5.0
+                    )
+                    if not chunk:
+                        break
+                    got += chunk
+                expected_total += 6
+                assert got == b"+OK\r\n+OK\r\n+OK\r\n:%d\r\n" % expected_total, got
+                if faults.hits("native.scan_apply") > h0:
                     break
-                got += chunk
-            assert got == b"+OK\r\n+OK\r\n+OK\r\n:6\r\n", got
-            assert faults.hits("native.scan_apply") == 1
+                writer.close()
+                await asyncio.sleep(0.1)
+            assert faults.hits("native.scan_apply") == h0 + 1
             assert (
                 node.database.metrics.serving_counters["demotions"]
                 == before + 1
@@ -378,9 +395,77 @@ def test_chaos_ffi_fault_demotes_and_serves_correctly():
             # the demoted connection keeps serving correctly
             writer.write(b"*3\r\n$6\r\nGCOUNT\r\n$3\r\nGET\r\n$1\r\nk\r\n")
             await writer.drain()
-            assert await asyncio.wait_for(reader.read(1 << 10), timeout=5.0) == b":6\r\n"
+            assert await asyncio.wait_for(
+                reader.read(1 << 10), timeout=5.0
+            ) == b":%d\r\n" % expected_total
             writer.close()
         finally:
+            await node.stop()
+
+    asyncio.run(main())
+
+
+@pytest.mark.chaos
+def test_chaos_ffi_sleep_delays_one_connection_not_the_loop():
+    """Regression (jlint v2 interprocedural JL101): the FFI burst
+    failpoint used the SYNC `faults.point`, so an armed
+    `native.scan_apply=sleep:X` parked the whole event loop —
+    heartbeats, Pongs, and every other connection — turning a
+    slow-burst drill into a node-wide freeze that idle-evicts our
+    peer connections. It is now the async point: the injected sleep
+    delays THIS connection's burst while the loop keeps running."""
+
+    async def main():
+        (port,) = grab_ports(1)
+        node = Node("solo", port)
+        await node.start()
+        try:
+            if node.database.native_engine is None:
+                pytest.skip("no native toolchain: FFI seam absent")
+            h0 = faults.hits("native.scan_apply")
+            gaps: list[float] = []
+
+            async def ticker():
+                loop = asyncio.get_running_loop()
+                last = loop.time()
+                while True:
+                    await asyncio.sleep(0.01)
+                    now = loop.time()
+                    gaps.append(now - last)
+                    last = now
+
+            t = asyncio.ensure_future(ticker())
+            # retry past transient engine busy-ness (a threaded drain at
+            # burst time routes down the Python path without reaching
+            # the FFI seam) — same discipline as the demotion drill
+            took = 0.0
+            for attempt in range(10):
+                faults.arm("native.scan_apply", "sleep", arg=0.4, budget=1)
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", node.server.port
+                )
+                t0 = asyncio.get_running_loop().time()
+                writer.write(
+                    b"*4\r\n$6\r\nGCOUNT\r\n$3\r\nINC\r\n$1\r\nk\r\n$1\r\n2\r\n"
+                )
+                await writer.drain()
+                got = await asyncio.wait_for(reader.read(1 << 10), timeout=5.0)
+                took = asyncio.get_running_loop().time() - t0
+                assert got == b"+OK\r\n", got
+                if faults.hits("native.scan_apply") > h0:
+                    break
+                writer.close()
+                await asyncio.sleep(0.1)
+            t.cancel()
+            assert faults.hits("native.scan_apply") == h0 + 1
+            # the injected sleep DID delay this burst...
+            assert took >= 0.35, took
+            # ...but the loop kept ticking through it (the sync point
+            # produced one >=0.4 s gap here)
+            assert gaps and max(gaps) < 0.2, max(gaps)
+            writer.close()
+        finally:
+            faults.disarm("native.scan_apply")
             await node.stop()
 
     asyncio.run(main())

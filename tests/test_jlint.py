@@ -388,7 +388,7 @@ def test_stale_baseline_entry_fails(tmp_path):
         ],
     )
     assert all(f.suppressed for f in bad)  # first entry matched
-    assert len(problems) == 1 and problems[0].rule == "JL900"
+    assert len(problems) == 1 and problems[0].rule == "JL000"
     assert "stale" in problems[0].msg
 
 
@@ -848,3 +848,514 @@ def test_full_jlint_run_is_clean_including_baseline():
     from scripts.jlint.__main__ import run_all
 
     assert run_all() == 0
+
+
+# ---- jlint v2: the semantic core (graph/summaries) --------------------------
+
+
+from scripts.jlint import pass_codec, pass_lattice, pass_locks  # noqa: E402
+from scripts.jlint.core import Project  # noqa: E402
+
+
+def project_of(tmp_path, code: str, rel="jylis_tpu/models/mod.py") -> Project:
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(code)
+    return Project.load(str(tmp_path), (rel.split("/")[0],))
+
+
+def test_core_resolves_calls_and_held_locks(tmp_path):
+    project = project_of(tmp_path, """
+import os, threading
+
+class J:
+    def __init__(self):
+        self._cv = threading.Condition()
+
+    def helper(self):
+        os.fsync(3)
+
+    def outer(self):
+        with self._cv:
+            self.helper()
+""")
+    fi = project.functions["jylis_tpu/models/mod.py::J.outer"]
+    site = next(s for s in fi.calls if s.raw == "self.helper")
+    assert site.targets == ("jylis_tpu/models/mod.py::J.helper",)
+    assert site.locks == ("J._cv",)
+    closure = project.blocking_closure()
+    assert closure["jylis_tpu/models/mod.py::J.helper"] == ("os.fsync",)
+
+
+# ---- interprocedural JL101 (pass-1 upgrade) ---------------------------------
+
+
+def test_interproc_blocking_in_async_fires(tmp_path):
+    project = project_of(tmp_path, """
+import os
+
+def sync_helper():
+    os.fsync(3)
+
+async def handler():
+    sync_helper()
+""")
+    bad = pass_async.run_interprocedural(project)
+    assert [f.rule for f in bad] == ["JL101"]
+    assert "sync_helper" in bad[0].msg and "os.fsync" in bad[0].msg
+
+
+def test_interproc_blocking_skips_async_callees_and_dispatch(tmp_path):
+    project = project_of(tmp_path, """
+import asyncio, os
+
+def sync_helper():
+    os.fsync(3)
+
+async def async_helper():
+    await asyncio.to_thread(sync_helper)
+
+async def handler():
+    await async_helper()
+    await asyncio.to_thread(sync_helper)
+""")
+    assert pass_async.run_interprocedural(project) == []
+
+
+# ---- pass 7: codec symmetry (JL701/JL702/JL703) -----------------------------
+
+
+def test_codec_order_drift_fires_jl701():
+    units = {
+        "delta/FAKE": {
+            "encode": ["bytes", "varint"],
+            "decode": ["varint", "bytes"],
+        }
+    }
+    findings = pass_codec.unit_findings(units)
+    assert [f.rule for f in findings] == ["JL701"]
+    assert "delta/FAKE" in findings[0].msg
+
+
+def test_codec_unconsumed_field_fires_jl702():
+    units = {
+        "delta/FAKE": {
+            "encode": ["bytes", "varint", "varint"],
+            "decode": ["bytes", "varint"],
+        },
+        "file/FAKE": {
+            "grade": "atoms",
+            "encode": ["MAGIC", "delta_signature", "crc"],
+            "decode": ["MAGIC", "delta_signature"],
+        },
+    }
+    findings = pass_codec.unit_findings(units)
+    assert sorted(f.rule for f in findings) == ["JL702", "JL702"]
+    assert any("encoder" in f.msg and "varint" in f.msg for f in findings)
+    assert any("crc" in f.msg for f in findings)
+
+
+def test_codec_symmetric_units_clean():
+    units = {
+        "delta/FAKE": {
+            "encode": ["bytes", ["rep", ["varint", "str"]]],
+            "decode": ["bytes", ["rep", ["varint", "str"]]],
+        },
+        "file/FAKE": {
+            "grade": "atoms",
+            "ignore": ["framing"],
+            "encode": ["MAGIC", "framing", "crc"],
+            "decode": ["crc", "MAGIC"],
+        },
+    }
+    assert pass_codec.unit_findings(units) == []
+
+
+def test_codec_emitter_extracts_eval_order(tmp_path):
+    import ast as ast_mod
+
+    mod = ast_mod.parse("""
+def _w_pair(out, v):
+    _w_varint(out, len(v))
+    for item in v:
+        _w_bytes(out, item)
+    _w_str(out, "tail")
+
+def _r_pair(r):
+    n = [r.bytes_() for _ in range(r.varint())]
+    return n, r.str_()
+""")
+    fns = {n.name: n for n in mod.body}
+    em = pass_codec._Emitter(fns)
+    enc = pass_codec._flat(em.sequence(fns["_w_pair"]))
+    dec = pass_codec._flat(em.sequence(fns["_r_pair"]))
+    assert enc == ["varint", "rep[", "bytes", "]", "str"]
+    assert dec == enc  # comprehension iter evaluates before elements
+
+
+def test_codec_manifest_drift_fires_jl703(tmp_path):
+    import copy
+
+    manifest = pass_codec.build_manifest()
+    stale = copy.deepcopy(manifest)
+    stale["schema_version"] = 99
+    p = tmp_path / "codec.json"
+    p.write_text(json.dumps(stale))
+    findings = pass_codec.check(str(p))
+    assert any(
+        f.rule == "JL703" and "schema_version" in f.msg for f in findings
+    )
+
+
+def test_codec_missing_manifest_fires_jl703(tmp_path):
+    findings = pass_codec.check(str(tmp_path / "nope.json"))
+    assert any(f.rule == "JL703" and "missing" in f.msg for f in findings)
+
+
+def test_real_codec_surfaces_are_symmetric_and_committed():
+    """Full-repo clean: every paired encoder/decoder extracts to the
+    same field sequence and the committed manifest matches."""
+    assert pass_codec.check() == []
+    manifest = pass_codec.build_manifest()
+    # every cluster message and delta type is covered
+    units = set(manifest["units"])
+    for t in ("TREG", "TLOG", "SYSTEM", "GCOUNT", "PNCOUNT", "UJSON"):
+        assert f"delta/{t}" in units
+    for m in ("Pong", "ExchangeAddrs", "AnnounceAddrs", "PushDeltas",
+              "SyncRequest", "SyncDone"):
+        assert f"msg/{m}" in units
+    assert {"frame/header", "frame/wire", "file/journal", "file/snapshot"} <= units
+    assert manifest["units"]["file/snapshot"]["accepts_legacy"] is True
+    assert manifest["legacy_snapshot_versions"] == [1, 2, 3]
+
+
+# ---- pass 8: lattice discipline (JL801-JL805) -------------------------------
+
+
+LATTICE_BAD = """
+import time
+
+def now_helper():
+    return time.time()
+
+def converge(key, delta):
+    ts = now_helper()
+    return ts
+
+def sync_canon(key):
+    d = {1: 2}
+    return repr([x for x in d.items()]).encode()
+
+class Repo:
+    _identity = 3
+
+    def load_state(self, batch):
+        for key, delta in batch:
+            if self._identity in delta:
+                pass
+
+def flush(journal, batch):
+    journal.append("T", batch)
+    batch.append(("k", 1))
+"""
+
+
+def test_lattice_rules_fire_on_fixture(tmp_path):
+    project = project_of(tmp_path, LATTICE_BAD)
+    findings = pass_lattice.run(project)
+    rules = sorted({f.rule for f in findings})
+    assert rules == ["JL801", "JL802", "JL803", "JL804"]
+    jl801 = [f for f in findings if f.rule == "JL801"]
+    assert any("now_helper" in f.msg and "time.time" in f.msg for f in jl801)
+    jl803 = [f for f in findings if f.rule == "JL803"]
+    assert any("`batch`" in f.msg for f in jl803)
+
+
+def test_lattice_rules_clean_on_disciplined_fixture(tmp_path):
+    project = project_of(tmp_path, """
+def converge(key, delta):
+    return max(delta)
+
+def sync_canon(key):
+    d = {1: 2}
+    return repr(sorted(d.items())).encode()
+
+def flush(journal, batch):
+    journal.append("T", list(batch))
+    out = []
+    out.append(("k", 1))
+""")
+    assert pass_lattice.run(project) == []
+
+
+def test_lattice_manifest_staleness_fires_jl805(tmp_path):
+    project = Project.load()
+    manifest = pass_lattice.build_manifest(project)
+    manifest["merge_roots"] = manifest["merge_roots"][:-1] + ["gone::fn"]
+    p = tmp_path / "lattice.json"
+    p.write_text(json.dumps(manifest))
+    findings = pass_lattice.check_manifest(project, str(p))
+    assert any(f.rule == "JL805" and "gone::fn" in f.msg for f in findings)
+    assert any(
+        f.rule == "JL805" and "not recorded" in f.msg for f in findings
+    )
+
+
+def test_lattice_manifest_missing_fires_jl805(tmp_path):
+    project = Project.load()
+    findings = pass_lattice.check_manifest(project, str(tmp_path / "no.json"))
+    assert [f.rule for f in findings] == ["JL805"]
+
+
+def test_real_lattice_manifest_and_harness_current():
+    """Full-repo clean: every merge root is recorded, every rule has a
+    documented obligation, and the committed property harness equals
+    what the manifest renders."""
+    project = Project.load()
+    assert pass_lattice.check_manifest(project) == []
+    manifest = pass_lattice.load_manifest()
+    assert sorted(manifest["types"]) == [
+        "GCOUNT", "PNCOUNT", "TLOG", "TREG", "UJSON",
+    ]
+    assert manifest["merge_roots"] == pass_lattice.extract_roots(project)
+
+
+# ---- pass 9: lock order (JL901/JL902/JL903) ---------------------------------
+
+
+def test_await_under_threading_lock_fires_jl901(tmp_path):
+    project = project_of(tmp_path, """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    async def bad(self):
+        with self._lock:
+            await self.fetch()
+
+    async def fine(self):
+        async with self._alock:
+            await self.fetch()
+""")
+    findings = pass_locks.check_await_under_lock(project)
+    assert [f.rule for f in findings] == ["JL901"]
+    assert "bad" in findings[0].msg
+
+
+def test_lock_cycle_fires_jl902(tmp_path):
+    project = project_of(tmp_path, """
+import threading
+
+class A:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+
+    def one(self, b):
+        with self._a_lock:
+            b.two_inner()
+
+class B:
+    def __init__(self):
+        self._b_lock = threading.Lock()
+        self._a = A()
+
+    def two_inner(self):
+        with self._b_lock:
+            pass
+
+    def back(self):
+        with self._b_lock:
+            self._a.one_inner()
+
+class A2(A):
+    pass
+
+def drive():
+    a = A()
+    b = B()
+    with a._a_lock:
+        with b._b_lock:
+            pass
+    with b._b_lock:
+        with a._a_lock:
+            pass
+""")
+    findings = pass_locks.check_lock_cycles(project)
+    assert findings and all(f.rule == "JL902" for f in findings)
+    assert any("A._a_lock" in f.msg and "B._b_lock" in f.msg for f in findings)
+
+
+def test_lock_order_clean_when_consistent(tmp_path):
+    """Consistent A-then-B ordering over CONSTRUCTOR-TYPED locks (the
+    resolvable identities the cycle graph is built from) is clean —
+    parameter-typed receivers would be `?.attr` wildcards, excluded
+    from the graph entirely, and would make this pin vacuous."""
+    project = project_of(tmp_path, """
+import threading
+
+class A:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+
+class B:
+    def __init__(self):
+        self._b_lock = threading.Lock()
+
+def drive():
+    a = A()
+    b = B()
+    with a._a_lock:
+        with b._b_lock:
+            pass
+    with a._a_lock:
+        with b._b_lock:
+            pass
+""")
+    # the consistent order produces a real A->B edge and no cycle
+    assert ("A._a_lock", "B._b_lock") in project.lock_edges()
+    assert pass_locks.check_lock_cycles(project) == []
+
+
+def test_wildcard_lock_identities_never_form_cycle_edges(tmp_path):
+    """Untyped receivers (`?.attr`) must stay out of the cycle graph:
+    they merge same-named locks across unrelated classes and would
+    fabricate deadlocks the no-false-edge discipline forbids."""
+    project = project_of(tmp_path, """
+import threading
+
+def one(a, b):
+    with a._a_lock:
+        with b._b_lock:
+            pass
+
+def two(a, b):
+    with b._b_lock:
+        with a._a_lock:
+            pass
+""")
+    assert project.lock_edges() == {}
+    assert pass_locks.check_lock_cycles(project) == []
+
+
+def test_interproc_blocking_under_lock_fires_jl903(tmp_path):
+    project = project_of(tmp_path, """
+import os, threading
+
+class J:
+    def __init__(self):
+        self._cv = threading.Condition()
+
+    def disk(self):
+        os.fsync(3)
+
+    def caller(self):
+        with self._cv:
+            self.disk()
+
+    def fine(self):
+        with self._cv:
+            f = 1
+        self.disk()
+""")
+    findings = pass_locks.check_blocking_under_lock(project)
+    assert [f.rule for f in findings] == ["JL903"]
+    assert "caller" in findings[0].src or "self.disk" in findings[0].msg
+
+
+def test_real_repo_lock_order_clean():
+    """Full-repo clean: no await under a threading lock, no lock cycle,
+    every under-lock blocking call suppressed with a documented
+    protocol."""
+    project = Project.load()
+    assert pass_locks.check_await_under_lock(project) == []
+    assert pass_locks.check_lock_cycles(project) == []
+    findings = pass_locks.check_blocking_under_lock(project)
+    jlint.apply_suppressions(findings, project.by_rel)
+    assert [f for f in findings if not f.suppressed] == []
+
+
+# ---- suppression hygiene (JL002/JL003) --------------------------------------
+
+
+def test_suppression_without_reason_fires_jl002(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text("""
+try:
+    x = 1
+except Exception:  # jlint: broad-ok
+    pass
+""")
+    src = jlint.Source.load(str(p), root=str(tmp_path))
+    findings = pass_async.run([src])
+    problems = jlint.check_inline_suppressions(findings, {src.rel: src})
+    assert any(f.rule == "JL002" for f in problems)
+    assert not any(f.rule == "JL003" for f in problems)  # it does fire
+
+
+def test_stale_suppression_fires_jl003(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text("""
+x = 1  # jlint: broad-ok — nothing broad here any more
+""")
+    src = jlint.Source.load(str(p), root=str(tmp_path))
+    problems = jlint.check_inline_suppressions([], {src.rel: src})
+    assert [f.rule for f in problems] == ["JL003"]
+
+
+def test_block_comment_suppression_covers_next_code_line(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text("""
+try:
+    x = 1
+# jlint: broad-ok — a two-line justification explaining
+# exactly why swallowing everything is correct here
+except Exception:
+    pass
+""")
+    src = jlint.Source.load(str(p), root=str(tmp_path))
+    findings = pass_async.run([src])
+    jlint.apply_suppressions(findings, {src.rel: src})
+    assert all(f.suppressed for f in findings)
+    problems = jlint.check_inline_suppressions(findings, {src.rel: src})
+    assert problems == []
+
+
+def test_shared_lockio_slug_counts_either_rule_as_live(tmp_path):
+    """lockio-ok is honored by JL104 (syntactic) AND JL903
+    (interprocedural): a suppression is live when either fires."""
+    assert jlint.SLUG_RULES["lockio-ok"] == {"JL104", "JL903"}
+
+
+def test_nested_def_blocking_is_visible_interprocedurally(tmp_path):
+    """A blocking call hidden in a LOCAL helper must not escape the
+    interprocedural JL101: nested defs summarise on their own quals and
+    bare-name calls to them resolve locally."""
+    project = project_of(tmp_path, """
+import os
+
+async def handler(dd):
+    def flush():
+        os.fsync(3)
+    flush()
+""")
+    assert any("<locals>.flush" in q for q in project.functions)
+    bad = pass_async.run_interprocedural(project)
+    assert [f.rule for f in bad] == ["JL101"]
+    assert "os.fsync" in bad[0].msg
+
+
+def test_syntax_error_writes_artifact_and_exits_2(tmp_path):
+    """An unparseable file is a clean diagnostic + exit 2 AND the --out
+    CI artifact still lands (red builds are when it matters)."""
+    from scripts.jlint.__main__ import run_all
+
+    d = tmp_path / "jylis_tpu"
+    d.mkdir()
+    (d / "bad.py").write_text("def broken(:\n")
+    out = tmp_path / "findings.json"
+    rc = run_all(root=str(tmp_path), out_path=str(out))
+    assert rc == 2
+    payload = json.loads(out.read_text())
+    assert payload["exit"] == 2 and "unparseable" in payload["error"]

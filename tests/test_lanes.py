@@ -505,3 +505,136 @@ def test_supervisor_child_argv_overrides(tmp_path):
         assert [lane["id"] for lane in manifest["lanes"]] == [0, 1]
 
     asyncio.run(main())
+
+
+def test_supervisor_manifest_write_runs_off_the_event_loop(tmp_path, monkeypatch):
+    """Regression (jlint v2 interprocedural JL101): `run()` and
+    `_lane_died()` called `write_manifest` — open/json.dump/os.replace —
+    directly on the supervisor event loop, which also carries every
+    lane's death-watcher, signal handling, and the aggregated metrics
+    endpoint. A contended disk during a crash-respawn storm stalled all
+    three. The write now dispatches through write_manifest_async: a
+    slow manifest write must not freeze the loop."""
+
+    async def main():
+        import threading
+        import time as _time
+
+        cfg = Config()
+        cfg.port = "0"
+        cfg.addr = Address("127.0.0.1", "9999", "supnode")
+        cfg.lanes = 2
+        cfg.data_dir = str(tmp_path)
+        cfg.log = Log.create_none()
+        sup = lanes_mod.Supervisor(cfg, ["--port", "0", "--lanes", "2"])
+
+        wrote_on: list = []
+        real = lanes_mod.Supervisor.write_manifest
+
+        def slow_write(self):
+            wrote_on.append(threading.current_thread())
+            _time.sleep(0.3)  # the contended-disk shape
+            real(self)
+
+        monkeypatch.setattr(lanes_mod.Supervisor, "write_manifest", slow_write)
+
+        # a loop heartbeat: the largest gap between ticks is the stall
+        gaps: list[float] = []
+
+        async def ticker():
+            last = asyncio.get_running_loop().time()
+            while True:
+                await asyncio.sleep(0.01)
+                now = asyncio.get_running_loop().time()
+                gaps.append(now - last)
+                last = now
+
+        t = asyncio.ensure_future(ticker())
+        try:
+            await sup.write_manifest_async()
+        finally:
+            t.cancel()
+        assert wrote_on and wrote_on[0] is not threading.main_thread()
+        # the loop kept ticking THROUGH the 0.3 s write (pre-fix the
+        # direct call would produce one >=0.3 s gap)
+        assert gaps and max(gaps) < 0.15, max(gaps)
+        # and the manifest really landed
+        manifest = json.load(open(os.path.join(str(tmp_path), "lanes.json")))
+        assert [lane["id"] for lane in manifest["lanes"]] == [0, 1]
+
+    asyncio.run(main())
+
+
+def test_lane_died_writes_manifest_off_loop(tmp_path, monkeypatch):
+    """The crash-respawn path itself (`_lane_died`) must use the
+    threaded manifest write — pinned by driving it with a stubbed spawn
+    and asserting the write thread."""
+
+    async def main():
+        import threading
+
+        cfg = Config()
+        cfg.port = "0"
+        cfg.addr = Address("127.0.0.1", "9999", "supnode")
+        cfg.lanes = 2
+        cfg.data_dir = str(tmp_path)
+        cfg.log = Log.create_none()
+        sup = lanes_mod.Supervisor(cfg, ["--port", "0", "--lanes", "2"])
+        monkeypatch.setattr(lanes_mod, "RESTART_BACKOFF_S", 0.0)
+        monkeypatch.setattr(
+            lanes_mod.Supervisor, "_spawn", lambda self, k: None
+        )
+        wrote_on: list = []
+        real = lanes_mod.Supervisor.write_manifest
+
+        def recording_write(self):
+            wrote_on.append(threading.current_thread())
+            real(self)
+
+        monkeypatch.setattr(
+            lanes_mod.Supervisor, "write_manifest", recording_write
+        )
+        await sup._lane_died(1)
+        assert wrote_on and wrote_on[0] is not threading.main_thread()
+
+    asyncio.run(main())
+
+
+def test_concurrent_manifest_writes_serialise(tmp_path, monkeypatch):
+    """Two lanes dying near-simultaneously drive write_manifest_async
+    concurrently; the writes share ONE fixed lanes.json.tmp path, so
+    they must serialise (the on-loop call was implicitly serial; the
+    off-loop fix carries an explicit lock) — interleaved writers would
+    publish corrupt JSON."""
+
+    async def main():
+        import time as _time
+
+        cfg = Config()
+        cfg.port = "0"
+        cfg.addr = Address("127.0.0.1", "9999", "supnode")
+        cfg.lanes = 2
+        cfg.data_dir = str(tmp_path)
+        cfg.log = Log.create_none()
+        sup = lanes_mod.Supervisor(cfg, ["--port", "0", "--lanes", "2"])
+        spans: list = []
+        real = lanes_mod.Supervisor.write_manifest
+
+        def slow_write(self):
+            t0 = _time.monotonic()
+            _time.sleep(0.15)
+            real(self)
+            spans.append((t0, _time.monotonic()))
+
+        monkeypatch.setattr(lanes_mod.Supervisor, "write_manifest", slow_write)
+        await asyncio.gather(
+            sup.write_manifest_async(), sup.write_manifest_async()
+        )
+        assert len(spans) == 2
+        (a0, a1), (b0, b1) = sorted(spans)
+        assert b0 >= a1, "concurrent manifest writes overlapped"
+        # and the published file is valid JSON
+        manifest = json.load(open(os.path.join(str(tmp_path), "lanes.json")))
+        assert [lane["id"] for lane in manifest["lanes"]] == [0, 1]
+
+    asyncio.run(main())
