@@ -5,7 +5,7 @@ PY ?= python
 
 .PHONY: test soak bench bench-all bench-full bench-smoke native run clean \
         check-graft ci check-prose image compose-smoke smoke3 release \
-        lint lint-native sanitize chaos metrics-smoke
+        lint lint-native sanitize chaos metrics-smoke model-smoke
 
 # what CI runs per commit (.github/workflows/ci.yml + .circleci/config.yml):
 # hermetic on any host. `test` includes the journal suite
@@ -15,14 +15,15 @@ PY ?= python
 # RESP surface parity, failpoint manifest parity); `sanitize` rebuilds the
 # native engine under ASAN+UBSAN with -Werror and re-runs the jax-free
 # native test subset; `chaos` is the tiny fault-injection drill smoke.
-ci: native lint lint-native test chaos check-graft check-prose bench-smoke \
-    metrics-smoke sanitize
+ci: native lint lint-native test chaos model-smoke check-graft check-prose \
+    bench-smoke metrics-smoke sanitize
 
-# the nine jlint passes + the hygiene rules (broad-except, suppression
+# the ten jlint passes + the hygiene rules (broad-except, suppression
 # reasons/staleness), against the committed baseline
 # (scripts/jlint/baseline.json — every entry justified in-line, stale
 # entries fail). The manifest checks (RESP parity, failpoints, metrics,
-# lane shared-state, codec symmetry, lattice discipline) re-extract
+# lane shared-state, codec symmetry, lattice discipline, protocol
+# atlas) re-extract
 # their surfaces on every run and fail on uncommitted drift; regenerate
 # with `$(PY) -m scripts.jlint --write-manifest` (then `--write-corpus`
 # if the codec manifest changed) and commit the diff. `--budget` fails
@@ -85,6 +86,24 @@ test:
 # matrix plus the 3-node lane drills run nightly behind `-m soak`.
 chaos:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_drill_matrix.py -m chaos -q
+
+# jmodel: bounded explicit-state exploration of the cluster + lane-bus
+# protocol (scripts/jmodel). Drives the REAL Cluster handler code over
+# an in-memory deterministic network (virtual clock + pipe transport
+# through cluster.py's injectable clock/connect seams), enumerating
+# delivery schedules — reorder across conns, drop (conn kill),
+# duplicate, partition, crash-reboot-from-journal — over the 2-node,
+# 3-node and 2-lane-bus configs with state-hash dedup and sleep-set
+# partial-order reduction. Asserts, per state: lattice monotonicity,
+# held-queue FIFO + bound, dial-backoff monotonicity; at quiescence:
+# digest match on every replica, no stranded rtt stamps, nothing in
+# flight. The run must cover >= the recorded model_min_states distinct
+# states and finish inside model_budget_seconds (both in
+# scripts/jlint/budget.json). Deeper sweep nightly via `-m soak`
+# (tests/test_model.py); minimized counterexamples replay from
+# tests/model/ in tier-1.
+model-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m scripts.jmodel --smoke --budget
 
 # nightly CI: the long-running real-process churn/crash drills, including
 # the SIGKILL-mid-traffic journal recovery soak and the full

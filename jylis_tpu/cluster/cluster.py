@@ -114,6 +114,36 @@ _WIRE_ORIGIN_LEN = 8
 _now_ms = now_ms
 
 
+class Clock:
+    """Injectable time source for one Cluster instance. Production runs
+    on wall time (this class); jmodel (scripts/jmodel) substitutes a
+    virtual clock that advances only when the explorer says so, which is
+    what makes exhaustive schedule exploration deterministic and
+    wall-time-free. ``now_ms`` feeds origin stamps, held-delta ages and
+    the backlog gauge; ``perf`` feeds the rtt histogram's send→Pong
+    stamps."""
+
+    __slots__ = ()
+
+    def now_ms(self) -> int:
+        return _now_ms()
+
+    def perf(self) -> float:
+        return time.perf_counter()
+
+
+REAL_CLOCK = Clock()
+
+
+async def tcp_connect(addr: Address):
+    """The default transport seam: one real TCP dial. jmodel swaps this
+    for an in-memory pipe factory; everything above the seam — the dial
+    state machine, handshake, read loop, every message handler — is the
+    same code either way (the explorer drives the REAL protocol, not a
+    re-model)."""
+    return await asyncio.open_connection(addr.host, int(addr.port))
+
+
 def wire_frame(body: bytes, origin_ms: int | None = None) -> bytes:
     """One cluster transport frame: framing header + crc32(stamp+body)
     + origin stamp + body. ``origin_ms`` defaults to now."""
@@ -149,6 +179,25 @@ class Drop:
     UNEXPECTED = "unexpected_msg"
     DISPOSED = "disposed"
     BLACKLISTED = "blacklisted"
+
+
+class MsgDrop:
+    """DECLARED message-level drops: a frame that arrives outside the
+    protocol's expected (role, state, message) envelope is discarded —
+    the connection stays up — but never silently: each drop is counted
+    per reason (``msg_drop_<reason>`` in the CLUSTER metrics section)
+    and traced. jlint pass 10's protocol atlas enumerates exactly these
+    sites, so a new silent fall-through cannot be added unreviewed."""
+
+    # a Pong on a passive conn: we never send Pong-soliciting frames on
+    # passive conns, so nothing can legitimately answer with one
+    PONG_UNSOLICITED = "pong_unsolicited"
+    # a Pong on an active conn with no outstanding stamped send — the
+    # peer ponged something we never asked about (or double-ponged)
+    PONG_UNMATCHED = "pong_unmatched"
+    # a SyncDone on a passive conn: sync replies close OUR requests,
+    # which only ever go out on active conns
+    SYNC_DONE_UNSOLICITED = "sync_done_unsolicited"
 
 
 # active-conn teardown reasons that mean the PEER (not the network)
@@ -269,10 +318,19 @@ class Cluster:
         database,
         drive_flush: bool = True,
         register_system: bool = True,
+        clock: Clock | None = None,
+        connect=None,
     ):
         self._config = config
         self._database = database
         self._log = config.log
+        # injectable clock + transport (jmodel's two seams): defaults
+        # are wall time and real TCP; the explorer passes a virtual
+        # clock and an in-memory pipe factory. Everything downstream of
+        # these two calls is identical in production and under the model
+        # checker.
+        self._clock = clock or REAL_CLOCK
+        self._connect = connect or tcp_connect
         # multi-lane bridge hooks (lanes.py). A node running N serving
         # lanes has TWO Cluster instances on lane 0 — the external mesh
         # on config.addr and the loopback lane bus — sharing ONE
@@ -317,10 +375,14 @@ class Cluster:
         # reasons; live peer-state counts are computed on demand
         self._stats = {
             "dials": 0, "dial_fails": 0,
-            "sync_served": 0, "sync_deferred": 0,
+            "sync_served": 0, "sync_deferred": 0, "sync_done_recv": 0,
             "held_drops": 0,
         }
         self._drop_counts: dict[str, int] = {}
+        # declared message-level drops (MsgDrop reasons): frame
+        # discarded, conn kept — counted so an out-of-envelope peer is
+        # visible in SYSTEM METRICS instead of silently tolerated
+        self._msg_drops: dict[str, int] = {}
         self._held_drop_episode = False  # warn once per eviction episode
         self._tick = 0
         self._serial = codec.signature()
@@ -491,6 +553,7 @@ class Cluster:
             "evictions": sum(self._drop_counts.values()),
             "sync_served": self._stats["sync_served"],
             "sync_deferred": self._stats["sync_deferred"],
+            "sync_done_recv": self._stats["sync_done_recv"],
             "held_now": len(self._held),
             "held_drops": self._stats["held_drops"],
             # the time dimension of anti-entropy health: worst per-peer
@@ -502,6 +565,8 @@ class Cluster:
         }
         for reason in sorted(self._drop_counts):
             out[f"drop_{reason}"] = self._drop_counts[reason]
+        for reason in sorted(self._msg_drops):
+            out[f"msg_drop_{reason}"] = self._msg_drops[reason]
         return out
 
     # ---- convergence lag / backlog (obs) -----------------------------------
@@ -534,7 +599,7 @@ class Cluster:
         """Age of the oldest held delta batch, or of the current
         sync-serve defer episode — whichever says work has been waiting
         longer. Published as the cluster.backlog_ms gauge."""
-        now = _now_ms()
+        now = self._clock.now_ms()
         age = float(now - self._held[0][0]) if self._held else 0.0
         if self._defer_since_ms is not None:
             age = max(age, float(now - self._defer_since_ms))
@@ -584,7 +649,7 @@ class Cluster:
             # cluster.dial: error -> the OSError recovery path below;
             # sleep -> a blackholed connect, which wait_for then bounds
             await faults.async_point("cluster.dial")
-            return await asyncio.open_connection(addr.host, int(addr.port))
+            return await self._connect(addr)
 
         try:
             # the OS would let a blackholed connect hang for minutes;
@@ -606,7 +671,7 @@ class Cluster:
         # the passive side can identify this peer (teardown logs) and
         # reset its own dial backoff toward us (inbound contact proves
         # the address is alive again)
-        conn.send_raw(wire_frame(self._serial + codec.encode_addr(self._addr)))
+        conn.send_raw(self._wire(self._serial + codec.encode_addr(self._addr)))
         await self._read_loop(conn, reader, active=True)
 
     def _active_missed(self, addr: Address) -> None:
@@ -756,7 +821,7 @@ class Cluster:
             self._maybe_request_sync(conn)
         else:
             # passive side echoes the signature back
-            conn.send_raw(wire_frame(self._serial))
+            conn.send_raw(self._wire(self._serial))
         return True
 
     # ---- message handling --------------------------------------------------
@@ -778,7 +843,7 @@ class Cluster:
         50-year lag)."""
         if origin_ms and self._reg.enabled:
             self._note_lag(
-                self._peer_key(conn), max(_now_ms() - origin_ms, 0)
+                self._peer_key(conn), max(self._clock.now_ms() - origin_ms, 0)
             )
 
     async def _active_msg(self, conn: _Conn, msg, origin_ms: int = 0) -> None:
@@ -791,13 +856,22 @@ class Cluster:
             # switch gates only the record, so a mid-conn toggle can
             # never strand stamps and shift later matches
             if conn.pong_sent:
-                dt = time.perf_counter() - conn.pong_sent.popleft()
+                dt = self._clock.perf() - conn.pong_sent.popleft()
                 if self._reg.enabled and self._obs_primary:
                     self._h_rtt.record(dt)
+            else:
+                # nothing outstanding answers this Pong — an
+                # out-of-envelope peer, declared and counted (a silent
+                # ignore here would hide a double-ponging peer forever)
+                self._drop_msg(conn, MsgDrop.PONG_UNMATCHED)
             return  # liveness only
         if isinstance(msg, MsgSyncDone):
-            return  # sync reply: liveness only (requester re-pulls by
-            # cooldown; a deferred or matched request needs no data)
+            # sync reply closing our request: no data needed (deferred /
+            # digest-matched / end-of-dump — the requester re-pulls by
+            # cooldown either way). Counted so the requester side of the
+            # sync conversation is observable, not a silent ignore.
+            self._stats["sync_done_recv"] += 1
+            return
         if isinstance(msg, MsgExchangeAddrs):
             self._converge_addrs(msg.known_addrs)
             return
@@ -817,7 +891,17 @@ class Cluster:
         self._drop(conn, Drop.UNEXPECTED)
 
     async def _passive_msg(self, conn: _Conn, msg, origin_ms: int = 0) -> None:
-        if isinstance(msg, (MsgPong, MsgSyncDone)):
+        if isinstance(msg, MsgPong):
+            # we never send Pong-soliciting frames on a passive conn, so
+            # no Pong can legitimately arrive here: declared drop (the
+            # frame, not the conn — one stray message is not a protocol
+            # violation worth a teardown + redial churn)
+            self._drop_msg(conn, MsgDrop.PONG_UNSOLICITED)
+            return
+        if isinstance(msg, MsgSyncDone):
+            # sync replies close requests WE made, which only go out on
+            # active conns — same declared-drop policy as the stray Pong
+            self._drop_msg(conn, MsgDrop.SYNC_DONE_UNSOLICITED)
             return
         if isinstance(msg, MsgExchangeAddrs):
             # full sync: converge then reply with our own set
@@ -918,7 +1002,7 @@ class Cluster:
                     if self._defer_since_ms is None:
                         # the backlog gauge's defer clock: how long
                         # rejoiners have been waiting on this node
-                        self._defer_since_ms = _now_ms()
+                        self._defer_since_ms = self._clock.now_ms()
                     self._log.info() and self._log.i(
                         "sync: mid-heal, deferring dump "
                         f"(streak {conn.sync_defer_streak}, "
@@ -1020,7 +1104,7 @@ class Cluster:
                 stack.append(chunk[mid:])
                 stack.append(chunk[:mid])
                 continue
-            yield wire_frame(data)
+            yield self._wire(data)
 
     async def _system_frames(self) -> list[bytes]:
         """The SYSTEM log as sync frames, dumped fresh (it is tiny —
@@ -1028,7 +1112,7 @@ class Cluster:
         a digest-matched peer still recovers log lines it missed)."""
         dump = await self._database.dump_state_async(names=("SYSTEM",))
         return [
-            wire_frame(codec.encode(MsgPushDeltas(name, tuple(batch))))
+            self._wire(codec.encode(MsgPushDeltas(name, tuple(batch))))
             for name, batch in dump
         ]
 
@@ -1165,6 +1249,13 @@ class Cluster:
 
     # ---- sending -----------------------------------------------------------
 
+    def _wire(self, body: bytes) -> bytes:
+        """One transport frame origin-stamped by THIS instance's clock
+        (virtual under jmodel, wall time in production) — every send in
+        this class goes through here so no frame can pick up a wall
+        stamp behind the seam's back."""
+        return wire_frame(body, origin_ms=self._clock.now_ms())
+
     def broadcast_deltas(self, deltas) -> None:
         """The _SendDeltasFn sink (cluster.pony:209-213): serialise the batch
         once, write to every established active connection. Anything
@@ -1176,7 +1267,7 @@ class Cluster:
             # outbound data deltas exist only for LOCAL applies: the
             # signal that defers the periodic digest pull (heartbeat)
             self._local_writes_seen = True
-        data = wire_frame(codec.encode(MsgPushDeltas(name, tuple(batch))))
+        data = self._wire(codec.encode(MsgPushDeltas(name, tuple(batch))))
         self._flush_held()
         if self._held or not self._send_to_actives(data, expect_pong=True):
             # nobody reachable right now (maybe nobody known yet): hold
@@ -1185,7 +1276,7 @@ class Cluster:
             # (deltas_size()==1 quirk) carry nothing and would FIFO-evict
             # real pre-join writes on a long-solo node — don't hold those.
             if self._worth_holding(name, batch):
-                self._held.append((_now_ms(), data))
+                self._held.append((self._clock.now_ms(), data))
                 over = len(self._held) - self._held_cap
                 if over > 0:
                     # oldest-first eviction at the cap: DOCUMENTED data
@@ -1218,7 +1309,7 @@ class Cluster:
                         # EXCEPT an injected-drop "send": no frame left,
                         # no Pong comes, the stamp would strand and
                         # shift every later match by one
-                        conn.pong_sent.append(time.perf_counter())
+                        conn.pong_sent.append(self._clock.perf())
                 else:
                     self._drop(conn, Drop.WRITE_FAILED)
         return sent
@@ -1247,15 +1338,26 @@ class Cluster:
 
     def _broadcast_msg(self, msg) -> None:
         self._send_to_actives(
-            wire_frame(codec.encode(msg)),
+            self._wire(codec.encode(msg)),
             expect_pong=isinstance(msg, MsgAnnounceAddrs),
         )
 
     def _send(self, conn: _Conn, msg) -> None:
-        if not conn.send_raw(wire_frame(codec.encode(msg))):
+        if not conn.send_raw(self._wire(codec.encode(msg))):
             self._drop(conn, Drop.WRITE_FAILED)
 
     # ---- connection teardown -----------------------------------------------
+
+    def _drop_msg(self, conn: _Conn, reason: str) -> None:
+        """A DECLARED message drop (MsgDrop reasons): the frame is
+        discarded, the connection stays up, and the event is counted
+        (``msg_drop_<reason>`` in CLUSTER metrics) and traced — never a
+        silent fall-through. The protocol atlas (jlint pass 10) extracts
+        these sites, so every ignore in the handlers is reviewed."""
+        self._msg_drops[reason] = self._msg_drops.get(reason, 0) + 1
+        self._reg.trace_event(
+            "cluster", "msg_drop", reason, self._conn_desc(conn)
+        )
 
     def _mark_activity(self, conn: _Conn) -> None:
         self._last_activity[conn] = self._tick

@@ -160,6 +160,18 @@ REPO_CLAIMS = [
     ("docs/development.md", "scripts/jlint/codec_manifest.json",
      lambda d: len(d["units"]), str,
      "({} units:", "development doc codec unit count"),
+    # jmodel round: the smoke's recorded coverage + time and the
+    # enforced floor are repo records (budget.json model_* entries) —
+    # the prose must track them exactly like the lint budget
+    ("docs/development.md", "scripts/jlint/budget.json",
+     lambda d: d["model_recorded_states"], str,
+     "explores {} distinct", "development doc jmodel recorded states"),
+    ("docs/development.md", "scripts/jlint/budget.json",
+     lambda d: d["model_recorded_seconds"], lambda v: f"~{v:.0f} s",
+     "in {} on the recording host", "development doc jmodel recorded time"),
+    ("docs/development.md", "scripts/jlint/budget.json",
+     lambda d: d["model_min_states"], lambda v: f"{v / 1000:.0f}k-state floor",
+     "below the {}", "development doc jmodel state floor"),
 ]
 
 

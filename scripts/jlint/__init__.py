@@ -62,6 +62,14 @@ calls through the call graph and powers three semantic passes:
   while holding a threading lock, lock-acquisition cycles over the
   global lock graph, and blocking I/O reachable under a held lock
   interprocedurally (the case pass 1's syntactic JL104 missed).
+* **Pass 10 — protocol atlas** (`pass_protocol`, JL100x): the full
+  transition relation of the cluster protocol — (role, state, message)
+  → permitted effects (sends, converges, state mutations, teardown
+  reasons, metric/trace emissions) — extracted from ``cluster.py``'s
+  handler dispatch, handshake, sync machinery and dial state machine
+  into the committed ``protocol_manifest.json``. Undeclared effects,
+  silent fall-throughs, and manifest drift fail; jmodel
+  (``scripts/jmodel``) explores the same protocol dynamically.
 
 Plus the hygiene rules: JL001 (``except Exception`` / bare ``except``
 without justification), JL002 (an inline suppression carrying no
@@ -134,6 +142,9 @@ RULES = {
     "JL901": ("awaitlock-ok", "`await` while holding a threading lock"),
     "JL902": (None, "lock-acquisition cycle across the thread/loop seams (potential deadlock)"),
     "JL903": ("lockio-ok", "blocking call reachable under a held lock through the call graph"),
+    "JL1001": (None, "cluster protocol handler effect outside the committed atlas (protocol_manifest.json)"),
+    "JL1002": (None, "undeclared (role, state, msg) fall-through or silent ignore in a cluster protocol handler"),
+    "JL1003": (None, "protocol manifest drift, missing, or undescribed (--write-manifest regenerates)"),
 }
 
 # slug -> every rule that honors it (JL104/JL903 share lockio-ok; the

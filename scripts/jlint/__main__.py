@@ -3,12 +3,13 @@
 Exit 0 only when every pass is clean: no unsuppressed finding, no stale
 baseline entry or inline suppression, no manifest drift. One semantic
 core (scripts/jlint/core.py) is built per run — content-hash-cached
-ASTs, call graph, per-function summaries — and all nine passes consume
+ASTs, call graph, per-function summaries — and all ten passes consume
 it.
 
 * ``--write-manifest`` regenerates every committed manifest (parity,
   failpoints, metrics, lanes, codec, lattice + the generated lattice
-  property harness) in place and exits: commit the diff.
+  property harness, protocol atlas) in place and exits: commit the
+  diff.
 * ``--write-corpus`` regenerates the golden codec corpus
   (tests/golden/codec_corpus.json) from the current codec manifest
   (imports the product; run after any --write-manifest that changed
@@ -17,7 +18,7 @@ it.
   line, message, suppressed) plus per-pass wall times — the CI artifact
   finding-count drift is diffed across.
 * ``--budget`` enforces the recorded wall-time bound in
-  scripts/jlint/budget.json: nine passes must not erode the commit
+  scripts/jlint/budget.json: ten passes must not erode the commit
   loop, so `make lint` fails if the run blows the budget.
 """
 
@@ -46,6 +47,7 @@ from . import (
     pass_locks,
     pass_metrics,
     pass_parity,
+    pass_protocol,
 )
 from .core import Project
 
@@ -57,7 +59,7 @@ JAX_SCOPE = ("jylis_tpu/ops",)
 
 BUDGET_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "budget.json")
 
-N_PASSES = 9
+N_PASSES = 10
 
 
 def run_all(
@@ -111,6 +113,7 @@ def run_all(
     findings += timed("4:failpoints", pass_failpoints.check)
     findings += timed("5:metrics", pass_metrics.check)
     findings += timed("7:codec", pass_codec.check)
+    findings += timed("10:protocol", pass_protocol.check)
     findings += timed("8:lattice", pass_lattice.check_manifest, project)
     findings += problems
     findings += hygiene
@@ -144,7 +147,7 @@ def run_all(
         if bound is not None and total > bound:
             print(
                 f"jlint: BUDGET EXCEEDED — {total:.2f}s > {bound:.1f}s "
-                "(scripts/jlint/budget.json). Nine passes must not erode "
+                "(scripts/jlint/budget.json). Ten passes must not erode "
                 "the commit loop: profile with -v, fix the slow pass, or "
                 "re-record the bound with a justification.",
                 file=sys.stderr,
@@ -215,6 +218,19 @@ def write_manifests(project: Project | None = None) -> None:
         f"lattice manifest written: {len(lat['merge_roots'])} merge roots, "
         f"{len(lat['types'])} harness types (tests/test_lattice_laws.py "
         "regenerated)"
+    )
+    proto = pass_protocol.write_manifest()
+    n_entries = sum(len(v) for v in proto["sections"].values())
+    todo = sum(
+        1
+        for sec in proto["sections"].values()
+        for e in sec.values()
+        if e["note"] == pass_protocol.PLACEHOLDER
+    )
+    print(
+        f"protocol manifest written: {n_entries} transitions across "
+        f"{len(proto['sections'])} sections"
+        + (f" ({todo} need notes)" if todo else "")
     )
 
 

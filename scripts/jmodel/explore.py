@@ -1,0 +1,294 @@
+"""Bounded DFS over delivery schedules with dedup + sleep sets.
+
+The explorer cannot snapshot a live asyncio world, so the search is
+replay-based: a state IS its action trace from the initial state, and
+visiting a state replays the trace into a fresh :class:`~.world.World`
+(determinism makes that sound — the same trace always lands on the
+same state). Two reductions keep the frontier tractable:
+
+* **state-hash dedup** — ``World.state_hash()`` canonicalises all
+  protocol-relevant state; a hash seen before prunes the subtree
+  (different interleavings that commute collapse here);
+* **sleep sets** — when actions ``a`` and ``b`` touch disjoint node
+  groups they commute (a delivery mutates only its receiver + appends
+  to that receiver's own outboxes), so after exploring ``a`` first the
+  ``b``-subtree carries ``a`` in its sleep set and never re-fires it
+  immediately — the classic partial-order reduction, sound because the
+  independence relation is conservative (structural actions — kill,
+  crash, partition, heal — are dependent with everything).
+
+Invariants run at every DISTINCT state; the expensive global check
+(``World.quiesce()``: heal + run to fixpoint + digest match everywhere)
+runs at a deterministic sample of depth-bound leaves. A violation
+raises out of the search with its trace, which :func:`minimize` shrinks
+ddmin-style (drop actions while the same invariant still fails) into a
+schedule file — the replayable regression artifact committed under
+``tests/model/``.
+
+Callers must hold :func:`scripts.jmodel.model_periods` open around any
+exploration or replay — schedules are defined against the shrunk
+protocol periods.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .world import Runtime, Violation, World
+
+SCHEDULE_SCHEMA = 1
+
+
+class _Found(Exception):
+    def __init__(self, trace, violation: Violation):
+        super().__init__(str(violation))
+        self.trace = list(trace)
+        self.violation = violation
+
+
+class _Done(Exception):
+    pass
+
+
+@dataclass
+class Result:
+    config: str
+    depth: int
+    states: int = 0
+    leaves: int = 0
+    quiesced: int = 0
+    violation: dict | None = None
+    schedule: dict | None = None
+    capped: bool = False
+
+
+def _group_of(instance_key: str) -> str:
+    return instance_key.split(".", 1)[0]
+
+
+def _touched(action: tuple) -> frozenset | None:
+    """Node groups an action can affect, or None for structural actions
+    (dependent with everything)."""
+    kind = action[0]
+    if kind == "tick":
+        return frozenset((_group_of(action[1]),))
+    if kind in ("deliver", "dup"):
+        cid, direction = action[1], action[2]
+        dialer, rest = cid.split(">", 1)
+        target = rest.split("#", 1)[0]
+        recv = target if direction == "fwd" else dialer
+        return frozenset((_group_of(recv),))
+    if kind == "write":
+        return frozenset((action[1],))
+    return None  # kill / crash / part / heal
+
+
+def independent(a: tuple, b: tuple) -> bool:
+    ta, tb = _touched(a), _touched(b)
+    return ta is not None and tb is not None and ta.isdisjoint(tb)
+
+
+class Explorer:
+    def __init__(
+        self,
+        config: str,
+        depth: int,
+        budgets: dict | None = None,
+        quiesce_every: int = 16,
+        max_states: int | None = None,
+    ):
+        self.config = config
+        self.depth = depth
+        self.budgets = budgets
+        self.quiesce_every = quiesce_every
+        self.max_states = max_states
+        self.visited: set[str] = set()
+        self.leaves = 0
+        self.quiesced = 0
+        self._runtime: Runtime | None = None
+
+    def _replay(self, trace) -> World:
+        world = World(self.config, self.budgets, runtime=self._runtime)
+        try:
+            for action in trace:
+                applied = world.apply(tuple(action))
+                assert applied, f"replay of own trace lost {action}"
+            return world
+        # jlint: broad-ok — cleanup-and-reraise: the world (its tasks
+        # parked on the shared runtime loop) must be torn down on ANY
+        # failure, including KeyboardInterrupt, before propagating
+        except BaseException:
+            world.close()
+            raise
+
+    def run(self) -> Result:
+        result = Result(self.config, self.depth)
+        self._runtime = Runtime()
+        try:
+            self._dfs([], frozenset())
+        except _Found as f:
+            result.violation = {
+                "invariant": f.violation.name,
+                "detail": f.violation.detail,
+            }
+            minimized = minimize(
+                self.config, f.trace, f.violation.name, self.budgets,
+                runtime=self._runtime,
+            )
+            result.schedule = schedule_dict(
+                self.config, minimized, expect=f.violation.name,
+                note=f.violation.detail,
+            )
+        except _Done:
+            result.capped = True
+        finally:
+            self._runtime.close()
+        result.states = len(self.visited)
+        result.leaves = self.leaves
+        result.quiesced = self.quiesced
+        return result
+
+    def _dfs(self, trace: list, sleep: frozenset, world=None) -> None:
+        """Visit the state `trace` lands on. ``world`` may carry the
+        already-positioned World (first-child descent hands its own
+        down, saving one full replay per internal node); ownership
+        transfers — this frame closes it or hands it on."""
+        if world is None:
+            world = self._replay(trace)
+        actions = None
+        try:
+            h = world.state_hash()
+            if h in self.visited:
+                return
+            self.visited.add(h)
+            if self.max_states and len(self.visited) >= self.max_states:
+                raise _Done
+            try:
+                world.check_invariants()
+            except Violation as v:
+                raise _Found(trace, v) from None
+            if len(trace) >= self.depth:
+                self.leaves += 1
+                # quiesce a deterministic sample of leaves (plus always
+                # the first): the global laws are expensive — a fixpoint
+                # run per leaf would dwarf the search itself
+                if (self.leaves - 1) % self.quiesce_every == 0:
+                    self.quiesced += 1
+                    try:
+                        world.quiesce()
+                    except Violation as v:
+                        raise _Found(trace + [("quiesce",)], v) from None
+                return
+            actions = [
+                a for a in (tuple(x) for x in world.enabled_actions())
+                if a not in sleep
+            ]
+        finally:
+            if actions is None:
+                world.close()
+        explored: list[tuple] = []
+        for i, action in enumerate(actions):
+            child_sleep = frozenset(
+                other
+                for other in (set(sleep) | set(explored))
+                if independent(other, action)
+            )
+            if i == 0:
+                # descend in place: this world becomes the first child's
+                try:
+                    applied = world.apply(action)
+                    assert applied, f"frontier action {action} not enabled"
+                # jlint: broad-ok — cleanup-and-reraise before handing
+                # the world down (same teardown contract as _replay)
+                except BaseException:
+                    world.close()
+                    raise
+                self._dfs(trace + [action], child_sleep, world=world)
+            else:
+                self._dfs(trace + [action], child_sleep)
+            explored.append(action)
+        if not actions:
+            world.close()
+
+
+# ---- schedules (the replayable counterexample artifact) ---------------------
+
+
+def schedule_dict(
+    config: str, actions, expect: str = "pass", note: str = ""
+) -> dict:
+    return {
+        "schema": SCHEDULE_SCHEMA,
+        "config": config,
+        "actions": [list(a) for a in actions],
+        # "pass" = regression corpus entry (the defect this schedule
+        # found is fixed; replay must hold every invariant). An
+        # invariant name = a live counterexample under triage.
+        "expect": expect,
+        "note": note,
+    }
+
+
+def replay_schedule(
+    data: dict, budgets: dict | None = None, runtime: Runtime | None = None
+):
+    """Replay one schedule file's actions; returns the Violation hit,
+    or None if every invariant held. Actions that are no longer enabled
+    (the protocol moved on under the schedule) are skipped — a schedule
+    degrades to a weaker test, never a spurious failure."""
+    if data.get("schema") != SCHEDULE_SCHEMA:
+        raise ValueError(f"unknown schedule schema: {data.get('schema')!r}")
+    world = World(data["config"], budgets, runtime=runtime)
+    try:
+        explicit_quiesce = False
+        for raw in data["actions"]:
+            action = tuple(tuple(x) if isinstance(x, list) else x for x in raw)
+            if action == ("quiesce",):
+                explicit_quiesce = True
+                world.quiesce()
+            else:
+                world.apply(action)
+                world.check_invariants()
+        if not explicit_quiesce:
+            world.quiesce()
+        return None
+    except Violation as v:
+        return v
+    finally:
+        world.close()
+
+
+def minimize(
+    config: str, trace: list, expect: str, budgets: dict | None = None,
+    rounds: int = 4, runtime: Runtime | None = None,
+) -> list:
+    """ddmin-lite over the action trace: greedily drop actions while
+    replaying still hits the SAME invariant. Replays are cheap at
+    counterexample depth; the result is what a human debugs and what
+    the corpus replays forever."""
+
+    def still_fails(candidate) -> bool:
+        v = replay_schedule(
+            {
+                "schema": SCHEDULE_SCHEMA,
+                "config": config,
+                "actions": [list(a) for a in candidate],
+            },
+            budgets,
+            runtime=runtime,
+        )
+        return v is not None and v.name == expect
+
+    current = [tuple(a) for a in trace]
+    for _ in range(rounds):
+        shrunk = False
+        i = len(current) - 1
+        while i >= 0:
+            candidate = current[:i] + current[i + 1:]
+            if still_fails(candidate):
+                current = candidate
+                shrunk = True
+            i -= 1
+        if not shrunk:
+            break
+    return current

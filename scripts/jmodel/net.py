@@ -1,0 +1,275 @@
+"""The in-memory deterministic network + virtual clock under jmodel.
+
+One ``ModelConn`` stands in for one TCP connection: two directed
+``Link``s (dialer→target ``fwd``, target→dialer ``rev``), each a FIFO
+of written-but-undelivered frames (``outbox``) plus a delivered-but-
+unread byte buffer (``inbox``). ``Cluster`` writes through a
+``ModelWriter`` exactly as it writes through an asyncio StreamWriter;
+nothing moves from outbox to inbox until the explorer fires a
+``deliver`` action — that withheld hop IS the schedule choice point.
+
+Teardown is ABORTIVE, like a real socket teardown: ``kill()`` (what
+``ModelWriter.close`` also routes to — a dropped conn, a partition, a
+crash) discards everything in flight and EOFs both readers now. There
+is deliberately no graceful close that keeps frames deliverable after
+the connection dies: that wire exists on no TCP, and modelling it made
+frames outlive their connection (a false in_flight counterexample).
+
+The ``VirtualClock`` subclasses ``cluster.Clock``: ``now_ms`` advances
+only on explorer ticks, ``perf`` is a strictly-increasing counter (rtt
+stamps need ordering, not wall time). Both are deterministic, so the
+same action trace always reproduces the same state — the property the
+state-hash dedup, the sleep sets, and schedule replay all rest on.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from jylis_tpu.cluster.cluster import Clock
+
+
+class VirtualClock(Clock):
+    __slots__ = ("ms", "_perf_n")
+
+    def __init__(self, start_ms: int = 1_000_000):
+        self.ms = start_ms
+        self._perf_n = 0
+
+    def now_ms(self) -> int:
+        return self.ms
+
+    def perf(self) -> float:
+        self._perf_n += 1
+        return self._perf_n * 1e-6
+
+    def advance(self, ms: int) -> None:
+        self.ms += ms
+
+
+class Link:
+    """One direction of a model connection."""
+
+    __slots__ = ("key", "net", "outbox", "inbox", "closed", "_waiter")
+
+    def __init__(self, key: str, net: "Network"):
+        self.key = key
+        self.net = net
+        self.outbox: list[bytes] = []
+        self.inbox = bytearray()
+        self.closed = False
+        self._waiter: asyncio.Future | None = None
+
+    def _wake(self) -> None:
+        w, self._waiter = self._waiter, None
+        if w is not None and not w.done():
+            w.set_result(None)
+
+    def write(self, data: bytes) -> None:
+        if not self.closed:
+            self.outbox.append(bytes(data))
+            self.net.progress += 1
+
+    def deliver_one(self) -> None:
+        """The explorer's `deliver` action: one written frame crosses."""
+        if self.outbox:
+            self.inbox.extend(self.outbox.pop(0))
+            self.net.progress += 1
+            self._wake()
+
+    def duplicate_one(self) -> None:
+        """The explorer's `dup` action: the head frame crosses as a COPY,
+        the original stays queued — the receiver will see it twice
+        (fire-and-forget + sync overlap makes redelivery a real
+        schedule; the lattice join must absorb it)."""
+        if self.outbox:
+            self.inbox.extend(self.outbox[0])
+            self.net.progress += 1
+            self._wake()
+
+    def kill(self) -> None:
+        """Abortive: everything in flight is gone, EOF now."""
+        self.closed = True
+        self.outbox.clear()
+        self.inbox.clear()
+        self.net.progress += 1
+        self._wake()
+
+    @property
+    def eof(self) -> bool:
+        return self.closed and not self.outbox and not self.inbox
+
+
+class ModelTransport:
+    __slots__ = ("_conn",)
+
+    def __init__(self, conn: "ModelConn"):
+        self._conn = conn
+
+    def is_closing(self) -> bool:
+        return self._conn.closed
+
+    def get_write_buffer_size(self) -> int:
+        return 0  # the explorer IS the backpressure
+
+
+class ModelReader:
+    __slots__ = ("link",)
+
+    def __init__(self, link: Link):
+        self.link = link
+
+    async def read(self, n: int = -1) -> bytes:
+        while True:
+            link = self.link
+            if link.inbox:
+                take = len(link.inbox) if n < 0 else min(n, len(link.inbox))
+                data = bytes(link.inbox[:take])
+                del link.inbox[:take]
+                link.net.progress += 1
+                return data
+            if link.eof:
+                link.net.progress += 1
+                return b""
+            fut = asyncio.get_running_loop().create_future()
+            link._waiter = fut
+            await fut
+
+
+class ModelWriter:
+    """StreamWriter stand-in: writes into the conn's outgoing link;
+    ``close()`` closes the WHOLE connection (both directions), like a
+    socket close."""
+
+    __slots__ = ("conn", "out", "transport")
+
+    def __init__(self, conn: "ModelConn", out: Link):
+        self.conn = conn
+        self.out = out
+        self.transport = ModelTransport(conn)
+
+    def write(self, data: bytes) -> None:
+        self.out.write(data)
+
+    async def drain(self) -> None:
+        return
+
+    def close(self) -> None:
+        # a dropped conn is a torn-down socket: in-flight frames are
+        # gone and both readers EOF now (keeping "gracefully closed"
+        # conns deliverable forever would let a frame outlive the
+        # connection that carried it — a wire no TCP provides)
+        self.conn.kill()
+
+    def is_closing(self) -> bool:
+        return self.conn.closed
+
+
+class ModelConn:
+    """One logical connection: links fwd (dialer→target) + rev."""
+
+    __slots__ = ("cid", "dialer", "target", "fwd", "rev", "closed")
+
+    def __init__(self, cid: str, dialer: str, target: str, net: "Network"):
+        self.cid = cid
+        self.dialer = dialer
+        self.target = target
+        self.fwd = Link(f"{cid}/fwd", net)
+        self.rev = Link(f"{cid}/rev", net)
+        self.closed = False
+
+    def link(self, direction: str) -> Link:
+        return self.fwd if direction == "fwd" else self.rev
+
+    def kill(self) -> None:
+        self.closed = True
+        self.fwd.kill()
+        self.rev.kill()
+
+
+class Network:
+    """Instance registry + conn table + the dial seam.
+
+    Instances register under their ADVERTISED address string; a model
+    dial either fails instantly (unknown / crashed / partitioned — the
+    OSError the dial state machine's backoff path expects) or creates a
+    ModelConn and schedules the target cluster's real ``_accept`` with
+    the passive-side endpoints."""
+
+    def __init__(self):
+        self.instances: dict[str, object] = {}  # addr str -> Instance
+        self.conns: dict[str, ModelConn] = {}
+        self._conn_seq: dict[tuple[str, str], int] = {}
+        self.partitions: set[frozenset] = set()  # {group, group}
+        self.progress = 0
+        self.accept_tasks: list[asyncio.Task] = []
+
+    def register(self, addr_str: str, instance) -> None:
+        self.instances[addr_str] = instance
+
+    def partitioned(self, group_a: str, group_b: str) -> bool:
+        return (
+            group_a != group_b
+            and frozenset((group_a, group_b)) in self.partitions
+        )
+
+    def connect_fn(self, dialer_instance):
+        async def connect(addr):
+            target = self.instances.get(str(addr))
+            if (
+                target is None
+                or not target.alive
+                or self.partitioned(dialer_instance.group, target.group)
+            ):
+                raise OSError(f"model: {addr} unreachable")
+            pair = (dialer_instance.key, target.key)
+            seq = self._conn_seq.get(pair, 0) + 1
+            self._conn_seq[pair] = seq
+            cid = f"{pair[0]}>{pair[1]}#{seq}"
+            conn = ModelConn(cid, *pair, self)
+            self.conns[cid] = conn
+            # the passive side runs the REAL accept/read-loop code
+            task = asyncio.get_running_loop().create_task(
+                target.cluster._accept(
+                    ModelReader(conn.fwd), ModelWriter(conn, conn.rev)
+                )
+            )
+            self.accept_tasks.append(task)
+            return ModelReader(conn.rev), ModelWriter(conn, conn.fwd)
+
+        return connect
+
+    def kill_between(self, group_a: str, group_b: str) -> None:
+        for conn in self.conns.values():
+            ga = self.instances_group(conn.dialer)
+            gb = self.instances_group(conn.target)
+            if {ga, gb} == {group_a, group_b} or (
+                group_a == group_b and ga == gb == group_a
+            ):
+                conn.kill()
+
+    def instances_group(self, instance_key: str) -> str:
+        for inst in self.instances.values():
+            if inst.key == instance_key:
+                return inst.group
+        return instance_key
+
+    def kill_of_group(self, group: str) -> None:
+        """Every conn touching a crashed group dies abortively."""
+        for conn in self.conns.values():
+            if group in (
+                self.instances_group(conn.dialer),
+                self.instances_group(conn.target),
+            ):
+                conn.kill()
+
+    def gc_conns(self) -> None:
+        """Forget conns that are dead AND drained on both sides — keeps
+        the action space and the state hash from growing with history."""
+        for cid in [
+            c
+            for c, conn in self.conns.items()
+            if conn.closed and conn.fwd.eof and conn.rev.eof
+        ]:
+            del self.conns[cid]
+        self.accept_tasks = [t for t in self.accept_tasks if not t.done()]

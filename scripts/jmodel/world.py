@@ -1,0 +1,877 @@
+"""The explorable world: real Clusters over the model network.
+
+A ``World`` is one configuration (2-node, 3-node, or 2-lane-bus) of
+REAL ``Cluster`` instances wired to ``net.py``'s in-memory transport
+and virtual clock, each over a ``ModelDatabase`` — a minimal host-side
+GCOUNT lattice (pointwise-max join, the paper's canonical delta CRDT)
+that speaks the real wire codec, so every frame the explorer reorders
+is a genuine schema-v6 frame through the genuine framing/CRC/codec
+path.
+
+The explorer talks to the world through three methods:
+
+* ``enabled_actions()`` — the deterministic, stably-ordered action
+  frontier: deliveries per link, heartbeat ticks per instance, bounded
+  duplicates / connection kills / partitions / crash-reboots / extra
+  client writes;
+* ``apply(action)`` — fire one action, then settle the event loop to
+  idle (every task parked on a model-network future);
+* ``state_hash()`` — canonical digest of ALL protocol-relevant state
+  (lattices, membership, conn/dial/sync machine fields, link contents,
+  remaining budgets), timestamps rank-normalised so the virtual clock's
+  absolute values never defeat deduplication.
+
+Invariants: ``check_invariants()`` runs the cheap per-state laws
+(lattice monotonicity, held-queue FIFO + bound, dial-backoff
+boundedness/monotonicity) after every action; ``quiesce()`` heals
+everything, drives the system to a fixpoint and asserts the global
+laws (digest match everywhere, no stranded rtt stamps, nothing in
+flight). A failure raises :class:`Violation` carrying the invariant
+name — the explorer turns that plus its action trace into a minimized
+schedule file.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import selectors
+from concurrent.futures import ThreadPoolExecutor
+
+from jylis_tpu.cluster import cluster as cluster_mod
+from jylis_tpu.cluster.cluster import Cluster
+from jylis_tpu.lanes import wire_bridge
+from jylis_tpu.obs.registry import MetricsRegistry
+from jylis_tpu.utils.address import Address
+from jylis_tpu.utils.config import Config
+from jylis_tpu.utils.log import Log
+
+from .net import Network, VirtualClock
+
+CONFIG_NAMES = ("nodes2", "nodes3", "lanes2")
+
+TICK_MS = 100  # virtual ms per heartbeat action
+
+# per-trace budgets for the expensive/structural actions: unbounded,
+# each would multiply the frontier at every depth for little new
+# coverage (state-hash dedup already collapses the repeats)
+DEFAULT_BUDGETS = {
+    "writes": 1,  # extra client writes per group (on top of the seed write)
+    "dups": 1,
+    "kills": 1,
+    "crashes": 1,
+    "partitions": 1,
+}
+
+
+class Violation(Exception):
+    """One invariant broke. ``name`` is the invariant's stable id."""
+
+    def __init__(self, name: str, detail: str):
+        super().__init__(f"{name}: {detail}")
+        self.name = name
+        self.detail = detail
+
+
+class ModelDatabase:
+    """A host-side GCOUNT lattice with the exact Database surface the
+    Cluster consumes, producing real codec-shaped deltas. ``journal``
+    is the WAL analog: local writes survive a crash-reboot, converged
+    remote state does not (it heals back over the rejoin sync — the
+    exact path worth exploring)."""
+
+    DATA_TYPES = ("GCOUNT",)
+
+    def __init__(self, name: str, rid: int, journal=None):
+        self.name = name
+        self.rid = rid
+        self.state: dict[bytes, dict[int, int]] = {}
+        self.pending: list[tuple[bytes, dict[int, int]]] = []
+        self.journal: list[tuple[bytes, int]] = list(journal or ())
+        self.metrics = MetricsRegistry()
+        for key, n in self.journal:  # boot replay
+            rows = self.state.setdefault(key, {})
+            rows[self.rid] = max(rows.get(self.rid, 0), n)
+
+    def local_write(self, key: bytes = b"x") -> None:
+        rows = self.state.setdefault(key, {})
+        n = rows.get(self.rid, 0) + 1
+        rows[self.rid] = n
+        self.journal.append((key, n))  # WAL before the network sees it
+        self.pending.append((key, {self.rid: n}))
+
+    def _join(self, batch) -> None:
+        for key, delta in batch:
+            rows = self.state.setdefault(bytes(key), {})
+            for rid, v in delta.items():
+                if v > rows.get(rid, 0):
+                    rows[rid] = v
+
+    async def converge_async(self, deltas) -> None:
+        name, batch = deltas
+        if name == "GCOUNT":
+            self._join(batch)
+
+    async def flush_deltas_async(self, fn) -> None:
+        if self.pending:
+            batch, self.pending = self.pending, []
+            fn(("GCOUNT", tuple(batch)))
+
+    async def sync_type_digests_async(self) -> tuple[bytes, ...]:
+        return (self.digest(),)
+
+    async def dump_state_async(self, names=None):
+        names = tuple(names) if names is not None else self.DATA_TYPES
+        out = []
+        for n in names:
+            if n == "GCOUNT":
+                out.append(
+                    (
+                        "GCOUNT",
+                        [(k, dict(v)) for k, v in sorted(self.state.items())],
+                    )
+                )
+            elif n == "SYSTEM":
+                out.append(("SYSTEM", []))
+        return out
+
+    def digest(self) -> bytes:
+        canon = sorted(
+            (k.hex(), sorted(v.items()))
+            for k, v in self.state.items()
+            if v
+        )
+        return hashlib.sha256(repr(canon).encode()).digest()
+
+    def cells(self) -> dict[tuple[bytes, int], int]:
+        return {
+            (k, rid): v
+            for k, rows in self.state.items()
+            for rid, v in rows.items()
+        }
+
+
+class Instance:
+    """One Cluster's place in the world. ``group`` is the
+    crash/partition granularity (a lane-split node is one group with
+    two instances: the bus and the external cluster)."""
+
+    def __init__(self, key: str, group: str, addr: Address):
+        self.key = key
+        self.group = group
+        self.addr = addr
+        self.alive = True
+        self.cluster: Cluster | None = None
+        self.database: ModelDatabase | None = None
+
+
+class _TrackedExecutor(ThreadPoolExecutor):
+    """Single worker + a future ledger: settle() can WAIT on in-flight
+    ``to_thread`` work (the sync-dump encodes) instead of racing it —
+    one worker keeps completion order = submission order, so the drain
+    is deterministic."""
+
+    def __init__(self):
+        super().__init__(max_workers=1, thread_name_prefix="jmodel")
+        self.futures = []
+
+    def submit(self, fn, /, *args, **kwargs):
+        f = super().submit(fn, *args, **kwargs)
+        self.futures.append(f)
+        return f
+
+
+class _NullSelector(selectors.BaseSelector):
+    """The model loop has no real file descriptors — every wake-up is a
+    call_soon from the model network or the executor — so the epoll
+    syscall per loop iteration (hundreds of thousands per exploration)
+    is pure overhead. `select` parks briefly only when the loop is
+    genuinely idle waiting on the executor thread."""
+
+    def __init__(self):
+        self._map = {}
+
+    def register(self, fileobj, events, data=None):  # pragma: no cover
+        key = selectors.SelectorKey(fileobj, 0, events, data)
+        self._map[fileobj] = key
+        return key
+
+    def unregister(self, fileobj):  # pragma: no cover
+        return self._map.pop(fileobj)
+
+    def select(self, timeout=None):
+        if timeout is None or timeout > 0:
+            # genuinely idle (waiting on the executor thread): yield the
+            # GIL briefly instead of busy-spinning the loop
+            import time as _time
+
+            _time.sleep(5e-5)
+        return []
+
+    def get_map(self):
+        return self._map
+
+    def close(self):
+        self._map.clear()
+
+
+class Runtime:
+    """One event loop + tracked executor shared across the thousands of
+    short-lived Worlds a replay-based search creates — loop construction
+    and teardown would otherwise dominate the whole exploration.
+
+    ``task_events`` counts every task creation AND completion (via a
+    task factory): together with the network's progress counter it is
+    the O(1) settle fingerprint — ``asyncio.all_tasks()`` walks a
+    weakset of every live task and measurably dominated the search."""
+
+    def __init__(self):
+        self.loop = asyncio.SelectorEventLoop(_NullSelector())
+        self.executor = _TrackedExecutor()
+        self.loop.set_default_executor(self.executor)
+        self.task_events = 0
+
+        def factory(loop, coro):
+            self.task_events += 1
+            task = asyncio.Task(coro, loop=loop)
+            task.add_done_callback(self._task_done)
+            return task
+
+        self.loop.set_task_factory(factory)
+
+    def _task_done(self, _task) -> None:
+        self.task_events += 1
+
+    def close(self) -> None:
+        self.executor.shutdown(wait=False, cancel_futures=True)
+        self.loop.close()
+
+
+def _mk_config(addr: Address, seeds) -> Config:
+    cfg = Config()
+    cfg.addr = addr
+    cfg.seed_addrs = list(seeds)
+    cfg.heartbeat_time = 999.0  # never started: the explorer IS the heart
+    cfg.log = Log.create_none()
+    return cfg
+
+
+class World:
+    def __init__(
+        self,
+        config_name: str,
+        budgets: dict | None = None,
+        runtime: Runtime | None = None,
+    ):
+        if config_name not in CONFIG_NAMES:
+            raise ValueError(f"unknown config {config_name!r}")
+        self.config_name = config_name
+        self.budgets = dict(DEFAULT_BUDGETS)
+        if budgets:
+            self.budgets.update(budgets)
+        self._owns_runtime = runtime is None
+        self._runtime = runtime or Runtime()
+        self.loop = self._runtime.loop
+        self._executor = self._runtime.executor
+        self.clock = VirtualClock()
+        self.net = Network()
+        self.instances: dict[str, Instance] = {}
+        self.dbs: dict[str, ModelDatabase] = {}
+        self._group_builders: dict[str, callable] = {}
+        self.used = {"dups": 0, "kills": 0, "crashes": 0, "partitions": 0}
+        self.writes_left: dict[str, int] = {}
+        # invariant shadows: per-db lattice floor, per-(instance, addr)
+        # last observed dial-backoff state
+        self._floor: dict[str, dict] = {}
+        self._backoff_seen: dict[tuple[str, str], tuple[int, int]] = {}
+        self._build()
+        # seed divergence: every group starts with one local write on
+        # the shared key, so convergence is never vacuous
+        for group in sorted(self.dbs):
+            self.dbs[group].local_write()
+        self._run(lambda: None)
+
+    def close(self) -> None:
+        def down():
+            for inst in self.instances.values():
+                if inst.alive:
+                    inst.cluster.dispose()
+            for conn in self.net.conns.values():
+                conn.kill()  # EOF every parked read task
+
+        try:
+            self._run(down)
+        finally:
+            # reap anything still parked on a model future, so a shared
+            # runtime starts the next World with a clean task table
+            pending = asyncio.all_tasks(self.loop)
+            for task in pending:
+                task.cancel()
+            if pending:
+                try:
+                    self.loop.run_until_complete(
+                        asyncio.gather(*pending, return_exceptions=True)
+                    )
+                # jlint: broad-ok — best-effort reap of cancelled tasks
+                # at teardown; gather(return_exceptions=True) only
+                # raises loop-state errors, and a failed reap must not
+                # mask the exploration's own result
+                except Exception:
+                    pass
+            self._executor.futures.clear()
+            if self._owns_runtime:
+                self._runtime.close()
+
+    # ---- construction ------------------------------------------------------
+
+    def _spawn(self, key, group, addr, seeds, db, drive_flush=True,
+               register_system=True) -> Instance:
+        inst = Instance(key, group, addr)
+        inst.database = db
+        inst.cluster = Cluster(
+            _mk_config(addr, seeds),
+            db,
+            drive_flush=drive_flush,
+            register_system=register_system,
+            clock=self.clock,
+            connect=self.net.connect_fn(inst),
+        )
+        self.instances[key] = inst
+        self.net.register(str(addr), inst)
+        return inst
+
+    def _build(self) -> None:
+        if self.config_name == "nodes2":
+            addrs = {
+                "A": Address("10.0.0.1", "7001", "A"),
+                "B": Address("10.0.0.2", "7001", "B"),
+            }
+            for i, name in enumerate(sorted(addrs)):
+                self._node_group(name, addrs[name], [
+                    a for n, a in sorted(addrs.items()) if n != name
+                ], rid=i + 1)
+        elif self.config_name == "nodes3":
+            addrs = {
+                "foo": Address("10.0.0.1", "7001", "foo"),
+                "bar": Address("10.0.0.2", "7001", "bar"),
+                "baz": Address("10.0.0.3", "7001", "baz"),
+            }
+            # bar/baz know only the seed: mesh discovery through gossip
+            # is part of the explored state space (the reference test's
+            # topology)
+            self._node_group("foo", addrs["foo"], [], rid=1)
+            self._node_group("bar", addrs["bar"], [addrs["foo"]], rid=2)
+            self._node_group("baz", addrs["baz"], [addrs["foo"]], rid=3)
+        else:  # lanes2: external node E + a 2-lane node N (bus + bridge)
+            e_addr = Address("10.0.0.9", "7001", "E")
+            n_addr = Address("10.0.0.1", "7001", "N")
+            bus0 = Address("127.0.0.1", "7101", "N#lane0")
+            bus1 = Address("127.0.0.1", "7102", "N#lane1")
+            self._node_group("E", e_addr, [n_addr], rid=9)
+            self._lane_group("L0", 0, n_addr, bus0, [bus1], e_addr, rid=1)
+            self._lane_group("L1", 1, n_addr, bus1, [bus0], None, rid=2)
+
+    def _node_group(self, name, addr, seeds, rid) -> None:
+        def build(journal=None):
+            db = ModelDatabase(name, rid, journal)
+            self.dbs[name] = db
+            self._spawn(name, name, addr, seeds, db)
+
+        self._group_builders[name] = build
+        self.writes_left[name] = self.budgets["writes"]
+        build()
+
+    def _lane_group(self, group, lane_id, n_addr, bus_addr, bus_seeds,
+                    e_addr, rid) -> None:
+        def build(journal=None):
+            db = ModelDatabase(group, rid, journal)
+            self.dbs[group] = db
+            # main.py's exact wiring: every lane runs a bus instance
+            # (lane 0's does not own the SYSTEM metrics section); lane 0
+            # additionally runs the external cluster with
+            # drive_flush=False and bridges the meshes
+            bus = self._spawn(
+                f"{group}.bus", group, bus_addr, bus_seeds, db,
+                register_system=(lane_id != 0),
+            )
+            if lane_id == 0:
+                ext = self._spawn(
+                    f"{group}.ext", group, n_addr, [e_addr], db,
+                    drive_flush=False,
+                )
+                wire_bridge(bus.cluster, ext.cluster)
+
+        self._group_builders[group] = build
+        self.writes_left[group] = self.budgets["writes"]
+        build()
+
+    # ---- event-loop stepping ----------------------------------------------
+
+    def _run(self, fn) -> None:
+        async def step():
+            res = fn()
+            if asyncio.iscoroutine(res):
+                await res
+            await self._settle()
+
+        self.loop.run_until_complete(step())
+
+    async def _settle(self) -> None:
+        """Run the loop until every task is parked on a model-network
+        future (or done) and no executor work is in flight. The
+        fingerprint is (net progress, live task count); 8 stable
+        spin rounds covers any pure-compute continuation chain."""
+        stable, last = 0, None
+        for _ in range(2000):
+            await asyncio.sleep(0)
+            pending = [f for f in self._executor.futures if not f.done()]
+            if pending:
+                await asyncio.wrap_future(pending[0])
+                stable, last = 0, None
+                continue
+            self._executor.futures.clear()
+            fp = (self.net.progress, self._runtime.task_events)
+            if fp == last:
+                stable += 1
+                if stable >= 3:
+                    return
+            else:
+                stable, last = 0, fp
+        raise Violation("settle", "event loop failed to quiesce")
+
+    # ---- actions -----------------------------------------------------------
+
+    def _groups(self) -> list[str]:
+        return sorted(self._group_builders)
+
+    def enabled_actions(self) -> list[tuple]:
+        acts: list[tuple] = []
+        for cid in sorted(self.net.conns):
+            conn = self.net.conns[cid]
+            for direction in ("fwd", "rev"):
+                link = conn.link(direction)
+                recv = conn.target if direction == "fwd" else conn.dialer
+                inst = self.instances.get(recv)
+                if link.outbox and inst is not None and inst.alive:
+                    acts.append(("deliver", cid, direction))
+                    if self.used["dups"] < self.budgets["dups"]:
+                        acts.append(("dup", cid, direction))
+            if not conn.closed and self.used["kills"] < self.budgets["kills"]:
+                acts.append(("kill", cid))
+        for key in sorted(self.instances):
+            if self.instances[key].alive:
+                acts.append(("tick", key))
+        for group in self._groups():
+            if self.writes_left.get(group, 0) > 0 and self._group_alive(group):
+                acts.append(("write", group))
+            if (
+                self.used["crashes"] < self.budgets["crashes"]
+                and self._group_alive(group)
+            ):
+                acts.append(("crash", group))
+        if self.config_name != "lanes2":
+            groups = self._groups()
+            for i, a in enumerate(groups):
+                for b in groups[i + 1:]:
+                    pair = frozenset((a, b))
+                    if pair in self.net.partitions:
+                        acts.append(("heal", a, b))
+                    elif self.used["partitions"] < self.budgets["partitions"]:
+                        acts.append(("part", a, b))
+        return acts
+
+    def _group_alive(self, group: str) -> bool:
+        return all(
+            i.alive for i in self.instances.values() if i.group == group
+        )
+
+    def action_enabled(self, action: tuple) -> bool:
+        """Targeted membership test, equivalent to `action in
+        enabled_actions()` without rebuilding the whole frontier —
+        apply() runs this once per REPLAYED action, which is the
+        exploration hot path."""
+        kind = action[0]
+        if kind == "tick":
+            inst = self.instances.get(action[1])
+            return inst is not None and inst.alive
+        if kind in ("deliver", "dup"):
+            if kind == "dup" and self.used["dups"] >= self.budgets["dups"]:
+                return False
+            conn = self.net.conns.get(action[1])
+            if conn is None or action[2] not in ("fwd", "rev"):
+                return False
+            recv = conn.target if action[2] == "fwd" else conn.dialer
+            inst = self.instances.get(recv)
+            return bool(
+                conn.link(action[2]).outbox
+                and inst is not None
+                and inst.alive
+            )
+        if kind == "kill":
+            conn = self.net.conns.get(action[1])
+            return (
+                conn is not None
+                and not conn.closed
+                and self.used["kills"] < self.budgets["kills"]
+            )
+        if kind == "write":
+            return (
+                self.writes_left.get(action[1], 0) > 0
+                and action[1] in self._group_builders
+                and self._group_alive(action[1])
+            )
+        if kind == "crash":
+            return (
+                action[1] in self._group_builders
+                and self.used["crashes"] < self.budgets["crashes"]
+                and self._group_alive(action[1])
+            )
+        if kind == "part":
+            return (
+                self.config_name != "lanes2"
+                and action[1] in self._group_builders
+                and action[2] in self._group_builders
+                and action[1] != action[2]
+                and frozenset((action[1], action[2]))
+                not in self.net.partitions
+                and self.used["partitions"] < self.budgets["partitions"]
+            )
+        if kind == "heal":
+            return frozenset((action[1], action[2])) in self.net.partitions
+        return False
+
+    def apply(self, action: tuple) -> bool:
+        """Fire one action then settle; False if it is not currently
+        enabled (replay after a code change skips, never crashes)."""
+        action = tuple(action)
+        if not self.action_enabled(action):
+            return False
+        kind = action[0]
+        if kind == "tick":
+            inst = self.instances[action[1]]
+            self.clock.advance(TICK_MS)
+            self._run(inst.cluster._heartbeat)
+        elif kind == "deliver":
+            link = self.net.conns[action[1]].link(action[2])
+            self._run(link.deliver_one)
+        elif kind == "dup":
+            self.used["dups"] += 1
+            link = self.net.conns[action[1]].link(action[2])
+            self._run(link.duplicate_one)
+        elif kind == "kill":
+            self.used["kills"] += 1
+            self._run(self.net.conns[action[1]].kill)
+        elif kind == "write":
+            self.writes_left[action[1]] -= 1
+            self._run(self.dbs[action[1]].local_write)
+        elif kind == "crash":
+            self.used["crashes"] += 1
+            self._crash_reboot(action[1])
+        elif kind == "part":
+            self.used["partitions"] += 1
+            pair = frozenset((action[1], action[2]))
+            self.net.partitions.add(pair)
+            self._run(lambda: self.net.kill_between(action[1], action[2]))
+        elif kind == "heal":
+            self.net.partitions.discard(frozenset((action[1], action[2])))
+            self._run(lambda: None)
+        else:
+            raise ValueError(f"unknown action {action!r}")
+        self.net.gc_conns()
+        return True
+
+    def _crash_reboot(self, group: str) -> None:
+        journal = list(self.dbs[group].journal)
+
+        def down():
+            for key in [
+                k for k, i in self.instances.items() if i.group == group
+            ]:
+                inst = self.instances.pop(key)
+                inst.alive = False
+                inst.cluster.dispose()
+            self.net.kill_of_group(group)
+
+        self._run(down)
+        self.net.gc_conns()
+        # reboot from "disk": the journaled local writes survive,
+        # converged remote state heals back over the rejoin sync
+        self._group_builders[group](journal)
+        # floor resets with the reboot: losing REMOTE state at a crash
+        # is the documented durability model, not a join regression
+        self._floor.pop(group, None)
+        for k in [k for k in self._backoff_seen if k[0].startswith(group)]:
+            del self._backoff_seen[k]
+        self._run(lambda: None)
+
+    # ---- invariants --------------------------------------------------------
+
+    def check_invariants(self) -> None:
+        # lattice monotonicity: no (key, replica) cell ever regresses
+        for group, db in self.dbs.items():
+            cells = db.cells()
+            floor = self._floor.get(group, {})
+            for cell, v in floor.items():
+                if cells.get(cell, 0) < v:
+                    raise Violation(
+                        "monotonicity",
+                        f"{group}: cell {cell} regressed {v} -> "
+                        f"{cells.get(cell, 0)}",
+                    )
+            self._floor[group] = cells
+        for key, inst in self.instances.items():
+            if not inst.alive:
+                continue
+            c = inst.cluster
+            # held queue: bounded and FIFO by hold time
+            if len(c._held) > c._held_cap:
+                raise Violation(
+                    "held_bound",
+                    f"{key}: {len(c._held)} held > cap {c._held_cap}",
+                )
+            stamps = [ts for ts, _ in c._held]
+            if stamps != sorted(stamps):
+                raise Violation("held_fifo", f"{key}: held stamps {stamps}")
+            # dial backoff: bounded above by cap(+jitter), monotone
+            # while failures accumulate (reset only by contact)
+            for addr, st in c._peers.items():
+                wait = st.next_dial_tick - c._tick
+                bound = c._backoff_cap + c._backoff_cap // 2 + 1
+                if st.fails > 0 and wait > bound:
+                    raise Violation(
+                        "backoff_bound",
+                        f"{key}->{addr}: wait {wait} ticks > bound {bound}",
+                    )
+                seen = self._backoff_seen.get((key, str(addr)))
+                if (
+                    seen is not None
+                    and st.fails > seen[0]
+                    and st.next_dial_tick < seen[1]
+                ):
+                    raise Violation(
+                        "backoff_monotone",
+                        f"{key}->{addr}: fails {seen[0]}->{st.fails} but "
+                        f"next_dial {seen[1]}->{st.next_dial_tick}",
+                    )
+                self._backoff_seen[(key, str(addr))] = (
+                    st.fails, st.next_dial_tick,
+                )
+
+    # ---- quiescence + global laws -----------------------------------------
+
+    def _deliver_all(self, cap: int = 200) -> None:
+        for _ in range(cap):
+            moved = 0
+
+            def burst():
+                nonlocal moved
+                for cid in sorted(self.net.conns):
+                    conn = self.net.conns[cid]
+                    for direction in ("fwd", "rev"):
+                        link = conn.link(direction)
+                        recv = (
+                            conn.target if direction == "fwd"
+                            else conn.dialer
+                        )
+                        inst = self.instances.get(recv)
+                        while (
+                            link.outbox and inst is not None and inst.alive
+                        ):
+                            link.deliver_one()
+                            moved += 1
+
+            # quiescence needs no per-frame interleaving control: one
+            # settle per burst, not per frame
+            self._run(burst)
+            self.net.gc_conns()
+            if not moved:
+                return
+        raise Violation("quiesce", "deliveries never drained")
+
+    def _digests(self) -> dict[str, str]:
+        return {g: db.digest().hex() for g, db in sorted(self.dbs.items())}
+
+    def quiesce(self) -> None:
+        """Heal everything, run to a fixpoint, assert the global laws:
+        digest match on every replica, no in-flight or held frames, no
+        stranded rtt stamps."""
+        self.net.partitions.clear()
+        period = cluster_mod.SYNC_PERIOD_TICKS
+        stable = 0
+        for _ in range(40 * period):
+            self._deliver_all()
+            if len(set(self._digests().values())) == 1:
+                stable += 1
+                # a full extra sync period after digests agree lets the
+                # in-flight sync conversations and pong traffic finish
+                if stable > period + 2:
+                    break
+            else:
+                stable = 0
+            for key in sorted(self.instances):
+                if self.instances[key].alive:
+                    self.clock.advance(TICK_MS)
+                    self._run(self.instances[key].cluster._heartbeat)
+        self._deliver_all()
+        digests = self._digests()
+        if len(set(digests.values())) != 1:
+            raise Violation("convergence", f"digest mismatch: {digests}")
+        for key, inst in sorted(self.instances.items()):
+            if not inst.alive:
+                continue
+            c = inst.cluster
+            if c._held:
+                raise Violation(
+                    "held_drained", f"{key}: {len(c._held)} frames held "
+                    "after quiescence",
+                )
+            for addr, conn in sorted(
+                c._actives.items(), key=lambda kv: str(kv[0])
+            ):
+                if conn.established and conn.pong_sent:
+                    raise Violation(
+                        "rtt_stamps",
+                        f"{key}->{addr}: {len(conn.pong_sent)} stranded "
+                        "rtt stamps after quiescence",
+                    )
+        for cid, conn in sorted(self.net.conns.items()):
+            for direction in ("fwd", "rev"):
+                link = conn.link(direction)
+                if link.outbox or link.inbox:
+                    raise Violation(
+                        "in_flight", f"{cid}/{direction} still carries "
+                        "bytes after quiescence",
+                    )
+
+    # ---- state hashing -----------------------------------------------------
+
+    @staticmethod
+    def _sha(data: bytes) -> str:
+        return hashlib.sha256(data).hexdigest()[:16]
+
+    def _rel(self, tick: int, t) -> int | None:
+        if t is None:
+            return None
+        period = cluster_mod.SYNC_PERIOD_TICKS
+        return min(tick - t, 8 * period)
+
+    def canonical(self):
+        period = cluster_mod.SYNC_PERIOD_TICKS
+        mod = cluster_mod.ANNOUNCE_EVERY * period
+        # rank-normalise every wall-ms the state carries: absolute
+        # virtual-clock values would make every state unique
+        times = set()
+        for inst in self.instances.values():
+            if inst.alive:
+                c = inst.cluster
+                times.update(ts for ts, _ in c._held)
+                if c._defer_since_ms is not None:
+                    times.add(c._defer_since_ms)
+        rank = {t: i for i, t in enumerate(sorted(times))}
+        dbs = {
+            g: {
+                "digest": db.digest().hex()[:16],
+                "pending": [
+                    (k.hex(), sorted(d.items())) for k, d in db.pending
+                ],
+                "journal_len": len(db.journal),
+            }
+            for g, db in sorted(self.dbs.items())
+        }
+        insts = {}
+        for key in sorted(self.instances):
+            inst = self.instances[key]
+            if not inst.alive:
+                insts[key] = "down"
+                continue
+            c = inst.cluster
+            tick = c._tick
+            insts[key] = {
+                "tick_mod": tick % mod,
+                "known": [
+                    sorted(str(a) for a in c._known_addrs.adds),
+                    sorted(str(a) for a in c._known_addrs.removes),
+                ],
+                "actives": {
+                    str(a): [
+                        conn.established,
+                        len(conn.pong_sent),
+                        self._rel(tick, conn.sync_served_tick),
+                        conn.sync_defer_streak,
+                        self._rel(tick, conn.sync_defer_last_tick),
+                        conn.last_write_dropped,
+                        # idle age drives the eviction machine: without
+                        # it a 6-ticks-idle conn (evicts next tick)
+                        # dedup-merges with a fresh one and the
+                        # eviction subtree is never explored
+                        self._rel(tick, c._last_activity.get(conn)),
+                    ]
+                    for a, conn in sorted(
+                        c._actives.items(), key=lambda kv: str(kv[0])
+                    )
+                },
+                "passives": sorted(
+                    [str(conn.peer_addr), conn.established,
+                     len(conn.pong_sent),
+                     self._rel(tick, c._last_activity.get(conn))]
+                    for conn in c._passives
+                ),
+                "peers": {
+                    str(a): [st.fails, max(st.next_dial_tick - tick, 0)]
+                    for a, st in sorted(
+                        c._peers.items(), key=lambda kv: str(kv[0])
+                    )
+                    if st.fails or st.next_dial_tick > tick
+                },
+                "held": [
+                    [rank[ts], self._sha(data)] for ts, data in c._held
+                ],
+                "stats": sorted(c._stats.items()),
+                "drops": sorted(c._drop_counts.items()),
+                "msg_drops": sorted(c._msg_drops.items()),
+                "sync": [
+                    self._rel(tick, c._sync_rx_tick),
+                    sorted(
+                        (str(a), self._rel(tick, t))
+                        for a, t in c._sync_req_tick.items()
+                    ),
+                    sorted(str(a) for a in c._sync_req_inflight),
+                    len(c._sync_waiters),
+                    c._sync_dump_inflight,
+                    c._sync_defer_streak,
+                    c._sync_serve_defer_total,
+                    self._rel(tick, c._sync_defer_total_tick),
+                    c._local_writes_seen,
+                    None if c._defer_since_ms is None
+                    else rank[c._defer_since_ms],
+                ],
+            }
+        conns = {
+            cid: {
+                "closed": conn.closed,
+                "links": {
+                    d: [
+                        [self._sha(f) for f in conn.link(d).outbox],
+                        self._sha(bytes(conn.link(d).inbox)),
+                        conn.link(d).closed,
+                    ]
+                    for d in ("fwd", "rev")
+                },
+            }
+            for cid, conn in sorted(self.net.conns.items())
+        }
+        return {
+            "config": self.config_name,
+            "dbs": dbs,
+            "instances": insts,
+            "conns": conns,
+            "partitions": sorted(sorted(p) for p in self.net.partitions),
+            "used": sorted(self.used.items()),
+            "writes_left": sorted(self.writes_left.items()),
+        }
+
+    def state_hash(self) -> str:
+        # repr, not json.dumps: canonical() builds every dict in sorted
+        # insertion order, so repr is deterministic — and measurably
+        # cheaper than the json encoder at tens of thousands of states
+        return hashlib.sha256(repr(self.canonical()).encode()).hexdigest()

@@ -1061,3 +1061,75 @@ def test_eight_node_churn_convergence():
                 await n.stop()
 
     asyncio.run(main())
+
+
+def test_out_of_envelope_messages_are_declared_drops_not_silence():
+    """Satellite of the protocol-atlas round: a message outside the
+    (role, state, msg) envelope is DISCARDED with the conn kept, but
+    counted per reason (msg_drop_* in CLUSTER metrics) and traced —
+    jlint pass 10 (JL1002) forbids re-introducing a silent ignore."""
+    from jylis_tpu.cluster.cluster import Cluster, MsgDrop, _Conn
+    from jylis_tpu.cluster.msg import MsgSyncDone
+
+    cfg = Config()
+    cfg.addr = Address("127.0.0.1", "7001", "solo")
+    cfg.log = Log.create_none()
+
+    class _Db:  # registry-less direct drive: resolve_registry -> DEFAULT
+        pass
+
+    cluster = Cluster(cfg, _Db())
+
+    async def main():
+        from jylis_tpu.cluster.msg import MsgPong
+
+        passive = _Conn(writer=None, active_addr=None)
+        passive.established = True
+        await cluster._passive_msg(passive, MsgPong())
+        await cluster._passive_msg(passive, MsgSyncDone())
+        await cluster._passive_msg(passive, MsgSyncDone())
+        active = _Conn(
+            writer=None, active_addr=Address("127.0.0.1", "7002", "peer")
+        )
+        active.established = True
+        await cluster._active_msg(active, MsgPong())  # nothing outstanding
+        # an EXPECTED SyncDone on the active side is a counted close of
+        # our sync request, never a drop
+        await cluster._active_msg(active, MsgSyncDone())
+
+    asyncio.run(main())
+    totals = cluster.metrics_totals()
+    assert totals[f"msg_drop_{MsgDrop.PONG_UNSOLICITED}"] == 1
+    assert totals[f"msg_drop_{MsgDrop.SYNC_DONE_UNSOLICITED}"] == 2
+    assert totals[f"msg_drop_{MsgDrop.PONG_UNMATCHED}"] == 1
+    assert totals["sync_done_recv"] == 1
+
+
+def test_matched_pong_is_not_a_drop():
+    """The declared-drop path must not fire when a Pong answers a
+    stamped send: pop + rtt record, zero msg_drop counters."""
+    from jylis_tpu.cluster.cluster import Cluster, _Conn
+    from jylis_tpu.cluster.msg import MsgPong
+
+    cfg = Config()
+    cfg.addr = Address("127.0.0.1", "7001", "solo")
+    cfg.log = Log.create_none()
+
+    class _Db:
+        pass
+
+    cluster = Cluster(cfg, _Db())
+
+    async def main():
+        active = _Conn(
+            writer=None, active_addr=Address("127.0.0.1", "7002", "peer")
+        )
+        active.established = True
+        active.pong_sent.append(0.0)
+        await cluster._active_msg(active, MsgPong())
+        assert not active.pong_sent
+
+    asyncio.run(main())
+    assert not any(
+        k.startswith("msg_drop_") for k in cluster.metrics_totals()
+    )

@@ -111,3 +111,57 @@ def test_decode_rejects_garbage():
 def test_signature_is_stable_and_schema_bound():
     assert codec.signature() == codec.signature()
     assert len(codec.signature()) == 32
+
+
+# ---- wire_frame / check_frame edge cases (decoder robustness) --------------
+# The pass-7 codec corpus byte-pins the happy path; these pin the
+# DECODER's behaviour at the envelope's edges: the origin stamp's
+# None-vs-0 distinction (0 is the documented "unstamped" sentinel, None
+# means "stamp now"), the full u64 origin range, and truncation at
+# every byte — check_frame must answer None, never raise or mis-frame.
+
+
+def test_wire_frame_origin_none_stamps_now_but_zero_stays_zero():
+    from jylis_tpu.cluster.cluster import check_frame, wire_frame
+
+    body = b"payload"
+    origin, got = check_frame(wire_frame(body, origin_ms=0)[9:])
+    assert (origin, got) == (0, body)  # 0 = unstamped sentinel, preserved
+    origin, got = check_frame(wire_frame(body)[9:])
+    assert got == body
+    assert origin > 0  # None = stamp with the sender's clock
+
+
+def test_wire_frame_max_u64_origin_roundtrips():
+    from jylis_tpu.cluster.cluster import check_frame, wire_frame
+
+    top = (1 << 64) - 1
+    origin, got = check_frame(wire_frame(b"x", origin_ms=top)[9:])
+    assert (origin, got) == (top, b"x")
+
+
+def test_check_frame_truncated_at_every_byte_is_none():
+    from jylis_tpu.cluster.cluster import check_frame, wire_frame
+
+    raw = wire_frame(b"some message body", origin_ms=77)[9:]
+    assert check_frame(raw) is not None
+    for i in range(len(raw)):
+        assert check_frame(raw[:i]) is None, i
+
+
+def test_frame_reader_never_yields_a_truncated_wire_frame():
+    from jylis_tpu.cluster.cluster import wire_frame
+
+    framed = wire_frame(b"body bytes", origin_ms=1)
+    for i in range(len(framed)):
+        reader = framing.FrameReader()
+        reader.append(framed[:i])
+        assert list(reader) == []
+
+
+def test_check_frame_empty_body_roundtrips():
+    # a frame carrying ONLY the stamp envelope (empty payload) is legal
+    # on the wire and must not be confused with a short frame
+    from jylis_tpu.cluster.cluster import check_frame, wire_frame
+
+    assert check_frame(wire_frame(b"", origin_ms=5)[9:]) == (5, b"")

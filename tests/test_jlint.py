@@ -25,6 +25,7 @@ from scripts.jlint import (  # noqa: E402
     pass_lanes,
     pass_metrics,
     pass_parity,
+    pass_protocol,
 )
 
 
@@ -1359,3 +1360,212 @@ def test_syntax_error_writes_artifact_and_exits_2(tmp_path):
     assert rc == 2
     payload = json.loads(out.read_text())
     assert payload["exit"] == 2 and "unparseable" in payload["error"]
+
+
+# ---- pass 10: protocol atlas (JL1001/JL1002/JL1003) -------------------------
+
+FAKE_PROTO_MSG = '''
+class MsgPing:
+    pass
+
+class MsgData:
+    pass
+'''
+
+FAKE_PROTO_CLUSTER = '''
+class Drop:
+    UNEXPECTED = "unexpected_msg"
+
+class MsgDrop:
+    IGNORED = "ignored"
+
+class Cluster:
+    async def _active_msg(self, conn, msg):
+        if isinstance(msg, MsgPing):
+            self._drop_msg(conn, MsgDrop.IGNORED)
+            return
+        if isinstance(msg, MsgData):
+            await self._database.converge_async(msg)
+            self._send(conn, MsgPing())
+            return
+        self._drop(conn, Drop.UNEXPECTED)
+
+    async def _passive_msg(self, conn, msg):
+        if isinstance(msg, MsgPing):
+            return  # SILENT ignore: JL1002
+        self._drop(conn, Drop.UNEXPECTED)
+'''
+
+
+def _proto_tree(tmp_path, cluster_src=FAKE_PROTO_CLUSTER):
+    d = tmp_path / "jylis_tpu" / "cluster"
+    d.mkdir(parents=True)
+    (d / "cluster.py").write_text(cluster_src)
+    (d / "msg.py").write_text(FAKE_PROTO_MSG)
+    return pass_protocol.extract(str(tmp_path))
+
+
+def test_protocol_extraction_maps_branches_to_effects(tmp_path):
+    atlas = _proto_tree(tmp_path)
+    assert atlas["messages"] == ["MsgData", "MsgPing"]
+    active = atlas["sections"]["role:active"]
+    assert active["MsgPing"]["effects"] == ["msg_drop:IGNORED"]
+    assert active["MsgData"]["effects"] == ["converge:data", "send:MsgPing"]
+    assert active["<fallthrough>"]["effects"] == ["drop:UNEXPECTED"]
+
+
+def test_protocol_silent_ignore_fires_jl1002(tmp_path):
+    atlas = _proto_tree(tmp_path)
+    path = str(tmp_path / "protocol.json")
+    pass_protocol.write_manifest(
+        path, str(tmp_path),
+    )
+    # notes still placeholders -> JL1003s; the silent passive MsgPing
+    # branch must ALSO fire JL1002 regardless
+    findings = pass_protocol.check(path, atlas)
+    assert any(
+        f.rule == "JL1002" and "MsgPing" in f.src and "NO observable" in f.msg
+        for f in findings
+    )
+
+
+def test_protocol_missing_branch_with_silent_fallthrough_fires_jl1002(
+    tmp_path,
+):
+    # a handler whose fall-through does nothing leaves unhandled
+    # message types as undeclared protocol holes
+    src = FAKE_PROTO_CLUSTER.replace(
+        '''    async def _passive_msg(self, conn, msg):
+        if isinstance(msg, MsgPing):
+            return  # SILENT ignore: JL1002
+        self._drop(conn, Drop.UNEXPECTED)''',
+        '''    async def _passive_msg(self, conn, msg):
+        if isinstance(msg, MsgPing):
+            self._drop_msg(conn, MsgDrop.IGNORED)
+            return''',
+    )
+    atlas = _proto_tree(tmp_path, src)
+    path = str(tmp_path / "protocol.json")
+    pass_protocol.write_manifest(path, str(tmp_path))
+    findings = pass_protocol.check(path, atlas)
+    assert any(
+        f.rule == "JL1002" and "MsgData" in f.msg
+        and "fall-through is silent" in f.msg
+        for f in findings
+    )
+
+
+def test_protocol_undeclared_effect_fires_jl1001(tmp_path):
+    atlas = _proto_tree(tmp_path)
+    path = str(tmp_path / "protocol.json")
+    manifest = pass_protocol.write_manifest(path, str(tmp_path))
+    # strip one extracted effect from the committed entry: the handler
+    # now does something the atlas does not permit
+    entry = manifest["sections"]["role:active"]["MsgData"]
+    entry["effects"] = [e for e in entry["effects"] if e != "send:MsgPing"]
+    with open(path, "w") as f:
+        json.dump(manifest, f)
+    findings = pass_protocol.check(path, atlas)
+    assert any(
+        f.rule == "JL1001" and "send:MsgPing" in f.msg for f in findings
+    )
+
+
+def test_protocol_drift_and_placeholders_fire_jl1003(tmp_path):
+    atlas = _proto_tree(tmp_path)
+    path = str(tmp_path / "protocol.json")
+    manifest = pass_protocol.write_manifest(path, str(tmp_path))
+    # stale declared effect + stale entry + placeholder notes
+    manifest["sections"]["role:active"]["MsgData"]["effects"].append(
+        "send:MsgGone"
+    )
+    manifest["sections"]["role:active"]["MsgVanished"] = {
+        "effects": [], "note": "an entry no branch backs",
+    }
+    with open(path, "w") as f:
+        json.dump(manifest, f)
+    findings = pass_protocol.check(path, atlas)
+    assert any(
+        f.rule == "JL1003" and "send:MsgGone" in f.msg for f in findings
+    )
+    assert any(
+        f.rule == "JL1003" and "MsgVanished" in f.msg for f in findings
+    )
+    assert any(
+        f.rule == "JL1003" and "has no note" in f.msg for f in findings
+    )
+
+
+def test_protocol_stale_section_fires_jl1003(tmp_path):
+    # a WHOLE section whose machinery left the source — entry-level
+    # drift can't see it (extract() skips absent functions)
+    atlas = _proto_tree(tmp_path)
+    path = str(tmp_path / "protocol.json")
+    manifest = pass_protocol.write_manifest(path, str(tmp_path))
+    manifest["sections"]["recv"] = {
+        "_read_loop": {"effects": [], "note": "machinery that is gone"},
+    }
+    with open(path, "w") as f:
+        json.dump(manifest, f)
+    findings = pass_protocol.check(path, atlas)
+    assert any(
+        f.rule == "JL1003" and "stale manifest section `recv`" in f.msg
+        for f in findings
+    )
+
+
+def test_protocol_missing_manifest_fires_jl1003(tmp_path):
+    atlas = _proto_tree(tmp_path)
+    findings = pass_protocol.check(str(tmp_path / "nope.json"), atlas)
+    assert [f.rule for f in findings] == ["JL1003"]
+    assert "missing" in findings[0].msg
+
+
+def test_protocol_message_inventory_drift_fires_jl1003(tmp_path):
+    atlas = _proto_tree(tmp_path)
+    path = str(tmp_path / "protocol.json")
+    manifest = pass_protocol.write_manifest(path, str(tmp_path))
+    manifest["messages"] = ["MsgData"]  # msg.py grew MsgPing unseen
+    with open(path, "w") as f:
+        json.dump(manifest, f)
+    findings = pass_protocol.check(path, atlas)
+    assert any(
+        f.rule == "JL1003" and "inventory drift" in f.msg for f in findings
+    )
+
+
+def test_protocol_write_manifest_preserves_notes(tmp_path):
+    _proto_tree(tmp_path)
+    path = str(tmp_path / "protocol.json")
+    manifest = pass_protocol.write_manifest(path, str(tmp_path))
+    manifest["sections"]["role:active"]["MsgData"]["note"] = "human words"
+    with open(path, "w") as f:
+        json.dump(manifest, f)
+    again = pass_protocol.write_manifest(path, str(tmp_path))
+    assert (
+        again["sections"]["role:active"]["MsgData"]["note"] == "human words"
+    )
+    assert (
+        again["sections"]["role:active"]["MsgPing"]["note"]
+        == pass_protocol.PLACEHOLDER
+    )
+
+
+def test_real_protocol_atlas_is_complete_and_committed():
+    """The committed manifest covers every (role, state, msg) pair the
+    real cluster.py reaches — zero undeclared effects, zero silent
+    fall-throughs, zero drift; and the dial/sync/send/recv machinery is
+    present. `make lint` is clean on pass 10."""
+    assert pass_protocol.check() == []
+    atlas = pass_protocol.extract()
+    manifest = pass_protocol.load_manifest()
+    assert manifest["messages"] == atlas["messages"]
+    for role in ("role:active", "role:passive"):
+        covered = set(atlas["sections"][role])
+        for msg in atlas["messages"]:
+            assert (
+                msg in covered
+                or atlas["sections"][role]["<fallthrough>"]["effects"]
+            ), (role, msg)
+    for section in ("handshake", "sync", "dial", "send", "recv"):
+        assert manifest["sections"][section], section

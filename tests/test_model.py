@@ -1,0 +1,201 @@
+"""jmodel: the schedule-replay corpus + bounded exploration tiers.
+
+Tier-1 (per commit): every schedule file under ``tests/model/`` replays
+with all invariants holding — the corpus accumulates one minimized
+counterexample per fixed protocol defect (plus the schema-pinning
+fixture), so a regression replays the exact interleaving that found the
+bug. A small bounded exploration also runs per commit; the deep sweep
+(bigger budgets, deeper frontier) rides ``-m soak``. ``make
+model-smoke`` (scripts/jmodel --smoke) is the recorded-coverage gate
+between the two.
+"""
+
+import glob
+import json
+import os
+
+import pytest
+
+from scripts.jmodel import MODEL_PERIODS, model_periods
+from scripts.jmodel.explore import (
+    SCHEDULE_SCHEMA,
+    Explorer,
+    minimize,
+    replay_schedule,
+    schedule_dict,
+)
+from scripts.jmodel.net import Link, Network, VirtualClock
+from scripts.jmodel.world import CONFIG_NAMES, Violation, World
+
+CORPUS = sorted(
+    glob.glob(os.path.join(os.path.dirname(__file__), "model", "*.json"))
+)
+
+
+# ---- corpus ----------------------------------------------------------------
+
+
+def test_corpus_exists_and_pins_schema():
+    """The corpus directory ships with at least the schema fixture, and
+    every committed schedule is a well-formed expect=pass regression."""
+    assert CORPUS, "tests/model/ must hold at least the schema fixture"
+    for path in CORPUS:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        assert data["schema"] == SCHEDULE_SCHEMA, path
+        assert data["config"] in CONFIG_NAMES, path
+        assert data["expect"] == "pass", (
+            f"{path}: a committed schedule must expect 'pass' — an "
+            "invariant name means an UNFIXED defect was committed"
+        )
+        assert isinstance(data["actions"], list) and data["actions"], path
+
+
+@pytest.mark.parametrize(
+    "path", CORPUS, ids=[os.path.basename(p) for p in CORPUS]
+)
+def test_corpus_replays_clean(path):
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    with model_periods():
+        violation = replay_schedule(data)
+    assert violation is None, (
+        f"{os.path.basename(path)} regressed: {violation} — the defect "
+        "this schedule pinned has come back"
+    )
+
+
+def test_replay_skips_actions_the_protocol_no_longer_enables():
+    """A schedule referencing a conn that never exists degrades to a
+    weaker test (skipped action), never a spurious failure — corpus
+    files must survive protocol evolution."""
+    sched = schedule_dict(
+        "nodes2",
+        [("deliver", "A>B#9", "fwd"), ("tick", "A"), ("quiesce",)],
+    )
+    with model_periods():
+        assert replay_schedule(sched) is None
+
+
+# ---- explorer machinery ----------------------------------------------------
+
+
+def test_quick_exploration_holds_all_invariants():
+    with model_periods():
+        result = Explorer("nodes2", 3).run()
+    assert result.violation is None, result.violation
+    assert result.states > 50
+    assert result.quiesced >= 1  # the first leaf always quiesces
+
+
+def test_exploration_is_deterministic():
+    with model_periods():
+        a = Explorer("nodes3", 2).run()
+        b = Explorer("nodes3", 2).run()
+    assert (a.states, a.leaves) == (b.states, b.leaves)
+
+
+def test_lanes_world_bridges_and_converges():
+    """The 2-lane config: a write on lane 1 reaches the external node E
+    through the bus -> lane-0 bridge -> external mesh relay chain."""
+    with model_periods():
+        world = World("lanes2")
+        try:
+            world.apply(("write", "L1"))
+            world.quiesce()
+            digests = set(world._digests().values())
+            assert len(digests) == 1
+            # the seed writes + L1's extra write all visible everywhere
+            assert world.dbs["E"].state == world.dbs["L1"].state
+        finally:
+            world.close()
+
+
+def test_crash_reboot_recovers_local_writes_and_reconverges():
+    with model_periods():
+        world = World("nodes2")
+        try:
+            world.apply(("write", "A"))
+            world.apply(("crash", "A"))
+            # the journaled local writes survived the reboot
+            assert world.dbs["A"].state[b"x"][1] == 2
+            world.quiesce()
+            assert len(set(world._digests().values())) == 1
+        finally:
+            world.close()
+
+
+def test_minimizer_shrinks_to_the_failing_core(monkeypatch):
+    from scripts.jmodel import explore
+
+    def fake_replay(data, budgets=None, runtime=None):
+        acts = [tuple(a) for a in data["actions"]]
+        if ("tick", "A") in acts and ("tick", "B") in acts:
+            return Violation("fake", "both ticks present")
+        return None
+
+    monkeypatch.setattr(explore, "replay_schedule", fake_replay)
+    out = minimize(
+        "nodes2",
+        [("tick", "A"), ("write", "A"), ("tick", "B"), ("write", "B")],
+        "fake",
+    )
+    assert out == [("tick", "A"), ("tick", "B")]
+
+
+def test_model_periods_patch_is_scoped():
+    from jylis_tpu.cluster import cluster as cluster_mod
+
+    before = cluster_mod.SYNC_PERIOD_TICKS
+    with model_periods():
+        assert cluster_mod.SYNC_PERIOD_TICKS == (
+            MODEL_PERIODS["SYNC_PERIOD_TICKS"]
+        )
+    assert cluster_mod.SYNC_PERIOD_TICKS == before
+
+
+# ---- model network semantics ----------------------------------------------
+
+
+def test_virtual_clock_is_explorer_driven():
+    clock = VirtualClock()
+    t0 = clock.now_ms()
+    assert clock.now_ms() == t0  # never advances on its own
+    clock.advance(250)
+    assert clock.now_ms() == t0 + 250
+    assert clock.perf() < clock.perf()  # strictly increasing stamps
+
+
+def test_link_kill_discards_in_flight_frames():
+    net = Network()
+    link = Link("t/fwd", net)
+    link.write(b"frame1")
+    link.deliver_one()
+    link.write(b"frame2")
+    link.kill()
+    assert link.eof
+    assert not link.outbox and not link.inbox  # torn-down socket = loss
+
+
+# ---- the deep sweep (nightly) ----------------------------------------------
+
+
+@pytest.mark.soak
+@pytest.mark.parametrize(
+    "config,depth",
+    [("nodes2", 8), ("nodes3", 6), ("lanes2", 6)],
+)
+def test_soak_deep_exploration(config, depth):
+    """Bigger budgets (two kills / dups / crashes), deeper frontier,
+    denser quiescence sampling — bounded by max_states so the nightly
+    stays finite."""
+    with model_periods():
+        result = Explorer(
+            config,
+            depth,
+            budgets={"kills": 2, "dups": 2, "crashes": 2},
+            quiesce_every=32,
+            max_states=60_000,
+        ).run()
+    assert result.violation is None, result.violation
+    assert result.states > 1_000
