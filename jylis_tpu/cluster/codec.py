@@ -33,6 +33,7 @@ from __future__ import annotations
 import hashlib
 
 from ..ops.p2set import P2Set
+from ..ops.tensor_host import Tensor
 from ..ops.ujson_host import UJSON
 from ..ops.ujson_wire import read_ujson
 from ..utils.address import Address
@@ -48,7 +49,7 @@ from .msg import (
     MsgSyncRequest,
 )
 
-SCHEMA_VERSION = 6
+SCHEMA_VERSION = 7
 
 # The canonical schema text: any change to the wire format MUST change this
 # string (bump SCHEMA_VERSION), which changes the signature, which makes
@@ -74,6 +75,17 @@ SCHEMA_VERSION = 6
 # answers a round-trip-stamped send and the rtt histogram's FIFO
 # matching stays exact — a sync reply's timing includes digest
 # computation or a whole dump stream, which is not a round trip.
+# v7: the TENSOR data type (ops/tensor_host.py — fixed-dim f32 vectors
+# with per-coordinate MAX / LWW / timestamp-weighted-AVG joins). One
+# uniform delta shape for all three merge modes: every plane ships
+# every time (empty bytes for the planes a mode does not use), so the
+# encoder/decoder bodies stay branch-free for pass 7's symmetry
+# extractor. `vec` payloads are packed little-endian f32 with NaNs
+# canonicalised at ingest. This is the FIRST delta-line change since
+# v1, so delta_signature() changes for the first time: v1-v6 snapshots
+# and journals (which stamp the delta signature) stay loadable via the
+# legacy acceptance below — they contain only old-type frames, all
+# still decodable.
 _SCHEMA_TEXT = f"""jylis-tpu cluster schema v{SCHEMA_VERSION}
 varint=LEB128 bytes=varint-len-prefixed str=utf8-bytes
 wire=frame(crc32(origin_ms:u64be body):u32be origin_ms:u64be body)
@@ -84,13 +96,14 @@ msg0=Pong
 msg1=ExchangeAddrs(p2set)
 msg2=AnnounceAddrs(p2set)
 msg3=PushDeltas(name:str batch:[(key:bytes delta)])
-msg4=SyncRequest(digests:[bytes] order=TREG,TLOG,GCOUNT,PNCOUNT,UJSON)
+msg4=SyncRequest(digests:[bytes] order=TREG,TLOG,GCOUNT,PNCOUNT,UJSON,TENSOR)
 msg5=SyncDone
 delta/TREG=(value:bytes ts:varint)
 delta/TLOG=delta/SYSTEM=(entries:[(value:bytes ts:varint)] cutoff:varint)
 delta/GCOUNT=[(rid:varint v:varint)]
 delta/PNCOUNT=(gcount gcount)
 delta/UJSON=(entries:[(rid seq path:[str] token:str)] vv:[(rid seq)] cloud:[(rid seq)])
+delta/TENSOR=(mode:varint dim:varint val:bytes ts:bytes rid:bytes contribs:[(rid:varint ts:varint vec:bytes)])
 """
 
 
@@ -172,16 +185,56 @@ delta/UJSON=(entries:[(rid seq path:[str] token:str)] vv:[(rid seq)] cloud:[(rid
 """
 
 
+# v4 through v6 stamped delta_signature() into snapshot AND journal
+# headers; their delta lines are byte-identical to v1's, so the ONE
+# legacy delta digest below covers that whole window. Frozen verbatim
+# (not derived from _SCHEMA_TEXT) like the full-signature texts above.
+_LEGACY_V6_TEXT = """jylis-tpu cluster schema v6
+varint=LEB128 bytes=varint-len-prefixed str=utf8-bytes
+wire=frame(crc32(origin_ms:u64be body):u32be origin_ms:u64be body)
+handshake=wire(sig:32B dialer-addr:addr?)
+addr=(host:str port:str name:str)
+p2set=(adds:[addr] removes:[addr])
+msg0=Pong
+msg1=ExchangeAddrs(p2set)
+msg2=AnnounceAddrs(p2set)
+msg3=PushDeltas(name:str batch:[(key:bytes delta)])
+msg4=SyncRequest(digests:[bytes] order=TREG,TLOG,GCOUNT,PNCOUNT,UJSON)
+msg5=SyncDone
+delta/TREG=(value:bytes ts:varint)
+delta/TLOG=delta/SYSTEM=(entries:[(value:bytes ts:varint)] cutoff:varint)
+delta/GCOUNT=[(rid:varint v:varint)]
+delta/PNCOUNT=(gcount gcount)
+delta/UJSON=(entries:[(rid seq path:[str] token:str)] vv:[(rid seq)] cloud:[(rid seq)])
+"""
+
+
+def legacy_delta_signatures() -> tuple[bytes, ...]:
+    """DELTA-schema digests of older releases whose frames this build
+    still decodes: the v1-v6 delta lines (unchanged across that whole
+    window) hash to one digest, stamped into every v4+ snapshot and
+    journal header on disk. v7 added delta/TENSOR — a pure extension,
+    so those files' frames all still decode."""
+    delta_lines = [
+        line
+        for line in _LEGACY_V6_TEXT.splitlines()
+        if line.startswith("delta/") or line.startswith("varint=")
+    ]
+    return (hashlib.sha256("\n".join(delta_lines).encode()).digest(),)
+
+
 def legacy_snapshot_signatures() -> tuple[bytes, ...]:
     """Snapshot headers older releases wrote that THIS build still reads:
-    the delta encodings they version are unchanged (persist.py accepts
+    every frame they version is still decodable (persist.py accepts
     these alongside delta_signature(), so upgrading a single-node
-    deployment never strands its only data copy)."""
+    deployment never strands its only data copy). The early releases
+    stamped the FULL schema signature; v4+ stamped the delta signature
+    (now also legacy after the v7 delta/TENSOR addition)."""
     return (
         hashlib.sha256(_LEGACY_V1_TEXT.encode()).digest(),
         hashlib.sha256(_LEGACY_V2_TEXT.encode()).digest(),
         hashlib.sha256(_LEGACY_V3_TEXT.encode()).digest(),
-    )
+    ) + legacy_delta_signatures()
 
 
 # the reader primitives live in utils/wire.py (shared with the lazy wire
@@ -325,6 +378,41 @@ def _r_ujson(r: _Reader) -> UJSON:
     return read_ujson(r)  # single implementation: ops/ujson_wire.py
 
 
+def _w_tensor(out: bytearray, t: Tensor) -> None:
+    # uniform shape for all three merge modes (branch-free unit: pass 7)
+    _w_varint(out, t.mode)
+    _w_varint(out, t.dim)
+    _w_bytes(out, t.val)
+    _w_bytes(out, t.ts)
+    _w_bytes(out, t.rid)
+    _w_varint(out, len(t.contribs))
+    for rid in sorted(t.contribs):
+        cts, vec = t.contribs[rid]
+        _w_varint(out, rid)
+        _w_varint(out, cts)
+        _w_bytes(out, vec)
+
+
+def _r_tensor(r: _Reader) -> Tensor:
+    mode = r.varint()
+    dim = r.varint()
+    val = r.bytes_()
+    ts = r.bytes_()
+    rid = r.bytes_()
+    n = r.varint()
+    contribs: dict[int, tuple[int, bytes]] = {}
+    for _ in range(n):
+        crid = r.varint()
+        cts = r.varint()
+        contribs[crid] = (cts, r.bytes_())
+    if len(contribs) != n:
+        # a repeated rid would silently last-entry-win past the per-rid
+        # join — the canonical encoding never produces one
+        raise CodecError("duplicate tensor contribution rid")
+    # shape validation happens in from_wire; a WireError IS a CodecError
+    return Tensor.from_wire(mode, dim, val, ts, rid, contribs)
+
+
 def _w_delta(out: bytearray, name: str, delta) -> None:
     if name == "TREG":
         value, ts = delta
@@ -340,6 +428,8 @@ def _w_delta(out: bytearray, name: str, delta) -> None:
         _w_gcount_dict(out, dn)
     elif name == "UJSON":
         _w_ujson(out, delta)
+    elif name == "TENSOR":
+        _w_tensor(out, delta)
     else:
         raise CodecError(f"unknown data type: {name}")
 
@@ -355,7 +445,27 @@ def _r_delta(r: _Reader, name: str):
         return _r_gcount_dict(r), _r_gcount_dict(r)
     if name == "UJSON":
         return _r_ujson(r)
+    if name == "TENSOR":
+        return _r_tensor(r)
     raise CodecError(f"unknown data type: {name}")
+
+
+def encode_delta(name: str, delta) -> bytes:
+    """One bare per-type delta payload (no message framing): what
+    TENSOR MRG accepts as its binary bulk payload, and what tests use
+    to pin delta bytes without a whole PushDeltas."""
+    out = bytearray()
+    _w_delta(out, name, delta)
+    return bytes(out)
+
+
+def decode_delta(name: str, blob: bytes):
+    """Inverse of encode_delta; raises CodecError on trailing bytes."""
+    r = _Reader(blob)
+    delta = _r_delta(r, name)
+    if not r.done():
+        raise CodecError("trailing bytes after delta")
+    return delta
 
 
 # ---- messages --------------------------------------------------------------

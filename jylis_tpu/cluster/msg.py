@@ -69,7 +69,8 @@ class MsgSyncRequest:
     snapshot wire shape, persist.py), which converge idempotently.
 
     digests: one 32-byte incremental digest per DATA type, in
-    Database.DATA_TYPES order (TREG, TLOG, GCOUNT, PNCOUNT, UJSON —
+    Database.DATA_TYPES order (TREG, TLOG, GCOUNT, PNCOUNT, UJSON,
+    TENSOR —
     SYSTEM excluded: its log advances on connection events themselves,
     which would make two in-sync peers never match). Each is the XOR of
     sha256(canonical per-key state) over the type's keys."""

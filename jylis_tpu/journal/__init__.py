@@ -4,6 +4,7 @@ from .journal import (  # noqa: F401
     FSYNC_ALWAYS,
     FSYNC_INTERVAL,
     FSYNC_OFF,
+    HEADER_LEN,
     Journal,
     JournalError,
     MAGIC,

@@ -554,9 +554,12 @@ def read_journal(path: str):
         raise JournalError("not a journal file")
     if blob[: len(MAGIC)] != MAGIC:
         raise JournalError("not a journal file")
-    if blob[len(MAGIC) : HEADER_LEN] != codec.delta_signature():
+    accepted = (codec.delta_signature(),) + codec.legacy_delta_signatures()
+    if blob[len(MAGIC) : HEADER_LEN] not in accepted:
         # NOT loadable by this build: the caller moves the file aside as
-        # .unreadable rather than deleting the only copy
+        # .unreadable rather than deleting the only copy. Legacy delta
+        # signatures (pre-v7, before delta/TENSOR) ARE loadable: their
+        # frames carry only old-type payloads this codec still decodes.
         raise JournalError("journal schema signature mismatch")
     # local-disk read, like snapshots: lift the wire-oriented frame cap
     frames = FrameReader(max_frame=1 << 62)
@@ -599,6 +602,16 @@ def replay_journal(database, path: str, truncate_tail: bool = True) -> int:
         raise JournalError(f"cannot read journal: {e}") from None
     if truncate_tail and good_end < total:
         os.truncate(path, good_end)
+    if truncate_tail and _header_is_legacy(path):
+        # a legacy-delta-signature segment is about to be APPENDED to by
+        # this build's Journal.open(): re-stamp it in the current schema
+        # first, or new-type frames would land in a file whose header
+        # promises the old delta encodings (a rolled-back build would
+        # then classify the whole segment as corrupt mid-replay instead
+        # of refusing it cleanly at the header). Foreign lane segments
+        # (truncate_tail=False) belong to live siblings and are never
+        # touched.
+        _migrate_legacy_segment(path, msgs)
     # fully validated: only now touch the database. load_state (not bare
     # converge) for the same reason snapshots use it: this node's own
     # counter columns are private monotonic state — converging them as
@@ -611,6 +624,33 @@ def replay_journal(database, path: str, truncate_tail: bool = True) -> int:
         database.drain_all()
         _db_registry(database).note_journal("replayed_batches", len(msgs))
     return len(msgs)
+
+
+def _header_is_legacy(path: str) -> bool:
+    with open(path, "rb") as f:
+        hdr = f.read(HEADER_LEN)
+    return (
+        len(hdr) == HEADER_LEN
+        and hdr[: len(MAGIC)] == MAGIC
+        and hdr[len(MAGIC):] != codec.delta_signature()
+    )
+
+
+def _migrate_legacy_segment(path: str, msgs) -> None:
+    """Atomically rewrite a validated legacy segment under the CURRENT
+    delta signature (same batches, re-encoded — the delta content is
+    schema-compatible by the legacy-acceptance contract). Write-then-
+    rename like snapshots: a crash leaves either the old valid file or
+    the new valid file, never a torn one."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(MAGIC + codec.delta_signature())
+        for msg in msgs:
+            payload = codec.encode(msg)
+            f.write(frame(struct.pack(">I", zlib.crc32(payload)) + payload))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
 
 
 def _db_registry(database):

@@ -15,6 +15,7 @@ from .help import DATATYPE_HELP, respond_help
 from .manager import RepoManager
 from .repo_counters import RepoGCOUNT, RepoPNCOUNT
 from .repo_system import RepoSYSTEM
+from .repo_tensor import RepoTENSOR
 from .repo_treg import RepoTREG
 from .repo_tlog import RepoTLOG
 from .repo_ujson import RepoUJSON
@@ -57,6 +58,7 @@ class Database:
             RepoGCOUNT(identity, engine=self.native_engine),
             RepoPNCOUNT(identity, engine=self.native_engine),
             RepoUJSON(identity, engine=self.native_engine),
+            RepoTENSOR(identity, engine=self.native_engine),
             self.system,
         ):
             # timed_drain resolves the registry through this attribute,
@@ -70,7 +72,9 @@ class Database:
         # a map of key -> sha256(canonical per-key state) and the running
         # XOR of those hashes. Updating costs O(keys dirty since the last
         # pass) — a reconnect never dumps the keyspace to compute 32 bytes.
-        self.DATA_TYPES = ("TREG", "TLOG", "GCOUNT", "PNCOUNT", "UJSON")
+        self.DATA_TYPES = (
+            "TREG", "TLOG", "GCOUNT", "PNCOUNT", "UJSON", "TENSOR"
+        )
         self._sync_hash: dict[str, dict[bytes, bytes]] = {
             n: {} for n in self.DATA_TYPES
         }
@@ -305,6 +309,9 @@ def warmup() -> None:
         b"TLOG GET k",
         b"UJSON SET k a 1",
         b"UJSON GET k a",
+        # the f32 payload (1.0f LE) is space-free, so the split survives
+        b"TENSOR SET k MAX 1 \x00\x00\x80?",
+        b"TENSOR GET k",
     ):
         db.apply(resp, line.split(b" "))
     # counter GETs after purely-local INCs serve from the host cache and
@@ -314,3 +321,6 @@ def warmup() -> None:
     db.apply(resp, [b"GCOUNT", b"GET", b"k"])
     db.manager("PNCOUNT").repo.converge(b"k", ({7: 1}, {7: 1}))
     db.apply(resp, [b"PNCOUNT", b"GET", b"k"])
+    # TENSOR GETs never touch the device; the threshold/converge drain
+    # kernel compiles here at its default bucket shape, not mid-serving
+    db.manager("TENSOR").repo.drain()

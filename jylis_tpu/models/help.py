@@ -58,5 +58,6 @@ The following are valid data types (case sensitive):
   GCOUNT  - Grow-Only Counter
   PNCOUNT - Positive/Negative Counter
   UJSON   - Unordered JSON (Nested Observed-Remove Maps and Sets)
+  TENSOR  - Tensor Register (Per-Coordinate Convergent Merges)
   SYSTEM  - (miscellaneous system-level operations)
 """

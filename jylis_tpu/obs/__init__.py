@@ -52,6 +52,7 @@ SEAMS = (
     "drain.TLOG",
     "drain.GCOUNT",
     "drain.PNCOUNT",
+    "drain.TENSOR",
     "server.native_burst",
     "server.py_dispatch",
     "journal.append",

@@ -1,0 +1,181 @@
+"""TENSOR: batched per-coordinate tensor joins as device kernels.
+
+The device mirror of ops/tensor_host.py — the first lattice in this
+repo whose VALUES are tensors, so the (keys x dims) planes are finally
+the shape the north-star device path exists for: thousands of vector
+merges collapse into one XLA launch (ROADMAP item 3; arXiv:2605.19373,
+arXiv:2607.01308).
+
+Layout: the keyspace is four (N, D) planes —
+
+    val    u32  raw f32 bit patterns (okey-comparable, see below)
+    ts_hi  u32  } u64 per-coordinate timestamp as hi/lo planes
+    ts_lo  u32  } (the planes.py u64-emulation posture: u32 ops only)
+    rid    u32  writer replica-id tiebreak
+
+One row is one MAX/LWW register vector, or one AVG CONTRIBUTION (the
+repo maps AVG keys to one device row per contributing replica, so all
+three merge modes run the SAME kernel). The join is a per-coordinate
+lexicographic select on ``(ts, rid, okey(val))``:
+
+* LWW rows carry real (ts, rid) stamps — the select IS per-coordinate
+  last-writer-wins with replica-id tiebreak and a value-bits total
+  order at the bottom.
+* MAX rows carry ts = rid = 0 — the select degenerates to elementwise
+  float max via ``okey``, the order-preserving u32 transform of the f32
+  bit pattern (sign-flip trick: unsigned integer compares match IEEE
+  order, totalised; the canonical quiet NaN is the per-coordinate top,
+  bit pattern 0xFFFFFFFF — okey 0 — is the identity padding).
+* AVG contribution rows carry a LOCAL monotone version stamp in the ts
+  planes (rid broadcast per row) — the host joins same-rid
+  contributions as whole vectors (lexicographic (ts, okey-tuple)),
+  which no per-coordinate select can reproduce at equal-ts ties, so
+  the mirror takes the host's latest whole-vector winner instead
+  (models/repo_tensor.py drain).
+
+``join_dense`` is literally ``jax.vmap`` of the one-row join over the
+keys axis — the "one vmap'd XLA join over (keys x dims) planes" the
+type was specified as. NaN canonicalisation happens at the host
+boundary (tensor_host.canon_f32); these kernels only ever see
+canonical bit patterns and compare them as integers, so no float
+comparison semantics leak into the lattice.
+
+Contract: one batch holds at most one delta per row (the repos
+coalesce per key host-side, the repo_gcount.pony:43-48 pattern);
+``converge_many`` folds several replica batches in one compiled scan.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+U32 = jnp.uint32
+
+# per-coordinate identity bits: okey == 0, below every canonical float
+BOTTOM_BITS = 0xFFFFFFFF
+
+
+class TensorState(NamedTuple):
+    val: jax.Array  # (N, D) uint32 f32 bit patterns
+    ts_hi: jax.Array  # (N, D) uint32
+    ts_lo: jax.Array  # (N, D) uint32
+    rid: jax.Array  # (N, D) uint32
+
+
+def init(num_rows: int, dim: int) -> TensorState:
+    return TensorState(
+        jnp.full((num_rows, dim), BOTTOM_BITS, U32),
+        jnp.zeros((num_rows, dim), U32),
+        jnp.zeros((num_rows, dim), U32),
+        jnp.zeros((num_rows, dim), U32),
+    )
+
+
+def _okey(u):
+    """Order-preserving u32 transform of f32 bits (tensor_host.okey_u32)."""
+    return jnp.where(
+        (u >> jnp.uint32(31)).astype(jnp.bool_), ~u, u | jnp.uint32(0x80000000)
+    )
+
+
+def _b_wins(a: tuple, b: tuple):
+    """Per-coordinate strict (ts, rid, okey(val)) dominance of B over A.
+
+    A total order on cells: ties on all four u32 components mean the
+    cells are bit-identical, so strict-greater select is commutative,
+    associative, and idempotent by construction."""
+    a_v, a_th, a_tl, a_r = a
+    b_v, b_th, b_tl, b_r = b
+    ts_gt = (b_th > a_th) | ((b_th == a_th) & (b_tl > a_tl))
+    ts_eq = (b_th == a_th) & (b_tl == a_tl)
+    return ts_gt | (
+        ts_eq & ((b_r > a_r) | ((b_r == a_r) & (_okey(b_v) > _okey(a_v))))
+    )
+
+
+def _join_row(a_v, a_th, a_tl, a_r, b_v, b_th, b_tl, b_r):
+    """Join ONE row's (D,) cell vectors — the unit the keys axis vmaps."""
+    wins = _b_wins((a_v, a_th, a_tl, a_r), (b_v, b_th, b_tl, b_r))
+    return (
+        jnp.where(wins, b_v, a_v),
+        jnp.where(wins, b_th, a_th),
+        jnp.where(wins, b_tl, a_tl),
+        jnp.where(wins, b_r, a_r),
+    )
+
+
+# the dense full-keyspace join: one row-join vmapped over the keys axis
+_join_rows = jax.vmap(_join_row)
+
+
+def join_dense(state: TensorState, deltas: TensorState) -> TensorState:
+    """Full-keyspace elementwise join — each plane streamed exactly once
+    (the north-star dense shape; rows with no delta carry the identity
+    (BOTTOM_BITS, 0, 0, 0), which never wins)."""
+    return TensorState(*_join_rows(*state, *deltas))
+
+
+def converge_batch(
+    state: TensorState,
+    key_idx: jax.Array,
+    d_val: jax.Array,
+    d_ts_hi: jax.Array,
+    d_ts_lo: jax.Array,
+    d_rid: jax.Array,
+) -> TensorState:
+    """Join one delta batch at UNIQUE rows: gather the current (B, D)
+    cell blocks, vmap the row join over the batch, scatter both back
+    (mode="drop" for pad rows)."""
+    cur = tuple(plane[key_idx] for plane in state)
+    new = _join_rows(*cur, d_val, d_ts_hi, d_ts_lo, d_rid)
+    return TensorState(
+        *(
+            plane.at[key_idx].set(nv, mode="drop", unique_indices=True)
+            for plane, nv in zip(state, new)
+        )
+    )
+
+
+def converge_many(
+    state: TensorState,
+    key_idx: jax.Array,
+    d_val: jax.Array,
+    d_ts_hi: jax.Array,
+    d_ts_lo: jax.Array,
+    d_rid: jax.Array,
+) -> TensorState:
+    """Fold several replica batches ((R, B)-indexed inputs) in one
+    compiled scan — a whole multi-replica anti-entropy round as a
+    single dispatch, for offline folds where batches arrive pre-formed
+    (the treg.converge_many posture; NOT on the serving path, which
+    coalesces per key host-side and drains one batch)."""
+
+    def step(st, batch):
+        ki, v, th, tl, r = batch
+        return converge_batch(st, ki, v, th, tl, r), None
+
+    out, _ = jax.lax.scan(
+        step, state, (key_idx, d_val, d_ts_hi, d_ts_lo, d_rid)
+    )
+    return out
+
+
+def read(state: TensorState, key_idx: jax.Array) -> jax.Array:
+    """Gather raw f32 bit-pattern rows for a batch of row indices."""
+    return state.val[key_idx]
+
+
+def grow(state: TensorState, num_rows: int, dim: int) -> TensorState:
+    n, d = state.val.shape
+    if (num_rows, dim) == (n, d):
+        return state
+    fresh = init(num_rows, dim)
+    return TensorState(
+        *(
+            f.at[:n, :d].set(p)
+            for f, p in zip(fresh, state)
+        )
+    )

@@ -102,12 +102,17 @@ CLAIMS = [
     # timings, ops/ujson_resident.py's round-3 environment numbers).
     ("jylis_tpu/parallel/PLAN.md", "north-star", "value", fmt_millions,
      "{} merges/s/chip recorded", "PLAN north-star merges/s"),
-    ("jylis_tpu/parallel/PLAN.md", "pallas-join", "vs_baseline", fmt_ratio,
-     "measures {} the XLA path", "PLAN pallas ratio"),
-    ("jylis_tpu/ops/pallas_join.py", "north-star", "value", fmt_millions,
-     "{} merges/sec/chip recorded", "pallas doc north-star rate"),
-    ("jylis_tpu/ops/pallas_join.py", "pallas-join", "value", fmt_millions,
-     "same workload, {} merges/sec recorded", "pallas doc kernel rate"),
+    # TENSOR round: the recorded tensor-merge numbers and the Pallas
+    # settlement ratio, pinned wherever the prose claims them (the
+    # pallas_join.py claims retired with the module)
+    ("docs/tensor.md", "tensor-merge", "value", fmt_millions,
+     "records **{} vector merges/sec**", "tensor doc merge rate"),
+    ("docs/tensor.md", "tensor-merge", "vs_baseline", fmt_ratio,
+     "{} the vectorised-numpy", "tensor doc merge ratio"),
+    ("docs/tensor.md", "pallas-tensor-merge", "vs_baseline", fmt_frac,
+     "recorded ratio of {}", "tensor doc pallas settlement ratio"),
+    ("README.md", "tensor-merge", "value", fmt_millions,
+     "TENSOR joins {} vector merges/sec", "README tensor merge rate"),
     ("docs/operations.md", "gcount-smoke", "socket_cost_frac", fmt_percent,
      "= {} of throughput", "operations doc socket cost"),
     ("docs/operations.md", "gcount-smoke", "engine_only", fmt_millions,

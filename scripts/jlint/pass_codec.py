@@ -82,7 +82,9 @@ TAG_UNITS = {
     "_TAG_SYNC_DONE": "SyncDone",
 }
 
-DELTA_TYPES = ("TREG", "TLOG", "SYSTEM", "GCOUNT", "PNCOUNT", "UJSON")
+DELTA_TYPES = (
+    "TREG", "TLOG", "SYSTEM", "GCOUNT", "PNCOUNT", "UJSON", "TENSOR"
+)
 
 _STRUCT_TOKENS = {"B": "u8", "H": "u16", "I": "u32", "Q": "u64", "i": "i32", "q": "i64"}
 
@@ -455,6 +457,7 @@ def extract_message_units(
 _ATOM_CALLS = {
     "delta_signature": "delta_signature",
     "legacy_snapshot_signatures": "legacy_accepted",
+    "legacy_delta_signatures": "legacy_accepted",
     "frame": "framing",
     "FrameReader": "framing",
     "build_header": "framing",
@@ -537,10 +540,14 @@ def extract_atom_units(root: str = ROOT) -> dict[str, dict]:
     for a in _atoms(_class_method(journal_tree, "Journal", "_run")):
         if a not in writer:
             writer.append(a)
+    jreader = _atoms(journal["read_journal"])
     units["file/journal"] = {
         "grade": "atoms",
         "encode": writer,
-        "decode": _atoms(journal["read_journal"]),
+        # legacy-signature acceptance is a version flag, not a wire
+        # field (the file/snapshot precedent)
+        "decode": [a for a in jreader if a != "legacy_accepted"],
+        "accepts_legacy": "legacy_accepted" in jreader,
     }
     loader = _atoms(persist["load_snapshot"])
     units["file/snapshot"] = {
@@ -824,10 +831,22 @@ def build_corpus() -> dict:
         MsgSyncRequest,
     )
     from jylis_tpu.ops.p2set import P2Set
+    from jylis_tpu.ops.tensor_host import Tensor
     from jylis_tpu.ops.ujson_host import UJSON
     from jylis_tpu.utils.address import Address
     import struct
     import zlib
+
+    def tensor_deltas():
+        """One key per merge mode, so all three TENSOR shapes byte-pin."""
+        lww = Tensor.lww(struct.pack("<2f", 1.5, -2.0), ts=9, rid=3)
+        av = Tensor.avg(1, 4, struct.pack("<2f", 0.5, 0.25))
+        av.converge(Tensor.avg(2, 6, struct.pack("<2f", 8.0, 1.0)))
+        return (
+            (b"kmax", Tensor.max_value(struct.pack("<2f", 1.0, -0.0))),
+            (b"klww", lww),
+            (b"kavg", av),
+        )
 
     def ujson_delta() -> UJSON:
         u = UJSON()
@@ -859,6 +878,7 @@ def build_corpus() -> dict:
             "PNCOUNT", ((b"k1", ({1: 10}, {2: 4})),)
         ),
         "delta/UJSON": MsgPushDeltas("UJSON", ((b"k1", ujson_delta()),)),
+        "delta/TENSOR": MsgPushDeltas("TENSOR", tensor_deltas()),
     }
     entries: dict[str, dict] = {}
     for name, msg in sorted(messages.items()):
@@ -882,7 +902,9 @@ def build_corpus() -> dict:
     entries["file/journal"] = {"hex": journal_blob.hex()}
     # file/snapshot: header + one frame per data type (wire-delta dump)
     snap_blob = b"JYLSNAP1" + codec.delta_signature()
-    for name in ("TREG", "TLOG", "GCOUNT", "PNCOUNT", "UJSON", "SYSTEM"):
+    for name in (
+        "TREG", "TLOG", "GCOUNT", "PNCOUNT", "UJSON", "TENSOR", "SYSTEM"
+    ):
         key = "delta/" + name
         snap_blob += frame(codec._encode_oracle(messages[key]))
     entries["file/snapshot"] = {"hex": snap_blob.hex()}

@@ -120,6 +120,7 @@ HARNESS_TYPES = {
     "GCOUNT": {"lattice": "jylis_tpu.ops.hostref:GCounter", "gen": "gen_gcount"},
     "PNCOUNT": {"lattice": "jylis_tpu.ops.hostref:PNCounter", "gen": "gen_pncount"},
     "UJSON": {"lattice": "jylis_tpu.ops.ujson_host:UJSON", "gen": "gen_ujson"},
+    "TENSOR": {"lattice": "jylis_tpu.ops.tensor_host:Tensor", "gen": "gen_tensor"},
 }
 
 
@@ -535,6 +536,7 @@ import copy
 import importlib
 import os
 import random
+import struct
 import sys
 
 import pytest
@@ -563,6 +565,10 @@ def _canon(x):
         return ("TR", x.is_set, x.ts, x.value)
     if name == "TLog":
         return ("TL", tuple(x.entries), x.cutoff)
+    if name == "Tensor":
+        # already representation-normal: packed canonical bytes + sorted
+        # contribution tuples (tensor_host.Tensor.canon)
+        return ("TS",) + x.canon()
     # UJSON: entries + fully-compacted causal context
     x.ctx.compact()
     return (
@@ -630,6 +636,44 @@ def gen_ujson(rng, cls):
         u.ctx.add((rng.randint(1, 4), rng.randint(1, 6)))
     u.ctx.compact()
     return u
+
+
+def gen_tensor(rng, cls):
+    """Random mode/dim/coordinates, NaN and ±inf included: the lattice
+    totalises IEEE order via okey (canonical NaN = per-coordinate top),
+    so the laws must hold across the whole float line. Mode and dim
+    vary so the (mode, dim) dominance rule is exercised too. AVG
+    payloads may collide on (rid, ts) with different vectors — the
+    value-bits tiebreak keeps even that adversarial case lawful."""
+    t = cls()
+    if rng.random() < 0.1:
+        return t  # unset bottom
+    mode = rng.choice((1, 2, 3))  # MAX, LWW, AVG
+    dim = rng.choice((1, 2, 3))
+
+    def vec():
+        vals = []
+        for _ in range(dim):
+            r = rng.random()
+            if r < 0.08:
+                vals.append(float("nan"))
+            elif r < 0.16:
+                vals.append(float("inf") if r < 0.12 else float("-inf"))
+            else:
+                vals.append(rng.uniform(-4.0, 4.0))
+        return struct.pack(f"<{{dim}}f", *vals)
+
+    if mode == 1:
+        return cls.max_value(vec())
+    if mode == 2:
+        out = cls.lww(vec(), rng.randint(0, 4), rng.randint(1, 4))
+        for _ in range(rng.randint(0, 2)):
+            out.converge(cls.lww(vec(), rng.randint(0, 4), rng.randint(1, 4)))
+        return out
+    out = cls.avg(rng.randint(1, 4), rng.randint(0, 4), vec())
+    for _ in range(rng.randint(0, 2)):
+        out.converge(cls.avg(rng.randint(1, 4), rng.randint(0, 4), vec()))
+    return out
 
 
 LATTICES = [

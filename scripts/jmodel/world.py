@@ -36,12 +36,14 @@ from __future__ import annotations
 import asyncio
 import hashlib
 import selectors
+import struct
 from concurrent.futures import ThreadPoolExecutor
 
 from jylis_tpu.cluster import cluster as cluster_mod
 from jylis_tpu.cluster.cluster import Cluster
 from jylis_tpu.lanes import wire_bridge
 from jylis_tpu.obs.registry import MetricsRegistry
+from jylis_tpu.ops.tensor_host import Tensor, okey_u32
 from jylis_tpu.utils.address import Address
 from jylis_tpu.utils.config import Config
 from jylis_tpu.utils.log import Log
@@ -74,24 +76,45 @@ class Violation(Exception):
 
 
 class ModelDatabase:
-    """A host-side GCOUNT lattice with the exact Database surface the
-    Cluster consumes, producing real codec-shaped deltas. ``journal``
-    is the WAL analog: local writes survive a crash-reboot, converged
-    remote state does not (it heals back over the rejoin sync — the
-    exact path worth exploring)."""
+    """Host-side GCOUNT + TENSOR lattices with the exact Database
+    surface the Cluster consumes, producing real codec-shaped deltas.
+    GCOUNT is the scalar delta payload; TENSOR (element-wise-max mode,
+    dim-2 vectors — ops/tensor_host.Tensor, the REAL wire object) makes
+    every explored schedule also carry a non-scalar binary payload over
+    the bus and bridge. One ``write`` action mutates both lattices (the
+    tensor cell is a deterministic function of the counter write), so
+    the frontier does not grow a second write axis. ``journal`` is the
+    WAL analog: local writes survive a crash-reboot (the tensor write
+    re-derives from the journaled counter), converged remote state does
+    not (it heals back over the rejoin sync — the exact path worth
+    exploring)."""
 
-    DATA_TYPES = ("GCOUNT",)
+    DATA_TYPES = ("GCOUNT", "TENSOR")
 
     def __init__(self, name: str, rid: int, journal=None):
         self.name = name
         self.rid = rid
         self.state: dict[bytes, dict[int, int]] = {}
+        self.state_t: dict[bytes, Tensor] = {}
         self.pending: list[tuple[bytes, dict[int, int]]] = []
+        self.pending_t: list[tuple[bytes, Tensor]] = []
         self.journal: list[tuple[bytes, int]] = list(journal or ())
         self.metrics = MetricsRegistry()
-        for key, n in self.journal:  # boot replay
+        for key, n in self.journal:  # boot replay (both lattices)
             rows = self.state.setdefault(key, {})
             rows[self.rid] = max(rows.get(self.rid, 0), n)
+            self._tensor_join(key, self._tensor_delta(n))
+
+    def _tensor_delta(self, n: int) -> Tensor:
+        # a function of (rid, counter value): replayable from the WAL
+        return Tensor.max_value(struct.pack("<2f", float(self.rid), float(n)))
+
+    def _tensor_join(self, key: bytes, delta: Tensor) -> None:
+        cur = self.state_t.get(key)
+        if cur is None:
+            cur = Tensor()
+            self.state_t[key] = cur
+        cur.converge(delta)
 
     def local_write(self, key: bytes = b"x") -> None:
         rows = self.state.setdefault(key, {})
@@ -99,6 +122,9 @@ class ModelDatabase:
         rows[self.rid] = n
         self.journal.append((key, n))  # WAL before the network sees it
         self.pending.append((key, {self.rid: n}))
+        t = self._tensor_delta(n)
+        self._tensor_join(key, t)
+        self.pending_t.append((key, t))
 
     def _join(self, batch) -> None:
         for key, delta in batch:
@@ -111,14 +137,25 @@ class ModelDatabase:
         name, batch = deltas
         if name == "GCOUNT":
             self._join(batch)
+        elif name == "TENSOR":
+            for key, delta in batch:
+                self._tensor_join(bytes(key), delta)
 
     async def flush_deltas_async(self, fn) -> None:
         if self.pending:
             batch, self.pending = self.pending, []
             fn(("GCOUNT", tuple(batch)))
+        if self.pending_t:
+            batch_t, self.pending_t = self.pending_t, []
+            fn(("TENSOR", tuple(batch_t)))
 
     async def sync_type_digests_async(self) -> tuple[bytes, ...]:
-        return (self.digest(),)
+        return (self._digest_g(), self._digest_t())
+
+    def _tensor_copy(self, t: Tensor) -> Tensor:
+        out = Tensor()
+        out.converge(t)
+        return out
 
     async def dump_state_async(self, names=None):
         names = tuple(names) if names is not None else self.DATA_TYPES
@@ -131,11 +168,24 @@ class ModelDatabase:
                         [(k, dict(v)) for k, v in sorted(self.state.items())],
                     )
                 )
+            elif n == "TENSOR":
+                # copies: the dump is encoded in a worker thread while
+                # actions keep mutating the live lattice objects
+                out.append(
+                    (
+                        "TENSOR",
+                        [
+                            (k, self._tensor_copy(t))
+                            for k, t in sorted(self.state_t.items())
+                            if t.mode != 0
+                        ],
+                    )
+                )
             elif n == "SYSTEM":
                 out.append(("SYSTEM", []))
         return out
 
-    def digest(self) -> bytes:
+    def _digest_g(self) -> bytes:
         canon = sorted(
             (k.hex(), sorted(v.items()))
             for k, v in self.state.items()
@@ -143,12 +193,37 @@ class ModelDatabase:
         )
         return hashlib.sha256(repr(canon).encode()).digest()
 
-    def cells(self) -> dict[tuple[bytes, int], int]:
-        return {
+    def _digest_t(self) -> bytes:
+        canon = sorted(
+            (k.hex(), t.canon())
+            for k, t in self.state_t.items()
+            if t.mode != 0
+        )
+        return hashlib.sha256(repr(canon).encode()).digest()
+
+    def digest(self) -> bytes:
+        return hashlib.sha256(self._digest_g() + self._digest_t()).digest()
+
+    def cells(self) -> dict[tuple, int]:
+        """Per-cell monotonicity floor: counter cells AND tensor
+        coordinates (as okey ints — per-coordinate max must never
+        regress)."""
+        out: dict[tuple, int] = {
             (k, rid): v
             for k, rows in self.state.items()
             for rid, v in rows.items()
         }
+        import numpy as np
+
+        for k, t in self.state_t.items():
+            if t.mode == 0:
+                continue
+            # the REAL lattice order (tensor_host.okey_u32), not a copy:
+            # the floor must track the product's definition exactly
+            keys = okey_u32(np.frombuffer(t.val, "<u4"))
+            for i, okey in enumerate(keys.tolist()):
+                out[("T", k, i)] = okey
+        return out
 
 
 class Instance:
@@ -773,6 +848,10 @@ class World:
                 "digest": db.digest().hex()[:16],
                 "pending": [
                     (k.hex(), sorted(d.items())) for k, d in db.pending
+                ],
+                "pending_t": [
+                    (k.hex(), self._sha(repr(t.canon()).encode()))
+                    for k, t in db.pending_t
                 ],
                 "journal_len": len(db.journal),
             }

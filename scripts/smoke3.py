@@ -12,8 +12,10 @@ Two modes:
 
 Each node INCs the same GCOUNT key with a different amount (2, 3, 4 — the
 reference test's exact workload), every node must converge to 9; then one
-write per remaining type (PNCOUNT/TREG/TLOG/UJSON) lands on a different
-node and must read back converged everywhere.
+write per remaining type (PNCOUNT/TREG/TLOG/UJSON/TENSOR) lands on a
+different node and must read back converged everywhere — TENSOR writes
+the same key from two nodes (element-wise MAX over a binary f32 payload)
+and additionally gates on SYSTEM DIGEST equality across all three.
 
 Every poll opens a fresh connection through jylis_tpu.client (the in-repo
 RESP client): a reply stalled past its timeout can therefore never desync
@@ -25,6 +27,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import struct
 import subprocess
 import sys
 import time
@@ -87,6 +90,18 @@ def run_smoke(ports) -> None:
     assert once(ports[1], "TREG", "SET", "reg", "hello", 42) == b"OK"
     assert once(ports[2], "TLOG", "INS", "log", "entry", 7) == b"OK"
     assert once(ports[0], "UJSON", "SET", "doc", "k", '"v"') == b"OK"
+    # TENSOR: two nodes write the same key; element-wise MAX must settle
+    # both payloads' coordinate-wise maximum everywhere (binary-safe
+    # bulk payloads over real sockets)
+    assert once(
+        ports[1], "TENSOR", "SET", "emb", "MAX", 0,
+        struct.pack("<2f", 1.0, 9.0),
+    ) == b"OK"
+    assert once(
+        ports[2], "TENSOR", "SET", "emb", "MAX", 0,
+        struct.pack("<2f", 5.0, 2.0),
+    ) == b"OK"
+    tensor_want = [b"MAX", struct.pack("<2f", 5.0, 9.0), 0]
     for p in ports:
         until(deadline, lambda p=p: once(p, "PNCOUNT", "GET", "pn") == 7,
               f"PNCOUNT on :{p}")
@@ -96,6 +111,15 @@ def run_smoke(ports) -> None:
               == [[b"entry", 7]], f"TLOG on :{p}")
         until(deadline, lambda p=p: once(p, "UJSON", "GET", "doc")
               == b'{"k":"v"}', f"UJSON on :{p}")
+        until(deadline, lambda p=p: once(p, "TENSOR", "GET", "emb")
+              == tensor_want, f"TENSOR on :{p}")
+    # the acceptance gate: converged replicas answer SYSTEM DIGEST with
+    # equal hex (covers TENSOR beside every other type)
+    until(
+        deadline,
+        lambda: len({bytes(once(p, "SYSTEM", "DIGEST")) for p in ports}) == 1,
+        "SYSTEM DIGEST match across all three nodes",
+    )
     print("SMOKE3-OK")
 
 

@@ -27,6 +27,7 @@ JYLIS_FAILPOINTS env arming and os._exit path end to end.
 import asyncio
 import json
 import os
+import struct
 import subprocess
 import sys
 import time
@@ -324,6 +325,114 @@ async def drill(site: str, action: str, tmp_path) -> None:
                 pass
 
 
+# ---- the TENSOR drill ------------------------------------------------------
+
+
+async def write_tensor(node, key: bytes, vec) -> None:
+    payload = struct.pack("<%df" % len(vec), *vec)
+    cmd = (
+        b"*6\r\n$6\r\nTENSOR\r\n$3\r\nSET\r\n$%d\r\n%s\r\n$3\r\nMAX\r\n"
+        b"$1\r\n0\r\n$%d\r\n%s\r\n" % (len(key), key, len(payload), payload)
+    )
+    got = await resp_call(node.server.port, cmd)
+    assert got == b"+OK\r\n", got
+
+
+async def read_tensor(node, key: bytes) -> bytes:
+    return await resp_call(
+        node.server.port,
+        b"*3\r\n$6\r\nTENSOR\r\n$3\r\nGET\r\n$%d\r\n%s\r\n" % (len(key), key),
+    )
+
+
+async def wait_tensor(nodes, key: bytes, vec, ticks: int = 300) -> None:
+    payload = struct.pack("<%df" % len(vec), *vec)
+    want = (
+        b"*3\r\n$3\r\nMAX\r\n$%d\r\n%s\r\n:0\r\n" % (len(payload), payload)
+    )
+    got = {}
+
+    async def check():
+        for n in nodes:
+            got[n.config.addr.name] = await read_tensor(n, key)
+        return all(v == want for v in got.values())
+
+    deadline = asyncio.get_event_loop().time() + ticks * TICK
+    while asyncio.get_event_loop().time() < deadline:
+        if await check():
+            return
+        await asyncio.sleep(TICK)
+    assert await check(), (key, vec, want, got)
+
+
+async def drill_tensor(site: str, action: str, tmp_path) -> None:
+    """The generic drill with TENSOR traffic: binary vector payloads
+    journaled/gossiped THROUGH the injected fault, every cell ending in
+    element-wise-converged reads and matched per-type digests."""
+    arg, budget = FAULT_ARGS[action]
+    data_dir = str(tmp_path / "bee") if site in DISK_SITES else None
+    p_a, p_b, p_c = grab_ports(3)
+    a = Node("aye", p_a)
+    b = DiskNode("bee", p_b, seeds=[a.config.addr], data_dir=data_dir)
+    c = Node("sea", p_c, seeds=[a.config.addr])
+    crashed: list[str] = []
+
+    def crash_handler(name):
+        crashed.append(name)
+        raise faults.FaultError(f"failpoint {name}: injected crash")
+
+    await a.start()
+    await b.start()
+    await c.start()
+    nodes = [a, b, c]
+    try:
+        assert await converge_wait(lambda: meshed(a, b, c), ticks=200)
+        # seed divergence: each node contributes one coordinate's max
+        for i, n in enumerate(nodes):
+            vec = [0.0, 0.0, 0.0]
+            vec[i] = float(10 + i)
+            await write_tensor(n, b"drill", vec)
+        await wait_tensor(nodes, b"drill", [10.0, 11.0, 12.0])
+
+        if action == "crash":
+            faults.set_crash_handler(crash_handler)
+        base_hits = faults.hits(site)
+        faults.arm(site, action, arg, budget)
+        # tensor traffic riding THROUGH the armed seam
+        for i, n in enumerate(nodes):
+            await write_tensor(n, b"during", [float(i + 1), 0.5])
+        fired = await wait_pred(lambda: faults.hits(site) > base_hits)
+        assert fired, f"failpoint {site} never fired under {action}"
+
+        if action == "crash":
+            await wait_pred(lambda: bool(crashed), ticks=100)
+            assert crashed, f"crash at {site} never flagged"
+            faults.disarm(site)
+            await b.crash_stop()
+            b = DiskNode("bee", p_b, seeds=[a.config.addr], data_dir=data_dir)
+            await b.start()
+            nodes[1] = b
+
+        faults.disarm(site)
+        assert await converge_wait(
+            lambda: meshed_real(nodes), ticks=300
+        ), {n.config.addr.name: len(n.cluster._actives) for n in nodes}
+        await wait_tensor(nodes, b"during", [3.0, 0.5])
+        for i, n in enumerate(nodes):
+            await write_tensor(n, b"heal", [float(20 + i)])
+        await wait_tensor(nodes, b"heal", [22.0])
+        await wait_tensor(nodes, b"drill", [10.0, 11.0, 12.0])
+        await wait_digests_match(nodes)
+    finally:
+        faults.reset()
+        faults.set_crash_handler(None)
+        for n in nodes:
+            try:
+                await n.stop()
+            except Exception:
+                pass
+
+
 # ---- per-commit chaos smoke (make chaos: seconds, not minutes) -------------
 
 SMOKE_CELLS = [
@@ -333,11 +442,31 @@ SMOKE_CELLS = [
     ("journal.fsync", "error"),
 ]
 
+# TENSOR action cells: {error, corrupt, crash} x one journal + one
+# cluster seam each — non-scalar binary payloads through the fault
+# classes most likely to mangle them (a corrupt cluster.write exercises
+# the CRC drop; a corrupt journal.append exercises boot-replay refusal;
+# crash reboots the disk node mid-tensor-traffic)
+TENSOR_CELLS = [
+    ("journal.append", "error"),
+    ("cluster.write", "error"),
+    ("journal.append", "corrupt"),
+    ("cluster.write", "corrupt"),
+    ("journal.append", "crash"),
+    ("cluster.write", "crash"),
+]
+
 
 @pytest.mark.chaos
 @pytest.mark.parametrize("site,action", SMOKE_CELLS)
 def test_chaos_smoke_cell(site, action, tmp_path):
     asyncio.run(drill(site, action, tmp_path))
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("site,action", TENSOR_CELLS)
+def test_chaos_tensor_cell(site, action, tmp_path):
+    asyncio.run(drill_tensor(site, action, tmp_path))
 
 
 @pytest.mark.chaos

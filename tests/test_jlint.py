@@ -838,6 +838,7 @@ def test_real_native_surface_is_python_subset():
     manifest = json.load(open(jlint.MANIFEST_PATH))
     assert manifest["python_only"] == {
         "SYSTEM": ["DIGEST", "GETLOG", "LATENCY", "METRICS", "TRACE", "VERSION"],
+        "TENSOR": ["GET", "MRG", "SET"],
         "TLOG": ["CLR", "TRIM", "TRIMAT"],
     }
 
@@ -1020,14 +1021,18 @@ def test_real_codec_surfaces_are_symmetric_and_committed():
     manifest = pass_codec.build_manifest()
     # every cluster message and delta type is covered
     units = set(manifest["units"])
-    for t in ("TREG", "TLOG", "SYSTEM", "GCOUNT", "PNCOUNT", "UJSON"):
+    for t in (
+        "TREG", "TLOG", "SYSTEM", "GCOUNT", "PNCOUNT", "UJSON", "TENSOR"
+    ):
         assert f"delta/{t}" in units
     for m in ("Pong", "ExchangeAddrs", "AnnounceAddrs", "PushDeltas",
               "SyncRequest", "SyncDone"):
         assert f"msg/{m}" in units
     assert {"frame/header", "frame/wire", "file/journal", "file/snapshot"} <= units
     assert manifest["units"]["file/snapshot"]["accepts_legacy"] is True
-    assert manifest["legacy_snapshot_versions"] == [1, 2, 3]
+    # the journal reader also accepts the pre-v7 delta signature
+    assert manifest["units"]["file/journal"]["accepts_legacy"] is True
+    assert manifest["legacy_snapshot_versions"] == [1, 2, 3, 6]
 
 
 # ---- pass 8: lattice discipline (JL801-JL805) -------------------------------
@@ -1116,7 +1121,7 @@ def test_real_lattice_manifest_and_harness_current():
     assert pass_lattice.check_manifest(project) == []
     manifest = pass_lattice.load_manifest()
     assert sorted(manifest["types"]) == [
-        "GCOUNT", "PNCOUNT", "TLOG", "TREG", "UJSON",
+        "GCOUNT", "PNCOUNT", "TENSOR", "TLOG", "TREG", "UJSON",
     ]
     assert manifest["merge_roots"] == pass_lattice.extract_roots(project)
 

@@ -182,6 +182,49 @@ def test_schema_signature_mismatch_moved_aside(tmp_path):
         journal_mod.replay_journal(db, bad)
 
 
+def test_legacy_delta_signature_replays_and_restamps(tmp_path):
+    """A pre-v7 journal (the v1-v6 delta signature — delta/TENSOR did
+    not exist yet) must replay, and the segment must be REWRITTEN under
+    the current signature before this build appends new-schema frames
+    to it: the header must always describe every frame in the file."""
+    import struct as _struct
+    import zlib as _zlib
+
+    from jylis_tpu.cluster import codec
+    from jylis_tpu.cluster.framing import frame
+    from jylis_tpu.cluster.msg import MsgPushDeltas
+
+    path = str(tmp_path / "journal.jylis")
+    # old-type frames encode byte-identically across the signature bump,
+    # so the current encoder produces a faithful legacy file
+    payload = codec.encode(MsgPushDeltas("GCOUNT", ((b"leg", {1: 5}),)))
+    with open(path, "wb") as f:
+        f.write(journal_mod.MAGIC + codec.legacy_delta_signatures()[0])
+        f.write(frame(_struct.pack(">I", _zlib.crc32(payload)) + payload))
+    db = Database(identity=1)
+    assert journal_mod.replay_journal(db, path) == 1
+    assert call(db, "GCOUNT", "GET", "leg") == b":5\r\n"
+    # the segment now stamps the CURRENT delta signature...
+    hdr = open(path, "rb").read(journal_mod.HEADER_LEN)
+    assert hdr[len(journal_mod.MAGIC):] == codec.delta_signature()
+    # ...and appending current-schema frames keeps it fully replayable
+    j = Journal(path, fsync="always")
+    j.open()
+    db2 = Database(identity=1)
+    call(db2, "TENSOR", "SET", "t", "MAX", "0",
+         b"\x00\x00\x80?\x00\x00\x00\xc0")
+    db2.set_journal(j)
+    db2.flush_deltas(lambda b: None)
+    j.flush()
+    j.close()
+    db3 = Database(identity=2)
+    assert journal_mod.replay_journal(db3, path) == 2
+    assert call(db3, "GCOUNT", "GET", "leg") == b":5\r\n"
+    assert call(db3, "TENSOR", "GET", "t") == (
+        b"*3\r\n$3\r\nMAX\r\n$8\r\n\x00\x00\x80?\x00\x00\x00\xc0\r\n:0\r\n"
+    )
+
+
 def test_empty_and_missing_journal(tmp_path):
     db = Database(identity=1)
     path = str(tmp_path / "journal.jylis")

@@ -1,0 +1,168 @@
+"""TENSOR device kernels (ops/tensor.py) differentially against the
+host lattice (ops/tensor_host.py): the vmap'd (ts, rid, okey) select
+must agree with the numpy oracle cell-for-cell, across NaN/inf
+payloads, scatter batches with pad rows, multi-replica scan folds, and
+capacity growth."""
+
+import random
+import struct
+
+import numpy as np
+
+from jylis_tpu.ops import tensor
+from jylis_tpu.ops.tensor_host import okey_u32
+from jylis_tpu.utils.batching import bucket, pad_rows
+
+N, D = 16, 4
+
+
+def _rand_cell(rng):
+    r = rng.random()
+    if r < 0.1:
+        return float("nan")
+    if r < 0.2:
+        return float("inf") if r < 0.15 else float("-inf")
+    return rng.uniform(-4.0, 4.0)
+
+
+def _rand_planes(rng):
+    vals = np.array(
+        [
+            struct.unpack("<I", struct.pack("<f", _rand_cell(rng)))[0]
+            for _ in range(N * D)
+        ],
+        np.uint32,
+    ).reshape(N, D)
+    # canonical NaNs only, like every host ingest path guarantees
+    nan = ((vals & 0x7F800000) == 0x7F800000) & ((vals & 0x007FFFFF) != 0)
+    vals[nan] = 0x7FC00000
+    ts = np.array(
+        [rng.randint(0, 3) for _ in range(N * D)], np.uint64
+    ).reshape(N, D)
+    rid = np.array(
+        [rng.randint(0, 2) for _ in range(N * D)], np.uint32
+    ).reshape(N, D)
+    return vals, ts, rid
+
+
+def _oracle_join(a, b):
+    av, at, ar = a
+    bv, bt, br = b
+    ak, bk = okey_u32(av), okey_u32(bv)
+    take = (bt > at) | (
+        (bt == at) & ((br > ar) | ((br == ar) & (bk > ak)))
+    )
+    return (
+        np.where(take, bv, av),
+        np.where(take, bt, at),
+        np.where(take, br, ar),
+    )
+
+
+def _split(ts):
+    return (ts >> np.uint64(32)).astype(np.uint32), ts.astype(np.uint32)
+
+
+def _state(vals, ts, rid):
+    hi, lo = _split(ts)
+    return tensor.TensorState(vals, hi, lo, rid)
+
+
+def test_dense_join_matches_oracle():
+    rng = random.Random(7)
+    for trial in range(20):
+        a = _rand_planes(rng)
+        b = _rand_planes(rng)
+        out = tensor.join_dense(_state(*a), _state(*b))
+        hv, ht, hr = _oracle_join(a, b)
+        assert np.array_equal(np.asarray(out.val), hv), trial
+        assert np.array_equal(np.asarray(out.ts_lo), ht.astype(np.uint32))
+        assert np.array_equal(np.asarray(out.rid), hr), trial
+
+
+def test_dense_join_laws_on_device():
+    rng = random.Random(11)
+    a, b, c = (_state(*_rand_planes(rng)) for _ in range(3))
+
+    def j(x, y):
+        return tensor.join_dense(x, y)
+
+    def eq(x, y):
+        return all(
+            np.array_equal(np.asarray(p), np.asarray(q))
+            for p, q in zip(x, y)
+        )
+
+    assert eq(j(a, b), j(b, a))
+    assert eq(j(j(a, b), c), j(a, j(b, c)))
+    assert eq(j(a, a), a)
+
+
+def test_converge_batch_scatter_and_pads():
+    rng = random.Random(3)
+    st = tensor.init(N, D)
+    av, at, ar = _rand_planes(rng)
+    rows = [3, 1, 9, 0, 7]
+    b = bucket(len(rows))
+    ki = pad_rows(b)
+    ki[: len(rows)] = rows
+    dv = np.full((b, D), tensor.BOTTOM_BITS, np.uint32)
+    dts = np.zeros((b, D), np.uint64)
+    dr = np.zeros((b, D), np.uint32)
+    for i, row in enumerate(rows):
+        dv[i], dts[i], dr[i] = av[row], at[row], ar[row]
+    hi, lo = _split(dts)
+    st2 = tensor.converge_batch(st, ki, dv, hi, lo, dr)
+    for i, row in enumerate(rows):
+        got = np.asarray(st2.val[row])
+        want = _oracle_join(
+            (np.full(D, tensor.BOTTOM_BITS, np.uint32),
+             np.zeros(D, np.uint64), np.zeros(D, np.uint32)),
+            (av[row], at[row], ar[row]),
+        )[0]
+        assert np.array_equal(got, want), row
+    # untouched rows keep the identity
+    assert np.asarray(st2.val[2]).tolist() == [tensor.BOTTOM_BITS] * D
+    # the batched read gathers the same bit rows the state holds
+    got = np.asarray(tensor.read(st2, np.asarray(rows, np.int32)))
+    assert np.array_equal(got, np.asarray(st2.val)[rows])
+
+
+def test_converge_many_equals_sequential_folds():
+    rng = random.Random(5)
+    R, B = 6, 16
+    seq = tensor.init(N, D)
+    batches = []
+    for _ in range(R):
+        av, at, ar = _rand_planes(rng)
+        ki = pad_rows(B)
+        rows = rng.sample(range(N), 5)
+        ki[: len(rows)] = rows
+        dv = np.full((B, D), tensor.BOTTOM_BITS, np.uint32)
+        dts = np.zeros((B, D), np.uint64)
+        dr = np.zeros((B, D), np.uint32)
+        for i, row in enumerate(rows):
+            dv[i], dts[i], dr[i] = av[row], at[row], ar[row]
+        batches.append((ki, dv, dts, dr))
+        hi, lo = _split(dts)
+        seq = tensor.converge_batch(seq, ki, dv, hi, lo, dr)
+    many = tensor.converge_many(
+        tensor.init(N, D),
+        np.stack([b[0] for b in batches]),
+        np.stack([b[1] for b in batches]),
+        np.stack([_split(b[2])[0] for b in batches]),
+        np.stack([_split(b[2])[1] for b in batches]),
+        np.stack([b[3] for b in batches]),
+    )
+    for p, q in zip(many, seq):
+        assert np.array_equal(np.asarray(p), np.asarray(q))
+
+
+def test_grow_preserves_and_pads_identity():
+    rng = random.Random(9)
+    st = _state(*_rand_planes(rng))
+    g = tensor.grow(st, 2 * N, 2 * D)
+    assert np.array_equal(np.asarray(g.val[:N, :D]), np.asarray(st.val))
+    assert np.asarray(g.val[N:, :]).flat[0] == tensor.BOTTOM_BITS
+    assert np.asarray(g.ts_lo[:N, D:]).max() == 0
+    assert tensor.grow(st, N, D) is st

@@ -41,6 +41,7 @@ def populate(db):
     call(db, "UJSON", "SET", "u", "name", '"alice"')
     call(db, "UJSON", "RM", "u", "name", '"alice"')
     call(db, "UJSON", "INS", "u", "tag", "1")
+    call(db, "TENSOR", "SET", "t", "MAX", "0", b"\x00\x00\x80?\x00\x00\x00\xc0")
     db.system.inslog("a log line")
 
 
@@ -51,6 +52,10 @@ READS = {
     ("TLOG", "GET", "l"): b"*1\r\n*2\r\n$1\r\nb\r\n:5\r\n",
     ("UJSON", "GET", "u", "tag"): b"$1\r\n1\r\n",
     ("UJSON", "GET", "u", "name"): b"$0\r\n\r\n",  # removed stays removed
+    # [1.0, -2.0] little-endian f32 (binary-safe bulk payload)
+    ("TENSOR", "GET", "t"): (
+        b"*3\r\n$3\r\nMAX\r\n$8\r\n\x00\x00\x80?\x00\x00\x00\xc0\r\n:0\r\n"
+    ),
 }
 
 
@@ -62,7 +67,7 @@ def test_roundtrip_all_types(tmp_path):
 
     db2 = Database(identity=1)
     n = persist.load_snapshot(db2, path)
-    assert n == 6  # one batch per data type
+    assert n == 7  # one batch per data type
     for req, want in READS.items():
         assert call(db2, *req) == want, req
     # the restored SYSTEM log still has the line

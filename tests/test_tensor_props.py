@@ -1,0 +1,339 @@
+"""TENSOR property tests beyond the generated law harness: the RESP
+surface (SET/GET/MRG, shape/mode rejection at the boundary), NaN/±inf
+coordinate semantics, LWW tiebreak determinism across replica ids
+(same digest on every replica for every delivery order), and the
+journal/snapshot/flush round-trips of the new delta payload."""
+
+import math
+import itertools
+import struct
+
+import pytest
+
+from jylis_tpu.cluster import codec
+from jylis_tpu.models.database import Database
+from jylis_tpu.models.repo_tensor import PENDING_DRAIN_THRESHOLD
+from jylis_tpu.ops.tensor_host import (
+    CANON_NAN_BITS,
+    MODE_LWW,
+    Tensor,
+    canon_f32,
+    pack_f32,
+)
+
+
+class Cap:
+    """Records resp-protocol calls for assertion."""
+
+    def __init__(self):
+        self.calls = []
+
+    def __getattr__(self, name):
+        def rec(*a):
+            self.calls.append((name, a))
+
+        return rec
+
+    def last_err(self):
+        return next(a[0] for n, a in reversed(self.calls) if n == "err")
+
+    def last_vec(self):
+        """The payload bulk of the most recent GET reply array
+        (string calls per GET are [mode, vector])."""
+        strings = [a[0] for n, a in self.calls if n == "string"]
+        return strings[-1] if len(strings) >= 2 else None
+
+
+def _set(db, resp, key, mode, ts, payload):
+    db.apply(resp, [b"TENSOR", b"SET", key, mode, str(ts).encode(), payload])
+
+
+def _get_vec(db, key):
+    r = Cap()
+    db.apply(r, [b"TENSOR", b"GET", key])
+    return r.last_vec()
+
+
+# ---- RESP boundary rejection ------------------------------------------------
+
+
+def test_payload_dtype_rejected_at_resp_boundary():
+    db = Database(identity=1, engine="python")
+    for bad in (b"", b"abc", b"12345"):
+        r = Cap()
+        _set(db, r, b"k", b"MAX", 0, bad)
+        assert "BADSHAPE" in r.last_err(), bad
+    # the key was never created by a rejected write
+    r = Cap()
+    db.apply(r, [b"TENSOR", b"GET", b"k"])
+    assert r.calls == [("null", ())]
+
+
+def test_mode_and_dim_mismatch_rejected():
+    db = Database(identity=1, engine="python")
+    ok = Cap()
+    _set(db, ok, b"k", b"MAX", 0, pack_f32([1.0, 2.0]))
+    assert ok.calls[-1][0] == "ok"
+    r = Cap()
+    _set(db, r, b"k", b"LWW", 3, pack_f32([1.0, 2.0]))
+    assert "BADSHAPE (key holds MAX/2, write is LWW/2)" in r.last_err()
+    r = Cap()
+    _set(db, r, b"k", b"MAX", 0, pack_f32([1.0, 2.0, 3.0]))
+    assert "write is MAX/3" in r.last_err()
+    # MRG passes the same gate
+    r = Cap()
+    blob = codec.encode_delta("TENSOR", Tensor.lww(pack_f32([9.0, 9.0]), 1, 1))
+    db.apply(r, [b"TENSOR", b"MRG", b"k", blob])
+    assert "BADSHAPE" in r.last_err()
+    r = Cap()
+    db.apply(r, [b"TENSOR", b"MRG", b"k", b"\x99garbage"])
+    assert "BADPAYLOAD" in r.last_err()
+
+
+def test_unknown_mode_renders_help():
+    db = Database(identity=1, engine="python")
+    r = Cap()
+    _set(db, r, b"k", b"SUM", 0, pack_f32([1.0]))
+    assert "BADCOMMAND" in r.last_err()
+
+
+# ---- NaN / ±inf coordinates -------------------------------------------------
+
+
+def test_nan_canonicalises_and_is_max_top():
+    db = Database(identity=1, engine="python")
+    r = Cap()
+    # a NON-canonical NaN payload (sign bit + junk mantissa)
+    weird_nan = struct.pack("<I", 0xFFC00001)
+    _set(db, r, b"k", b"MAX", 0, weird_nan + struct.pack("<f", 1.0))
+    got = _get_vec(db, b"k")
+    assert struct.unpack("<I", got[:4])[0] == CANON_NAN_BITS
+    # NaN is the per-coordinate top: +inf does not displace it, and the
+    # bytes stay canonical (digest-stable on every replica)
+    _set(db, r, b"k", b"MAX", 0, pack_f32([math.inf, math.inf]))
+    got = _get_vec(db, b"k")
+    assert struct.unpack("<I", got[:4])[0] == CANON_NAN_BITS
+    assert struct.unpack("<f", got[4:])[0] == math.inf
+
+
+def test_inf_ordering_and_negzero_total_order():
+    assert canon_f32(pack_f32([-math.inf])) == pack_f32([-math.inf])
+    a = Tensor.max_value(pack_f32([-math.inf, -0.0]))
+    b = Tensor.max_value(pack_f32([-1e30, 0.0]))
+    a.converge(b)
+    got = struct.unpack("<2f", a.val)
+    assert got[0] == pytest.approx(-1e30) and math.copysign(1, got[1]) == 1.0
+
+
+def test_avg_zero_weight_fallback_is_a_clean_unweighted_mean():
+    """All-zero-ts AVG keys render the UNWEIGHTED mean; the weighted
+    pass's 0*inf = NaN contamination must not leak into the fallback."""
+    t = Tensor.avg(1, 0, pack_f32([math.inf, 2.0]))
+    t.converge(Tensor.avg(2, 0, pack_f32([4.0, 6.0])))
+    vec, ts = t.read()
+    assert ts == 0
+    got = struct.unpack("<2f", vec)
+    assert got[0] == math.inf and got[1] == pytest.approx(4.0), got
+
+
+def test_avg_with_nan_inf_is_replica_deterministic():
+    contribs = [
+        Tensor.avg(1, 2, pack_f32([math.nan, 1.0])),
+        Tensor.avg(2, 3, pack_f32([math.inf, 2.0])),
+        Tensor.avg(3, 1, pack_f32([-math.inf, 4.0])),
+    ]
+    reads = set()
+    for perm in itertools.permutations(contribs):
+        t = Tensor()
+        for c in perm:
+            t.converge(c)
+        reads.add(t.read())
+    assert len(reads) == 1
+
+
+# ---- LWW tiebreak determinism across replicas ------------------------------
+
+
+def test_lww_equal_ts_tiebreak_same_digest_on_all_replicas():
+    """Three replicas write the same key at the SAME timestamp; every
+    delivery order on every replica must settle on identical canonical
+    state (the rid tiebreak) — the digest-match acceptance in miniature."""
+    writes = {
+        rid: Tensor.lww(pack_f32([float(rid), -float(rid)]), 7, rid)
+        for rid in (1, 2, 3)
+    }
+    canons = set()
+    for perm in itertools.permutations(writes.values()):
+        t = Tensor()
+        for w in perm:
+            t.converge(w)
+        canons.add(t.canon())
+    assert len(canons) == 1
+    settled = next(iter(canons))
+    # the rid-3 write wins every coordinate
+    assert settled[2] == pack_f32([3.0, -3.0])
+
+
+def test_lww_tiebreak_through_full_database_digests():
+    dbs = {rid: Database(identity=rid, engine="python") for rid in (1, 2, 3)}
+    flushed = {}
+    for rid, db in dbs.items():
+        r = Cap()
+        _set(db, r, b"emb", b"LWW", 7, pack_f32([float(rid), 1.0]))
+        out = []
+        db.flush_deltas(lambda batch: out.append(batch))
+        flushed[rid] = [b for b in out if b[0] == "TENSOR"]
+    for rid, db in dbs.items():
+        for other, batches in sorted(flushed.items()):
+            if other == rid:
+                continue
+            for name, batch in batches:
+                db.converge_deltas((name, batch))
+    digests = {db._sync_digest_blocking() for db in dbs.values()}
+    assert len(digests) == 1
+    for db in dbs.values():
+        assert _get_vec(db, b"emb") == pack_f32([3.0, 1.0])
+
+
+# ---- wire/journal/snapshot round-trips -------------------------------------
+
+
+def test_wire_rejects_malformed_planes():
+    t = Tensor.lww(pack_f32([1.0, 2.0]), 5, 9)
+    blob = bytearray(codec.encode_delta("TENSOR", t))
+    # truncating the val plane must fail decode, not mis-shape state
+    with pytest.raises(codec.CodecError):
+        codec.decode_delta("TENSOR", bytes(blob[:-1]))
+    # a structurally valid but shape-inconsistent delta is refused
+    bad = Tensor()
+    bad.mode, bad.dim, bad.val = MODE_LWW, 2, pack_f32([1.0, 2.0])
+    bad.ts, bad.rid = b"", b""
+    with pytest.raises(codec.CodecError):
+        codec.decode_delta("TENSOR", codec.encode_delta("TENSOR", bad))
+
+
+def test_threshold_drain_keeps_get_exact():
+    db = Database(identity=1, engine="python")
+    repo = db.manager("TENSOR").repo
+    r = Cap()
+    for i in range(PENDING_DRAIN_THRESHOLD + 5):
+        _set(db, r, b"k%d" % i, b"MAX", 0, pack_f32([float(i)]))
+    assert repo._tbl.pend_count() < PENDING_DRAIN_THRESHOLD
+    assert _get_vec(db, b"k0") == pack_f32([0.0])
+    assert _get_vec(db, b"k%d" % PENDING_DRAIN_THRESHOLD) == pack_f32(
+        [float(PENDING_DRAIN_THRESHOLD)]
+    )
+
+
+def test_mrg_rejects_over_u64_contribution_ts():
+    """A wire varint admits ~2^77; a contribution ts past u64 must be
+    refused at decode, not accepted into the lattice where the next
+    drain's u64 planes would raise (and boot replay would crash-loop
+    on the journaled delta)."""
+    t = Tensor.avg(1, 3, pack_f32([1.0]))
+    t.contribs[1] = (1 << 64, t.contribs[1][1])
+    blob = codec.encode_delta("TENSOR", t)
+    with pytest.raises(codec.CodecError):
+        codec.decode_delta("TENSOR", blob)
+    db = Database(identity=1, engine="python")
+    r = Cap()
+    db.apply(r, [b"TENSOR", b"MRG", b"k", blob])
+    assert "BADPAYLOAD" in r.last_err()
+    # and the repo still drains cleanly afterwards
+    db.manager("TENSOR").repo.drain()
+
+
+def test_avg_device_mirror_tracks_host_winner():
+    """Equal-(rid, ts) AVG contributions with different vectors join
+    WHOLE-vector on the host (lexicographic (ts, okey-tuple)); the
+    device mirror must land exactly the host winner, never a
+    per-coordinate mix of both vectors — and a stale remote delta must
+    not regress the mirror below the host truth."""
+    import numpy as np
+
+    db = Database(identity=1, engine="python")
+    repo = db.manager("TENSOR").repo
+    r = Cap()
+    for vec in ([1.0, 9.0], [2.0, 3.0]):
+        blob = codec.encode_delta("TENSOR", Tensor.avg(7, 5, pack_f32(vec)))
+        db.apply(r, [b"TENSOR", b"MRG", b"k", blob])
+        repo.drain()
+    # host whole-vector winner at the (rid=7, ts=5) tie
+    w = repo._tbl.winner(repo._tbl.find(b"k"))
+    assert w.contribs[7] == (5, pack_f32([2.0, 3.0]))
+    dev = repo._dev_rows[repo._tbl.find(b"k")][7]
+    got = np.asarray(repo._state.val[dev, :2]).tobytes()
+    assert got == pack_f32([2.0, 3.0]), got
+    # a STALE contribution (older ts) buffers and drains without
+    # regressing either the host winner or the mirror
+    blob = codec.encode_delta("TENSOR", Tensor.avg(7, 4, pack_f32([8.0, 8.0])))
+    db.apply(r, [b"TENSOR", b"MRG", b"k", blob])
+    repo.drain()
+    w = repo._tbl.winner(repo._tbl.find(b"k"))
+    assert w.contribs[7] == (5, pack_f32([2.0, 3.0]))
+    got = np.asarray(repo._state.val[dev, :2]).tobytes()
+    assert got == pack_f32([2.0, 3.0]), got
+
+
+def test_dominance_flip_retires_device_rows():
+    """Replication can upgrade a key's (mode, dim) rank wholesale (two
+    nodes racing first-writes of a fresh key); the device mirror must
+    follow — the old rank's planes would otherwise pin coordinates the
+    monotone select can never regress (e.g. okey(1.0) < okey(5.0))."""
+    import numpy as np
+
+    db = Database(identity=1, engine="python")
+    repo = db.manager("TENSOR").repo
+    r = Cap()
+    _set(db, r, b"k", b"MAX", 0, pack_f32([5.0]))
+    repo.drain()
+    row = repo._tbl.find(b"k")
+    old_dev = repo._dev_rows[row][-1]
+    # a replicated MAX/dim-2 write dominates the MAX/dim-1 state
+    repo.converge(b"k", Tensor.max_value(pack_f32([1.0, 1.0])))
+    repo.drain()
+    w = repo._tbl.winner(row)
+    assert (w.mode, w.dim) == (1, 2) and w.val == pack_f32([1.0, 1.0])
+    new_dev = repo._dev_rows[row][-1]
+    assert new_dev != old_dev
+    got = np.asarray(repo._state.val[new_dev, :2]).tobytes()
+    assert got == pack_f32([1.0, 1.0]), got
+    # an AVG flip likewise re-homes (and re-mirrors every contribution)
+    repo.converge(b"k", Tensor.avg(9, 3, pack_f32([4.0, 4.0, 4.0])))
+    repo.drain()
+    dev = repo._dev_rows[row][9]
+    assert -1 not in repo._dev_rows[row]
+    got = np.asarray(repo._state.val[dev, :3]).tobytes()
+    assert got == pack_f32([4.0, 4.0, 4.0]), got
+
+
+def test_snapshot_and_journal_round_trip(tmp_path):
+    from jylis_tpu import persist
+    from jylis_tpu.journal import journal as journal_mod
+
+    db = Database(identity=1, engine="python")
+    r = Cap()
+    _set(db, r, b"m", b"MAX", 0, pack_f32([5.0, -1.0]))
+    _set(db, r, b"l", b"LWW", 9, pack_f32([2.0]))
+    db.apply(r, [
+        b"TENSOR", b"MRG", b"a",
+        codec.encode_delta("TENSOR", Tensor.avg(4, 6, pack_f32([8.0]))),
+    ])
+    jpath = str(tmp_path / "journal.jylis")
+    j = journal_mod.Journal(jpath, fsync="always")
+    j.open()
+    db.set_journal(j)
+    db.flush_deltas(lambda batch: None)
+    j.flush()
+    j.close()
+    want = db._sync_digest_blocking()
+
+    spath = str(tmp_path / "snap.jylis")
+    persist.save_snapshot(db, spath)
+    db2 = Database(identity=2, engine="python")
+    persist.load_snapshot(db2, spath)
+    assert db2._sync_digest_blocking() == want
+
+    db3 = Database(identity=3, engine="python")
+    assert journal_mod.replay_journal(db3, jpath) > 0
+    assert db3._sync_digest_blocking() == want
