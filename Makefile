@@ -106,8 +106,11 @@ model-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m scripts.jmodel --smoke --budget
 
 # nightly CI: the long-running real-process churn/crash drills, including
-# the SIGKILL-mid-traffic journal recovery soak and the full
-# fault-injection drill matrix (tests/test_drill_matrix.py)
+# the SIGKILL-mid-traffic journal recovery soak, the 16-32 node churn
+# soak (tests/test_soak_churn_scale.py — kill/rejoin/partition/heal
+# under sustained writes, ends digest-matched with zero whole-state
+# dumps) and the full fault-injection drill matrix
+# (tests/test_drill_matrix.py)
 soak:
 	$(PY) -m pytest tests/ -q -m soak
 

@@ -40,15 +40,21 @@ from .. import faults
 from ..obs.trace import now_ms
 from ..ops.p2set import P2Set
 from ..utils.address import Address
+from ..utils.config import Config
 from ..utils.net import ipv4_port
 from . import codec
 from .framing import FrameReader, FramingError, frame
 from .heart import Heart
 from .msg import (
     MsgAnnounceAddrs,
+    MsgDeltaAck,
+    MsgDigestTree,
     MsgExchangeAddrs,
+    MsgIntervalReset,
     MsgPong,
     MsgPushDeltas,
+    MsgRangeRequest,
+    MsgSeqPush,
     MsgSyncDone,
     MsgSyncRequest,
 )
@@ -75,15 +81,46 @@ SYNC_CHUNK_KEYS = 2048
 # re-splits by key, so a few huge values (an untrimmed TLOG, a wide UJSON
 # doc) cannot produce one arbitrarily large frame / encode stall
 SYNC_CHUNK_BYTES = 4 << 20
+# ---- anti-entropy v2 (schema v8) -------------------------------------------
+# retransmit window: how many sequenced delta batches the sender keeps
+# for per-peer ack-gap replay. A peer whose unacked gap falls off this
+# window is marked INTERVAL-DIRTY and demoted to range repair
+# (MsgIntervalReset) — never silently lost, never a whole-state dump.
+# Overridable via --delta-log-cap; the default VALUE lives on the
+# Config dataclass (one source for the dataclass, the CLI and this
+# fallback — three hardcoded copies would drift silently).
+DELTA_LOG_CAP = Config.delta_log_cap
+# requester-side repair budget: divergent digest-tree buckets pulled
+# per MsgRangeRequest round. Each round is served as one backpressured
+# stream; the requester walks remaining buckets on each MsgSyncDone, so
+# one rejoining node's heal is paced in bounded slices instead of one
+# keyspace-sized burst that starves serving. Overridable via
+# --range-budget (default on Config).
+RANGE_REQ_BUCKETS = Config.range_budget
+# receiver-side out-of-order cap per sender: seqs above the contiguity
+# cursor park here until retransmit fills the gap. Past the cap the
+# interval bookkeeping is declared lost and the receiver self-demotes
+# to range repair (rebase cum, pull the tree) — the ladder's promise
+# that interval-state confusion degrades to range repair, not to
+# unbounded memory.
+RECV_OOO_CAP = 512
+# reconnection-replay byte cap: _retransmit_unacked writes the unacked
+# window synchronously (inside handshake handling, no drain between
+# frames), so the whole replay must fit comfortably under the conn's
+# 16 MB write-buffer limit. A gap bigger than this is demoted to range
+# repair via MsgIntervalReset — bytes proportional to divergence is the
+# range tier's job, not the interval tier's.
+RETRANSMIT_BYTES_CAP = 4 << 20
 # dial state machine defaults (overridable via --dial-timeout /
-# --dial-backoff-cap): connect attempts are bounded by DIAL_TIMEOUT
-# seconds (a blackholed peer must not hold a placeholder conn for the
-# OS's minutes-long TCP timeout), and consecutive dial failures back
-# off exponentially in heartbeat ticks up to DIAL_BACKOFF_CAP (plus a
-# deterministic jitter of up to half the backoff, so a cluster-wide
-# restart does not thundering-herd one recovering peer in lockstep)
-DIAL_TIMEOUT = 5.0
-DIAL_BACKOFF_CAP = 32
+# --dial-backoff-cap; values live on Config): connect attempts are
+# bounded by DIAL_TIMEOUT seconds (a blackholed peer must not hold a
+# placeholder conn for the OS's minutes-long TCP timeout), and
+# consecutive dial failures back off exponentially in heartbeat ticks
+# up to DIAL_BACKOFF_CAP (plus a deterministic jitter of up to half the
+# backoff, so a cluster-wide restart does not thundering-herd one
+# recovering peer in lockstep)
+DIAL_TIMEOUT = Config.dial_timeout
+DIAL_BACKOFF_CAP = Config.dial_backoff_cap
 
 # cluster transport integrity: every frame body is prefixed with its
 # CRC32 (schema v5). TCP checksums are weak (16-bit, and they end at
@@ -198,6 +235,10 @@ class MsgDrop:
     # a SyncDone on a passive conn: sync replies close OUR requests,
     # which only ever go out on active conns
     SYNC_DONE_UNSOLICITED = "sync_done_unsolicited"
+    # a DeltaAck with no outstanding stamped send — the cum is still
+    # folded into the peer's interval state (the ack information is
+    # valid regardless), but the rtt surface declares the mismatch
+    ACK_UNMATCHED = "ack_unmatched"
 
 
 # active-conn teardown reasons that mean the PEER (not the network)
@@ -216,14 +257,29 @@ _PEER_FAULT_DROPS = frozenset(
 class _PeerState:
     """Per-address dial lifecycle: consecutive failures and the earliest
     tick the next dial may happen (exponential backoff, reset to 0 by a
-    successful establishment or by inbound contact from that address)."""
+    successful establishment or by inbound contact from that address) —
+    plus the delta-interval SENDER state for that peer: the cumulative
+    seq it has acked, and whether its unacked gap fell off the
+    retransmit window (interval-dirty: the peer is owed a range repair,
+    announced via MsgIntervalReset). Living on the ADDRESS, not the
+    connection, is the point — acks survive conn churn, which is what
+    makes reconnect retransmit exactly the missed window."""
 
-    __slots__ = ("fails", "next_dial_tick", "dials")
+    __slots__ = (
+        "fails", "next_dial_tick", "dials",
+        "acked", "interval_dirty", "reset_seq",
+    )
 
     def __init__(self):
         self.fails = 0
         self.next_dial_tick = 0
         self.dials = 0  # total attempts (the drill's bounded-rate check)
+        # highest cumulative MsgSeqPush seq this peer has acked; None
+        # until its first ack (a brand-new peer bootstraps its history
+        # through the digest-tree sync, not through replay)
+        self.acked: int | None = None
+        self.interval_dirty = False
+        self.reset_seq = 0  # seq the last MsgIntervalReset re-based to
 
 
 class _Conn:
@@ -233,7 +289,8 @@ class _Conn:
         "writer", "active_addr", "peer_addr", "established", "task",
         "sync_served_tick",
         "sync_digests", "sync_defer_streak", "sync_defer_last_tick",
-        "pong_sent", "last_write_dropped",
+        "pong_sent", "last_write_dropped", "range_pending",
+        "range_inflight",
     )
 
     def __init__(self, writer, active_addr: Address | None):
@@ -270,6 +327,19 @@ class _Conn:
         # idle-evicted within IDLE_TICKS_LIMIT ticks, and the deque dies
         # with the conn.
         self.pong_sent: deque = deque()
+        # requester-side range-walk cursor (ACTIVE conns): per type, the
+        # divergent digest-tree buckets not yet pulled from this peer.
+        # Each MsgSyncDone pops the next RANGE_REQ_BUCKETS-sized chunk
+        # into a MsgRangeRequest, so a big heal walks the tree in
+        # budgeted rounds. Dies with the conn: a reconnect re-compares
+        # trees (cheap) rather than trusting a stale cursor.
+        self.range_pending: dict[str, list[int]] = {}
+        # True while a MsgRangeRequest round is outstanding on this conn
+        # — the requester side of the repair budget. Without it, N
+        # mismatched types (each tree handled as its own task) plus the
+        # digest request's closing SyncDone would each start a round,
+        # sustaining N+1 concurrent range streams against one responder.
+        self.range_inflight = False
         # True when the LAST send_raw "succeeded" only because an
         # injected cluster.write=drop swallowed it: no frame reached
         # the peer, so no Pong will answer — the rtt path must not
@@ -377,6 +447,17 @@ class Cluster:
             "dials": 0, "dial_fails": 0,
             "sync_served": 0, "sync_deferred": 0, "sync_done_recv": 0,
             "held_drops": 0,
+            # anti-entropy v2 (schema v8) repair-cost counters: repair
+            # is observable, not inferred (docs/replication.md ladder)
+            "deltas_reshipped": 0,      # retransmitted unacked batches
+            "ranges_requested": 0,      # divergent buckets we pulled
+            "ranges_served": 0,         # divergent buckets we streamed
+            "sync_bytes_sent": 0,       # tree/range/dump frame bytes out
+            "sync_bytes_recv": 0,       # tree/range/dump frame bytes in
+            "sync_trees_sent": 0,       # digest trees streamed (per type)
+            "sync_full_dumps": 0,       # legacy-shape fallback dumps ONLY
+            "interval_resets_sent": 0,  # gaps we demoted to range repair
+            "interval_resets_recv": 0,  # gaps peers demoted us over
         }
         self._drop_counts: dict[str, int] = {}
         # declared message-level drops (MsgDrop reasons): frame
@@ -398,6 +479,33 @@ class Cluster:
         # backlog's time dimension (the backlog_ms gauge).
         self._held: list[tuple[int, bytes]] = []
         self._held_cap = 1024
+        # ---- delta-interval replication (schema v8) --------------------
+        # per-sender monotone sequence over CONTENT-CARRYING delta
+        # batches, and the bounded retransmit window of (seq, wired
+        # frame) those batches live in. On (re)establishment the sender
+        # reships exactly the entries past the peer's acked watermark;
+        # an unacked gap that fell off the window demotes that peer to
+        # range repair via MsgIntervalReset (see _log_delta /
+        # _retransmit_unacked). The window holds pre-framed bytes: a
+        # retransmit reships the ORIGINAL origin stamp, so the lag gauge
+        # reports the delta's true staleness, not a fresh-looking lie.
+        self._delta_seq = 0
+        self._delta_log: deque = deque()  # (seq, wired frame)
+        self._delta_log_cap = getattr(config, "delta_log_cap", DELTA_LOG_CAP)
+        self._range_budget = getattr(config, "range_budget", RANGE_REQ_BUCKETS)
+        # receiver-side interval state per SENDER identity (str addr):
+        # the highest contiguous seq applied, plus the bounded
+        # out-of-order park for seqs above it (collapsed when retransmit
+        # fills the gap; rebased by MsgIntervalReset or the ooo cap)
+        self._recv_cum: dict[str, int] = {}
+        self._recv_ooo: dict[str, set[int]] = {}
+        # server-side range-serve queue: (conn, type, buckets) FIFO
+        # drained by ONE task with writer backpressure — the per-peer
+        # repair budget (one outstanding request per requester, one
+        # stream at a time) that keeps a rejoining node from starving
+        # serving
+        self._range_queue: list = []
+        self._range_serve_inflight = False
         self._flush_tasks: set = set()  # strong refs; asyncio's are weak
         self._sync_req_tick: dict[Address, int] = {}  # rate limit per peer
         self._sync_req_inflight: set[Address] = set()  # one request per peer
@@ -556,6 +664,8 @@ class Cluster:
             "sync_done_recv": self._stats["sync_done_recv"],
             "held_now": len(self._held),
             "held_drops": self._stats["held_drops"],
+            "delta_log_len": len(self._delta_log),
+            "interval_dirty_peers": self._dirty_count(),
             # the time dimension of anti-entropy health: worst per-peer
             # push→apply staleness, and how long work has been backed up
             # (held deltas / deferred sync serves) — both also published
@@ -563,6 +673,13 @@ class Cluster:
             "converge_lag_ms": int(self._worst_lag_ms()),
             "backlog_ms": int(self._backlog_ms()),
         }
+        for key in (
+            "deltas_reshipped", "ranges_requested", "ranges_served",
+            "sync_bytes_sent", "sync_bytes_recv", "sync_trees_sent",
+            "sync_full_dumps", "interval_resets_sent",
+            "interval_resets_recv",
+        ):
+            out[key] = self._stats[key]
         for reason in sorted(self._drop_counts):
             out[f"drop_{reason}"] = self._drop_counts[reason]
         for reason in sorted(self._msg_drops):
@@ -589,6 +706,22 @@ class Cluster:
 
     def _worst_lag_ms(self) -> float:
         return max(self._lag_ms.values(), default=0.0)
+
+    def _dirty_count(self) -> int:
+        return sum(1 for st in self._peers.values() if st.interval_dirty)
+
+    def _mark_dirty(self, st: _PeerState, dirty: bool) -> None:
+        """Flip a peer's interval-dirty flag and republish the
+        cluster.interval_dirty_peers gauge — every transition is
+        observable (a dirty peer is a peer owed a range repair; the
+        gauge pinned at 0 is the churn soak's no-silent-loss check)."""
+        if st.interval_dirty == dirty:
+            return
+        st.interval_dirty = dirty
+        if self._reg.enabled and self._obs_primary:
+            self._reg.gauge_set(
+                "cluster.interval_dirty_peers", float(self._dirty_count())
+            )
 
     def lag_snapshot(self) -> dict[str, float]:
         """{peer address: push→apply lag EWMA ms} — SYSTEM LATENCY's
@@ -772,7 +905,9 @@ class Cluster:
                         self._drop(conn, Drop.CODEC)
                         return
                     if active:
-                        await self._active_msg(conn, msg, origin_ms)
+                        await self._active_msg(
+                            conn, msg, origin_ms, nbytes=len(body)
+                        )
                     else:
                         await self._passive_msg(conn, msg, origin_ms)
         except (ConnectionError, asyncio.CancelledError, FramingError):
@@ -814,10 +949,13 @@ class Cluster:
         conn.established = True
         self._mark_activity(conn)
         if active:
-            # we initiated: announce our membership view, then ask for
-            # missed state — this connection just (re)opened, so any
-            # deltas flushed while it was down are gone (fire-and-forget)
+            # we initiated: announce our membership view, replay the
+            # peer's unacked delta window (the blip-sized heal: exactly
+            # the missed batches, schema v8), then ask for missed state
+            # the other way (deltas pushed to us while we were down are
+            # not replayable by anyone — the digest request covers them)
             self._send(conn, MsgExchangeAddrs(self._known_addrs.copy()))
+            self._retransmit_unacked(conn)
             self._maybe_request_sync(conn)
         else:
             # passive side echoes the signature back
@@ -846,40 +984,86 @@ class Cluster:
                 self._peer_key(conn), max(self._clock.now_ms() - origin_ms, 0)
             )
 
-    async def _active_msg(self, conn: _Conn, msg, origin_ms: int = 0) -> None:
+    def _consume_rtt_stamp(self, conn: _Conn, unmatched_reason: str) -> None:
+        """Close one cluster.rtt sample: a reply (Pong or DeltaAck) pops
+        the oldest outstanding stamped send on its conn. The FIFO match
+        is exact because replies are generated in receive order per conn
+        and only stamped sends solicit them. Pop unconditionally; the
+        enabled switch gates only the record, so a mid-conn toggle can
+        never strand stamps and shift later matches. A reply with
+        nothing outstanding is a DECLARED drop (an out-of-envelope peer
+        a silent ignore would hide forever)."""
+        if conn.pong_sent:
+            dt = self._clock.perf() - conn.pong_sent.popleft()
+            if self._reg.enabled and self._obs_primary:
+                self._h_rtt.record(dt)
+        else:
+            self._drop_msg(conn, unmatched_reason)
+
+    async def _active_msg(
+        self, conn: _Conn, msg, origin_ms: int = 0, nbytes: int = 0
+    ) -> None:
+        if isinstance(msg, MsgDeltaAck):
+            # the push path's reply (schema v8): fold the cumulative
+            # watermark into the peer's interval state, then consume the
+            # rtt stamp exactly like a Pong (acks answer stamped
+            # SeqPush/retransmit sends in FIFO order on this conn)
+            st = self._peers.get(conn.active_addr)
+            if msg.cum > self._delta_seq:
+                # the receiver's contiguity cursor outruns our counter:
+                # it tracked a PREVIOUS incarnation of this address (we
+                # crash-rebooted and restarted at seq 0). Re-base it
+                # down — otherwise our new stream looks like duplicates
+                # to its ack bookkeeping forever and reconnect replay
+                # silently no-ops (data still heals via the periodic
+                # digest sync, but the interval tier would be dead)
+                if st is not None:
+                    self._send_reset(conn, st)
+            elif st is not None and (st.acked is None or msg.cum > st.acked):
+                st.acked = msg.cum
+            self._consume_rtt_stamp(conn, MsgDrop.ACK_UNMATCHED)
+            return
+        if isinstance(msg, MsgDigestTree):
+            # sync response, range tier: the responder's keyspace-range
+            # digest tree for one mismatched type. Compare against our
+            # own tree (repo lock — a task, never the read loop) and
+            # start the budgeted range walk.
+            self._stats["sync_bytes_recv"] += nbytes
+            task = asyncio.get_running_loop().create_task(
+                self._handle_tree(conn, msg)
+            )
+            self._flush_tasks.add(task)
+            task.add_done_callback(self._flush_task_done)
+            return
         if isinstance(msg, MsgPong):
             # heartbeat-send → Pong round-trip (cluster.rtt): matched
             # against the oldest outstanding Pong-soliciting send. The
             # FIFO match is exact because Pongs answer ONLY stamped
             # push/announce sends, in order — sync replies are
-            # MsgSyncDone, never Pong. Pop unconditionally; the enabled
-            # switch gates only the record, so a mid-conn toggle can
-            # never strand stamps and shift later matches
-            if conn.pong_sent:
-                dt = self._clock.perf() - conn.pong_sent.popleft()
-                if self._reg.enabled and self._obs_primary:
-                    self._h_rtt.record(dt)
-            else:
-                # nothing outstanding answers this Pong — an
-                # out-of-envelope peer, declared and counted (a silent
-                # ignore here would hide a double-ponging peer forever)
-                self._drop_msg(conn, MsgDrop.PONG_UNMATCHED)
+            # MsgSyncDone, never Pong.
+            self._consume_rtt_stamp(conn, MsgDrop.PONG_UNMATCHED)
             return  # liveness only
         if isinstance(msg, MsgSyncDone):
-            # sync reply closing our request: no data needed (deferred /
-            # digest-matched / end-of-dump — the requester re-pulls by
-            # cooldown either way). Counted so the requester side of the
-            # sync conversation is observable, not a silent ignore.
+            # sync reply closing our request or one range round: no data
+            # needed (deferred / digest-matched / end-of-stream).
+            # Counted so the requester side of the sync conversation is
+            # observable, not a silent ignore — then the range walk
+            # continues if divergent buckets remain (each SyncDone
+            # closes one budgeted round).
             self._stats["sync_done_recv"] += 1
+            conn.range_inflight = False
+            self._continue_ranges(conn)
             return
         if isinstance(msg, MsgExchangeAddrs):
             self._converge_addrs(msg.known_addrs)
             return
         if isinstance(msg, MsgPushDeltas):
-            # full-state sync response to our MsgSyncRequest: converge
-            # like any push — the join is idempotent, so overlap with
-            # live deltas is harmless
+            # range-scoped (or legacy full-state) sync data answering
+            # our MsgSyncRequest / MsgRangeRequest: converge like any
+            # push — the join is idempotent, so overlap with live
+            # deltas is harmless
             self._sync_rx_tick = self._tick  # mid-heal: defer serving dumps
+            self._stats["sync_bytes_recv"] += nbytes
             await self._database.converge_async((msg.name, list(msg.batch)))
             self._record_push_lag(conn, origin_ms)
             if self.on_push is not None:
@@ -908,6 +1092,74 @@ class Cluster:
             self._converge_addrs(msg.known_addrs)
             self._send(conn, MsgExchangeAddrs(self._known_addrs.copy()))
             return
+        if isinstance(msg, MsgSeqPush):
+            # the schema-v8 live delta path: track the sender's batch
+            # sequence (contiguity cursor + bounded out-of-order park)
+            # and ack the cumulative watermark FIRST — the ack is the
+            # liveness signal (the v8 Pong of the push path), and a
+            # large batch's converge must not delay it past the peer's
+            # idle-eviction window. The awaited converge still paces
+            # this connection, so backpressure and per-connection
+            # ordering are unchanged. Duplicates (retransmit overlap)
+            # converge harmlessly — the join is idempotent — and just
+            # re-state the ack.
+            self._send(conn, MsgDeltaAck(self._track_seq(conn, msg.seq)))
+            await self._database.converge_async((msg.name, list(msg.batch)))
+            self._record_push_lag(conn, origin_ms)
+            if self.on_push is not None:
+                self.on_push(msg.name, list(msg.batch))
+            return
+        if isinstance(msg, MsgIntervalReset):
+            # the sender's retransmit window lost our gap: re-base our
+            # contiguity cursor, drop the parked out-of-order seqs, and
+            # demote this peering to range repair — force a digest-tree
+            # sync toward the sender (the ladder's middle rung; the data
+            # the interval machinery lost arrives as divergent ranges)
+            self._stats["interval_resets_recv"] += 1
+            skey = self._peer_key(conn)
+            self._recv_cum[skey] = msg.seq
+            self._recv_ooo.pop(skey, None)
+            self._reg.trace_event(
+                "cluster", "interval_reset", "recv", self._conn_desc(conn)
+            )
+            self._force_range_repair(conn.peer_addr)
+            return
+        if isinstance(msg, MsgRangeRequest):
+            # range tier serve: queue the requested buckets for the
+            # single range-serve task (FIFO across requesters, one
+            # backpressured stream at a time). A request larger than our
+            # own budget is split into budget-sized sub-rounds — NOT
+            # truncated: a requester with a bigger --range-budget than
+            # ours deletes the whole request from its pending cursor the
+            # moment it sends, so any bucket we dropped here would stay
+            # divergent until the next periodic digest exchange. Only
+            # the last sub-round carries the closing MsgSyncDone (one
+            # request, one SyncDone), and the FIFO interleaves other
+            # requesters' rounds between our slices.
+            if msg.name not in self._database.DATA_TYPES:
+                # a type this build does not serve: protocol violation
+                # (the handshake pinned the schema, so both ends know
+                # the same name set)
+                self._drop(conn, Drop.UNEXPECTED)
+                return
+            buckets = list(msg.buckets)
+            self._stats["ranges_served"] += len(buckets)
+            step = max(self._range_budget, 1)
+            chunks = [
+                buckets[i : i + step] for i in range(0, len(buckets), step)
+            ] or [[]]  # an EMPTY request is legal: zero frames + SyncDone
+            for i, chunk in enumerate(chunks):
+                self._range_queue.append(
+                    (conn, msg.name, tuple(chunk), i == len(chunks) - 1)
+                )
+            if not self._range_serve_inflight:
+                self._range_serve_inflight = True
+                task = asyncio.get_running_loop().create_task(
+                    self._serve_ranges()
+                )
+                self._flush_tasks.add(task)
+                task.add_done_callback(self._flush_task_done)
+            return
         if isinstance(msg, MsgPushDeltas):
             # Pong FIRST: the pong is a liveness signal, and a large
             # batch's converge (or waiting out a repo lock held by a
@@ -915,7 +1167,10 @@ class Cluster:
             # acknowledging receipt must not wait on lattice work. The
             # awaited converge still paces this connection (the next
             # frame is not read until it finishes), so peer backpressure
-            # and per-connection delta ordering are unchanged.
+            # and per-connection delta ordering are unchanged. Post-v8
+            # this branch carries only content-free keepalives (live
+            # data rides MsgSeqPush), but any joinable payload still
+            # converges — dup delivery across the schema seam is safe.
             self._send(conn, MsgPong())
             await self._database.converge_async((msg.name, list(msg.batch)))
             self._record_push_lag(conn, origin_ms)
@@ -1029,6 +1284,60 @@ class Cluster:
         )
         self._drop(conn, Drop.UNEXPECTED)
 
+    # ---- delta-interval receiver state (schema v8) -------------------------
+
+    def _track_seq(self, conn: _Conn, seq: int) -> int:
+        """Advance one sender's contiguity cursor for a received
+        MsgSeqPush; returns the cumulative watermark to ack. First
+        contact baselines at the observed seq (earlier history arrives
+        through the bootstrap tree sync, not through the interval
+        machinery); a gap parks the seq in the bounded out-of-order set
+        until retransmit fills it; ooo overflow declares the interval
+        relationship lost and self-demotes to range repair."""
+        skey = self._peer_key(conn)
+        cum = self._recv_cum.get(skey)
+        if cum is None:
+            self._recv_cum[skey] = seq
+            return seq
+        if seq == cum + 1:
+            cum += 1
+            ooo = self._recv_ooo.get(skey)
+            if ooo:
+                while cum + 1 in ooo:
+                    cum += 1
+                    ooo.discard(cum)
+                if not ooo:
+                    del self._recv_ooo[skey]
+            self._recv_cum[skey] = cum
+        elif seq > cum + 1:
+            ooo = self._recv_ooo.setdefault(skey, set())
+            ooo.add(seq)
+            if len(ooo) > RECV_OOO_CAP:
+                # the gap is not getting filled: rebase past it and pull
+                # the divergence as ranges instead of holding seqs
+                # forever (ladder: interval -> range, never unbounded)
+                self._recv_cum[skey] = max(ooo)
+                del self._recv_ooo[skey]
+                self._reg.trace_event(
+                    "cluster", "interval_overflow", "", skey
+                )
+                self._force_range_repair(conn.peer_addr)
+        # seq <= cum: retransmit duplicate — cursor unchanged
+        return self._recv_cum[skey]
+
+    def _force_range_repair(self, addr: Address | None) -> None:
+        """Clear the sync-request cooldown toward one peer and request
+        immediately if its active conn is up: the receiver-side entry
+        into range repair (driven by MsgIntervalReset / ooo overflow,
+        where waiting out the periodic cadence would stretch a known
+        divergence window for no reason)."""
+        if addr is None:
+            return
+        self._sync_req_tick.pop(addr, None)
+        conn = self._actives.get(addr)
+        if conn is not None and conn.established:
+            self._maybe_request_sync(conn)
+
     # ---- bootstrap / rejoin full-state sync --------------------------------
 
     def _maybe_request_sync(self, conn: _Conn) -> None:
@@ -1070,16 +1379,13 @@ class Cluster:
         finally:
             self._sync_req_inflight.discard(conn.active_addr)
 
-    async def _data_frames(self, name: str):
-        """Async generator over ONE type's sync-dump frames: the dump
-        happens under its repo lock (device touches threaded), and each
+    async def _chunk_frames(self, name: str, batch):
+        """Async generator over one batch's bounded sync frames: each
         frame is encoded off the loop just before it yields — the
-        responder never materialises the whole encoded keyspace
-        (round-5 verdict item 3). Frames are bounded both by key count
+        responder never materialises the whole encoded batch (round-5
+        verdict item 3). Frames are bounded both by key count
         (SYNC_CHUNK_KEYS) and by encoded size (SYNC_CHUNK_BYTES: an
         oversized chunk re-splits by key down to single-key frames)."""
-        dump = await self._database.dump_state_async(names=(name,))
-        batch = dump[0][1] if dump else []
         if name == "TLOG":
             # equal-timestamp entries order by interner-local ids on
             # device, which differ across nodes; ship ties by value
@@ -1106,6 +1412,111 @@ class Cluster:
                 continue
             yield self._wire(data)
 
+    async def _data_frames(self, name: str):
+        """One type's WHOLE-state sync frames: the legacy-shape fallback
+        (a requester whose digest vector we cannot interpret — the
+        degradation ladder's last rung). The dump happens under its repo
+        lock with device touches threaded; chunking via _chunk_frames."""
+        dump = await self._database.dump_state_async(names=(name,))
+        async for fr in self._chunk_frames(name, dump[0][1] if dump else []):
+            yield fr
+
+    async def _range_frames(self, name: str, buckets):
+        """One type's state RESTRICTED to the requested digest-tree
+        buckets, as bounded sync frames: bytes proportional to the
+        divergence the requester measured, never to the keyspace."""
+        batch = await self._database.dump_range_async(name, buckets)
+        async for fr in self._chunk_frames(name, batch):
+            yield fr
+
+    async def _serve_ranges(self) -> None:
+        """Drain the range-request queue: ONE backpressured stream at a
+        time (writer.drain between frames), FIFO across requesters —
+        the server side of the per-peer repair budget. Each request is
+        closed with MsgSyncDone, which is the requester's cue to pull
+        its next budgeted bucket round (an over-budget request streams
+        as several queue entries; only the last is ``done``)."""
+        try:
+            while self._range_queue:
+                conn, name, buckets, done = self._range_queue.pop(0)
+                if conn.writer is None or conn.writer.transport.is_closing():
+                    continue
+                self._log.info() and self._log.i(
+                    f"sync: serving {len(buckets)} {name} range(s)"
+                )
+                ok = True
+                async for fr in self._range_frames(name, buckets):
+                    try:
+                        # sync.range: drop -> this range frame is lost
+                        # (the requester's next tree compare re-pulls
+                        # the bucket); error -> conn drop + redial heal
+                        fr = await faults.async_point("sync.range", fr)
+                    except faults.FaultError:
+                        self._drop(conn, Drop.WRITE_FAILED)
+                        ok = False
+                        break
+                    if fr is None:
+                        continue
+                    if not await self._send_frame(conn, fr):
+                        ok = False
+                        break
+                if ok and done:
+                    self._send(conn, MsgSyncDone())
+        finally:
+            self._range_serve_inflight = False
+
+    async def _handle_tree(self, conn: _Conn, msg: MsgDigestTree) -> None:
+        """Requester side of the range tier: diff the responder's
+        digest-tree leaves against our own and start the budgeted walk
+        of divergent buckets. Runs as a task (our tree takes the repo
+        lock). Buckets where we hold keys the responder lacks also
+        mismatch — requesting them is harmless (the responder serves
+        what it has; our surplus flows to it when IT pulls)."""
+        if msg.name not in self._database.DATA_TYPES:
+            self._drop(conn, Drop.UNEXPECTED)
+            return
+        mine = dict(await self._database.sync_tree_async(msg.name))
+        theirs = dict(msg.leaves)
+        divergent = sorted(
+            b
+            for b in set(mine) | set(theirs)
+            if mine.get(b) != theirs.get(b)
+        )
+        if not divergent:
+            return  # leaf-equal: root mismatch was healed in flight
+        if conn.writer is None or conn.writer.transport.is_closing():
+            return
+        self._log.info() and self._log.i(
+            f"sync: {len(divergent)} divergent {msg.name} range(s), "
+            f"walking {self._range_budget} per round"
+        )
+        conn.range_pending[msg.name] = divergent
+        self._continue_ranges(conn)
+
+    def _continue_ranges(self, conn: _Conn) -> None:
+        """Pull the next budgeted round of divergent buckets, one
+        outstanding MsgRangeRequest per conn (each MsgSyncDone clears
+        the in-flight flag and re-enters here; concurrent entries —
+        several mismatched types' tree tasks finishing together — see
+        the flag and yield to the round already in flight). No-op once
+        the walk is done — the next periodic digest exchange is the
+        convergence check."""
+        if conn.range_inflight:
+            return
+        for name in list(conn.range_pending):
+            pending = conn.range_pending[name]
+            if not pending:
+                del conn.range_pending[name]
+                continue
+            chunk = pending[: self._range_budget]
+            del pending[: self._range_budget]
+            if not pending:
+                del conn.range_pending[name]
+            self._stats["ranges_requested"] += len(chunk)
+            conn.range_inflight = True
+            self._send(conn, MsgRangeRequest(name, tuple(chunk)))
+            return
+
     async def _system_frames(self) -> list[bytes]:
         """The SYSTEM log as sync frames, dumped fresh (it is tiny —
         trimmed to ~200 entries — and deliberately outside the digest, so
@@ -1117,52 +1528,87 @@ class Cluster:
         ]
 
     async def _serve_syncs(self) -> None:
-        """Drain the sync-waiter queue: ONE chunk-streamed dump serves
-        every queued requester, with writer.drain() between frames so a
-        large state streams under backpressure instead of tripping the
-        16 MB kill limit mid-sync. A requester whose digest matches ours
-        gets the (tiny) SYSTEM frames and a SyncDone — zero data frames, and
-        the digest comparison itself is the O(dirty) incremental one (no
-        dump happens at all when every waiter matches)."""
+        """Drain the sync-waiter queue (schema v8: the range tier). A
+        requester whose digests all match ours gets the (tiny) SYSTEM
+        frames and a SyncDone — zero data frames, zero-lag proof. A
+        requester with MISMATCHED types gets one ~8 KB MsgDigestTree per
+        mismatched type instead of a keyspace dump: it compares leaves
+        and pulls only divergent buckets (MsgRangeRequest), so rejoin
+        bytes scale with divergence. Only a requester whose digest
+        vector shape we cannot interpret falls through to the legacy
+        whole-state dump — the degradation ladder's last rung, counted
+        in sync_full_dumps (the churn soak pins it at zero)."""
         try:
             while self._sync_waiters:
                 waiters, self._sync_waiters = self._sync_waiters, []
                 mine = await self._database.sync_type_digests_async()
                 types = self._database.DATA_TYPES
                 sys_frames = await self._system_frames()
-                need: dict[_Conn, set] = {}
+                dump_all: list[_Conn] = []
                 for conn in waiters:
                     theirs = conn.sync_digests
-                    if len(theirs) == len(types):
-                        miss = {
-                            n for n, a, b in zip(types, mine, theirs) if a != b
-                        }
-                    else:
-                        miss = set(types)  # unknown digest shape: ship all
+                    if len(theirs) != len(types):
+                        dump_all.append(conn)  # unknown digest shape
+                        continue
+                    miss = [
+                        n for n, a, b in zip(types, mine, theirs) if a != b
+                    ]
                     if not miss:
                         # replicated observability (SYSTEM GETLOG): an
                         # in-sync rejoin is provably zero-cost. The
                         # digest match also PROVES the peer converged as
                         # of this wall instant — fold it into the lag
-                        # gauge as a zero-lag sample
+                        # gauge as a zero-lag sample, and clear any
+                        # interval-dirty debt we held against it (the
+                        # range repair it was owed has demonstrably
+                        # happened)
                         self._note_lag(self._peer_key(conn), 0.0)
+                        if conn.peer_addr is not None:
+                            st = self._peers.get(conn.peer_addr)
+                            if st is not None:
+                                self._mark_dirty(st, False)
                         self._log.info() and self._log.i(
                             "sync: peer digest match, zero data frames"
                         )
                         await self._stream_sync(conn, sys_frames)
-                    else:
-                        need[conn] = miss
-                if not need:
+                        continue
+                    self._log.info() and self._log.i(
+                        f"sync: digest trees for {'+'.join(miss)}"
+                    )
+                    ok = True
+                    for name in miss:
+                        leaves = await self._database.sync_tree_async(name)
+                        fr = self._wire(
+                            codec.encode(MsgDigestTree(name, leaves))
+                        )
+                        try:
+                            # sync.digest: drop -> this tree frame is
+                            # lost (the requester re-pulls next period);
+                            # error -> conn drop + redial heal
+                            fr = await faults.async_point("sync.digest", fr)
+                        except faults.FaultError:
+                            self._drop(conn, Drop.WRITE_FAILED)
+                            ok = False
+                            break
+                        if fr is None:
+                            continue
+                        self._stats["sync_trees_sent"] += 1
+                        if not await self._send_frame(conn, fr):
+                            ok = False
+                            break
+                    if ok:
+                        await self._stream_sync(conn, sys_frames)
+                if not dump_all:
                     continue
-                union = [n for n in types if any(n in m for m in need.values())]
+                self._stats["sync_full_dumps"] += len(dump_all)
                 self._log.info() and self._log.i(
-                    f"sync: streaming {'+'.join(union)} to {len(need)} peer(s)"
+                    f"sync: full dump to {len(dump_all)} legacy-shape peer(s)"
                 )
-                # per MISMATCHED type, encode-and-fan one bounded chunk at
-                # a time: responder memory holds ONE encoded chunk, never
-                # the keyspace, and in-sync types never dump at all
-                for name in union:
-                    targets = [c for c in need if name in need[c]]
+                # per type, encode-and-fan one bounded chunk at a time:
+                # responder memory holds ONE encoded chunk, never the
+                # keyspace
+                for name in types:
+                    targets = list(dump_all)
                     async for fr in self._data_frames(name):
                         targets = [
                             c for c in targets if await self._send_frame(c, fr)
@@ -1171,7 +1617,7 @@ class Cluster:
                             break
                 live = [
                     c
-                    for c in need
+                    for c in dump_all
                     if c.writer is not None
                     and not c.writer.transport.is_closing()
                 ]
@@ -1207,6 +1653,7 @@ class Cluster:
         except (ConnectionError, RuntimeError):
             self._drop(conn, Drop.WRITE_FAILED)
             return False
+        self._stats["sync_bytes_sent"] += len(data)
         self._mark_activity(conn)
         return True
 
@@ -1244,6 +1691,10 @@ class Cluster:
             for addr in list(self._peers):
                 if addr not in self._known_addrs:
                     del self._peers[addr]
+            for skey in list(self._recv_cum):
+                if not any(str(a) == skey for a in self._known_addrs):
+                    self._recv_cum.pop(skey, None)
+                    self._recv_ooo.pop(skey, None)
             self._sync_actives()
             self._broadcast_msg(MsgExchangeAddrs(self._known_addrs.copy()))
 
@@ -1257,38 +1708,162 @@ class Cluster:
         return wire_frame(body, origin_ms=self._clock.now_ms())
 
     def broadcast_deltas(self, deltas) -> None:
-        """The _SendDeltasFn sink (cluster.pony:209-213): serialise the batch
-        once, write to every established active connection. Anything
-        already held ships FIRST (strict FIFO: a late-joining peer sees
-        pre-join writes in flush order, never a fresh batch jumping the
-        queue), and a fresh batch that cannot ship queues behind them."""
+        """The _SendDeltasFn sink (cluster.pony:209-213), schema v8:
+        serialise the batch once, write to every established active
+        connection. Content-carrying batches are SEQUENCED (MsgSeqPush
+        with this sender's monotone seq) and logged into the retransmit
+        window; content-free keepalives (the SYSTEM deltas_size()==1
+        quirk) stay unsequenced MsgPushDeltas — they solicit the Pong
+        that feeds the rtt histogram and never burn window slots.
+        Anything already held ships FIRST (strict FIFO: a late-joining
+        peer sees pre-join writes in flush order, never a fresh batch
+        jumping the queue), and a fresh batch that cannot ship queues
+        behind them."""
         name, batch = deltas
         if batch and name != "SYSTEM":
             # outbound data deltas exist only for LOCAL applies: the
             # signal that defers the periodic digest pull (heartbeat)
             self._local_writes_seen = True
-        data = self._wire(codec.encode(MsgPushDeltas(name, tuple(batch))))
+        if not self._worth_holding(name, batch):
+            # keepalive: best-effort liveness traffic, never held
+            data = self._wire(codec.encode(MsgPushDeltas(name, tuple(batch))))
+            self._flush_held()
+            if not self._held:
+                self._send_to_actives(data, expect_pong=True)
+            return
+        self._delta_seq += 1
+        data = self._wire(
+            codec.encode(MsgSeqPush(self._delta_seq, name, tuple(batch)))
+        )
+        self._log_delta(self._delta_seq, data)
         self._flush_held()
         if self._held or not self._send_to_actives(data, expect_pong=True):
             # nobody reachable right now (maybe nobody known yet): hold
             # instead of losing, so a late-joining peer still converges on
-            # pre-join writes up to the cap. Empty SYSTEM keepalive frames
-            # (deltas_size()==1 quirk) carry nothing and would FIFO-evict
-            # real pre-join writes on a long-solo node — don't hold those.
-            if self._worth_holding(name, batch):
-                self._held.append((self._clock.now_ms(), data))
-                over = len(self._held) - self._held_cap
-                if over > 0:
-                    # oldest-first eviction at the cap: DOCUMENTED data
-                    # loss (SURVEY.md §2.5's known gap, bounded) — made
-                    # visible per the robustness round: counted in the
-                    # CLUSTER metrics and warned once per episode
-                    del self._held[:over]
-                    self._note_held_drop(over)
+            # pre-join writes up to the cap (the delta log ALSO keeps the
+            # frame, but replay only serves peers with ack history — the
+            # held queue is what reaches a first-ever joiner).
+            self._held.append((self._clock.now_ms(), data))
+            over = len(self._held) - self._held_cap
+            if over > 0:
+                # oldest-first eviction at the cap: DOCUMENTED data
+                # loss (SURVEY.md §2.5's known gap, bounded) — made
+                # visible per the robustness round: counted in the
+                # CLUSTER metrics and warned once per episode
+                del self._held[:over]
+                self._note_held_drop(over)
 
     @staticmethod
     def _worth_holding(name: str, batch) -> bool:
         return codec.batch_has_content(name, batch)
+
+    def _log_delta(self, seq: int, data: bytes) -> None:
+        """Append one sequenced batch frame to the retransmit window.
+        Past the cap the oldest entries leave the window — and every
+        known peer whose acked watermark predates an evicted seq is
+        marked INTERVAL-DIRTY right here (the satellite fix: cap
+        eviction mid-partition used to be a counter + warn; now it is a
+        per-peer demotion to range repair, announced by
+        MsgIntervalReset the moment the peer is reachable)."""
+        self._delta_log.append((seq, data))
+        evicted_to = None
+        while len(self._delta_log) > self._delta_log_cap:
+            evicted_to, _ = self._delta_log.popleft()
+        if evicted_to is None:
+            return
+        for addr, st in self._peers.items():
+            if st.acked is not None and st.acked < evicted_to:
+                self._mark_dirty(st, True)
+                conn = self._actives.get(addr)
+                if conn is not None and conn.established:
+                    self._send_reset(conn, st)
+
+    def _send_reset(
+        self, conn: _Conn, st: _PeerState, force: bool = False
+    ) -> None:
+        """Demote one peer's interval relationship to range repair: the
+        retransmit window can no longer replay its gap, so re-base its
+        contiguity cursor at the current seq and let the reset push it
+        into a digest-tree sync toward us. Idempotent per seq (a dirty
+        peer is reset once per watermark, not once per frame) — EXCEPT
+        at re-establishment (``force``): any previous reset rode a conn
+        whose fate is unknown, and without the re-send a reset lost
+        with no new writes in between would never go out again (the
+        guard's own acked/reset_seq bookkeeping satisfies itself
+        forever at an unchanged delta_seq). Re-delivery is harmless:
+        the receiver re-bases idempotently."""
+        if (
+            not force
+            and st.reset_seq == self._delta_seq
+            and st.acked == self._delta_seq
+        ):
+            return
+        self._stats["interval_resets_sent"] += 1
+        st.reset_seq = self._delta_seq
+        # optimistic: frames after the reset arrive contiguous at the
+        # re-based cursor; if the reset itself is lost to churn the
+        # peer's next (stale) ack re-opens the gap and the next
+        # establishment re-sends the reset — self-correcting, and any
+        # interval confusion in between is healed by the periodic
+        # digest sync regardless
+        st.acked = self._delta_seq
+        self._reg.trace_event(
+            "cluster", "interval_reset", "sent", self._conn_desc(conn)
+        )
+        self._send(conn, MsgIntervalReset(self._delta_seq))
+
+    def _retransmit_unacked(self, conn: _Conn) -> None:
+        """Reconnection replay (the delta-interval payoff): ship exactly
+        the window entries past this peer's acked watermark. A peer with
+        NO ack history gets nothing — its history arrives through the
+        digest-tree bootstrap sync, not through a 1024-frame replay of
+        writes it may never have been owed. A peer whose gap fell off
+        the window gets the MsgIntervalReset demotion instead."""
+        st = self._peers.get(conn.active_addr)
+        if st is None or st.acked is None:
+            return
+        if st.interval_dirty or (
+            self._delta_log and self._delta_log[0][0] > st.acked + 1
+        ):
+            self._mark_dirty(st, True)
+            self._send_reset(conn, st, force=True)
+            return
+        # frames still sitting in the held queue reach this peer through
+        # the upcoming _flush_held (strict FIFO, next broadcast tick) —
+        # replaying them here would ship every one twice and answer with
+        # duplicate acks. Held frames are always the most-recent seq run
+        # (flush-first ordering: nothing newer is ever sent while older
+        # frames are held), so skipping them keeps the replay contiguous
+        # below the held run and per-peer seq order intact.
+        held = {data for _, data in self._held}
+        pending = [
+            (seq, data)
+            for seq, data in self._delta_log
+            if seq > st.acked and data not in held
+        ]
+        if sum(len(data) for _, data in pending) > RETRANSMIT_BYTES_CAP:
+            # the replay loop writes synchronously (no drain between
+            # frames — it runs inside handshake handling): a window
+            # bigger than the cap would blow through the conn's write
+            # buffer limit mid-replay, drop the freshly established
+            # conn, and repeat on every redial. A gap that large is
+            # range-repair territory anyway — demote instead of churn.
+            self._mark_dirty(st, True)
+            self._send_reset(conn, st, force=True)
+            return
+        n = 0
+        for seq, data in pending:
+            if not conn.send_raw(data):
+                self._drop(conn, Drop.WRITE_FAILED)
+                return
+            if not conn.last_write_dropped:
+                conn.pong_sent.append(self._clock.perf())
+            n += 1
+        if n:
+            self._stats["deltas_reshipped"] += n
+            self._reg.trace_event(
+                "cluster", "reship", "", f"{n} to {self._conn_desc(conn)}"
+            )
 
     def _send_to_actives(self, data: bytes, expect_pong: bool = False) -> bool:
         """Write one pre-framed message to every established active conn;

@@ -42,14 +42,19 @@ from ..utils.wire import WireError
 from .msg import (
     Msg,
     MsgAnnounceAddrs,
+    MsgDeltaAck,
+    MsgDigestTree,
     MsgExchangeAddrs,
+    MsgIntervalReset,
     MsgPong,
     MsgPushDeltas,
+    MsgRangeRequest,
+    MsgSeqPush,
     MsgSyncDone,
     MsgSyncRequest,
 )
 
-SCHEMA_VERSION = 7
+SCHEMA_VERSION = 8
 
 # The canonical schema text: any change to the wire format MUST change this
 # string (bump SCHEMA_VERSION), which changes the signature, which makes
@@ -86,6 +91,20 @@ SCHEMA_VERSION = 7
 # and journals (which stamp the delta signature) stay loadable via the
 # legacy acceptance below — they contain only old-type frames, all
 # still decodable.
+# v8: the anti-entropy rewrite — five new TRANSPORT messages, zero
+# delta-line changes (so delta_signature() is UNCHANGED from v7 and
+# every v7 snapshot/journal stays first-class loadable; v1-v6 remain
+# covered by the legacy acceptance). msg6/msg7 are the delta-interval
+# half (per-sender monotone batch seqs, cumulative contiguous acks,
+# retransmit-only-unacked — arXiv:1410.2803); msg8/msg9 are the
+# Merkle-range half (a 256-leaf keyspace digest tree over
+# sha256(key)[0], range pulls of divergent buckets only —
+# arXiv:1605.06424); msg10 is the graceful-degradation rung between
+# them (a sender whose retransmit window evicted a receiver's gap
+# re-baselines that receiver and demotes it to range repair — never a
+# silent whole-state dump). msg7's name+batch encoding is byte-
+# identical to msg3 after the tag+seq prefix, so the native PushDeltas
+# fast path serves both.
 _SCHEMA_TEXT = f"""jylis-tpu cluster schema v{SCHEMA_VERSION}
 varint=LEB128 bytes=varint-len-prefixed str=utf8-bytes
 wire=frame(crc32(origin_ms:u64be body):u32be origin_ms:u64be body)
@@ -98,6 +117,11 @@ msg2=AnnounceAddrs(p2set)
 msg3=PushDeltas(name:str batch:[(key:bytes delta)])
 msg4=SyncRequest(digests:[bytes] order=TREG,TLOG,GCOUNT,PNCOUNT,UJSON,TENSOR)
 msg5=SyncDone
+msg6=DeltaAck(cum:varint)
+msg7=SeqPush(seq:varint name:str batch:[(key:bytes delta)])
+msg8=DigestTree(name:str leaves:[(bucket:varint digest:bytes)] fanout=256 bucket=sha256(key)[0])
+msg9=RangeRequest(name:str buckets:[varint])
+msg10=IntervalReset(seq:varint)
 delta/TREG=(value:bytes ts:varint)
 delta/TLOG=delta/SYSTEM=(entries:[(value:bytes ts:varint)] cutoff:varint)
 delta/GCOUNT=[(rid:varint v:varint)]
@@ -214,7 +238,9 @@ def legacy_delta_signatures() -> tuple[bytes, ...]:
     still decodes: the v1-v6 delta lines (unchanged across that whole
     window) hash to one digest, stamped into every v4+ snapshot and
     journal header on disk. v7 added delta/TENSOR — a pure extension,
-    so those files' frames all still decode."""
+    so those files' frames all still decode; v8 touched only transport
+    messages, so v7 headers carry the CURRENT delta signature and need
+    no legacy entry."""
     delta_lines = [
         line
         for line in _LEGACY_V6_TEXT.splitlines()
@@ -476,6 +502,11 @@ _TAG_ANNOUNCE = 2
 _TAG_PUSH = 3
 _TAG_SYNC_REQ = 4
 _TAG_SYNC_DONE = 5
+_TAG_DELTA_ACK = 6
+_TAG_SEQ_PUSH = 7
+_TAG_DIGEST_TREE = 8
+_TAG_RANGE_REQ = 9
+_TAG_INTERVAL_RESET = 10
 
 
 def encode(msg: Msg) -> bytes:
@@ -485,6 +516,18 @@ def encode(msg: Msg) -> bytes:
         fast = ncodec.encode_push(msg)
         if fast is not None:
             return fast
+    elif isinstance(msg, MsgSeqPush):
+        # msg7's name+batch bytes are msg3's after the tag+seq prefix
+        # (pinned by the schema text), so the native per-key delta
+        # packer serves the seq-stamped hot path too
+        from ..native import codec as ncodec
+
+        fast = ncodec.encode_push(MsgPushDeltas(msg.name, msg.batch))
+        if fast is not None:
+            out = bytearray((_TAG_SEQ_PUSH,))
+            _w_varint(out, msg.seq)
+            out += fast[1:]
+            return bytes(out)
     return _encode_oracle(msg)
 
 
@@ -512,6 +555,33 @@ def _encode_oracle(msg: Msg) -> bytes:
         _w_varint(out, len(msg.digests))
         for d in msg.digests:
             _w_bytes(out, d)
+    elif isinstance(msg, MsgDeltaAck):
+        out.append(_TAG_DELTA_ACK)
+        _w_varint(out, msg.cum)
+    elif isinstance(msg, MsgSeqPush):
+        out.append(_TAG_SEQ_PUSH)
+        _w_varint(out, msg.seq)
+        _w_str(out, msg.name)
+        _w_varint(out, len(msg.batch))
+        for key, delta in msg.batch:
+            _w_bytes(out, key)
+            _w_delta(out, msg.name, delta)
+    elif isinstance(msg, MsgDigestTree):
+        out.append(_TAG_DIGEST_TREE)
+        _w_str(out, msg.name)
+        _w_varint(out, len(msg.leaves))
+        for bucket, digest in msg.leaves:
+            _w_varint(out, bucket)
+            _w_bytes(out, digest)
+    elif isinstance(msg, MsgRangeRequest):
+        out.append(_TAG_RANGE_REQ)
+        _w_str(out, msg.name)
+        _w_varint(out, len(msg.buckets))
+        for bucket in msg.buckets:
+            _w_varint(out, bucket)
+    elif isinstance(msg, MsgIntervalReset):
+        out.append(_TAG_INTERVAL_RESET)
+        _w_varint(out, msg.seq)
     else:
         raise CodecError(f"cannot encode {type(msg).__name__}")
     return bytes(out)
@@ -524,6 +594,18 @@ def decode(body: bytes) -> Msg:
         fast = ncodec.decode_push(body)
         if fast is not None:
             return fast
+    elif body and body[0] == _TAG_SEQ_PUSH:
+        # strip the seq prefix, decode the remainder as msg3 (native
+        # fast path or oracle — byte-identical by schema), re-tag
+        from ..native import codec as ncodec
+
+        r = _Reader(body)
+        r.pos = 1
+        seq = r.varint()
+        rest = bytes((_TAG_PUSH,)) + body[r.pos :]
+        fast = ncodec.decode_push(rest)
+        inner = fast if fast is not None else _decode_oracle(rest)
+        return MsgSeqPush(seq, inner.name, inner.batch)
     return _decode_oracle(body)
 
 
@@ -549,6 +631,27 @@ def _decode_oracle(body: bytes) -> Msg:
         msg = MsgPushDeltas(name, batch)
     elif tag == _TAG_SYNC_REQ:
         msg = MsgSyncRequest(tuple(r.bytes_() for _ in range(r.varint())))
+    elif tag == _TAG_DELTA_ACK:
+        msg = MsgDeltaAck(r.varint())
+    elif tag == _TAG_SEQ_PUSH:
+        seq = r.varint()
+        name = r.str_()
+        batch = tuple(
+            (r.bytes_(), _r_delta(r, name)) for _ in range(r.varint())
+        )
+        msg = MsgSeqPush(seq, name, batch)
+    elif tag == _TAG_DIGEST_TREE:
+        name = r.str_()
+        leaves = tuple(
+            (r.varint(), r.bytes_()) for _ in range(r.varint())
+        )
+        msg = MsgDigestTree(name, leaves)
+    elif tag == _TAG_RANGE_REQ:
+        name = r.str_()
+        buckets = tuple(r.varint() for _ in range(r.varint()))
+        msg = MsgRangeRequest(name, buckets)
+    elif tag == _TAG_INTERVAL_RESET:
+        msg = MsgIntervalReset(r.varint())
     else:
         raise CodecError(f"unknown message tag: {tag}")
     if not r.done():

@@ -57,6 +57,80 @@ class MsgPushDeltas:
 
 
 @dataclass(frozen=True)
+class MsgSeqPush:
+    """Schema v8 delta-interval broadcast: a MsgPushDeltas payload
+    stamped with the SENDER's per-sender monotone batch sequence. The
+    receiver tracks the highest contiguous seq per sender and answers
+    every SeqPush with MsgDeltaAck(cum) — the sender retransmits only
+    the unacked window on reconnection, so a short blip reships exactly
+    the missed batches instead of falling through to a state sync
+    ("Efficient State-based CRDTs by Delta-Mutation", arXiv:1410.2803's
+    delta-interval algorithm). Content-free keepalives (the SYSTEM
+    deltas_size()==1 quirk) stay unsequenced MsgPushDeltas: sequencing
+    them would burn retransmit-window slots on frames that carry
+    nothing."""
+
+    seq: int
+    name: str
+    batch: tuple  # tuple[(key: bytes, delta), ...]
+
+
+@dataclass(frozen=True)
+class MsgDeltaAck:
+    """Cumulative contiguous ack of a sender's MsgSeqPush stream: "I
+    have applied every batch of yours up to and including cum". Sent by
+    the receiver for EVERY SeqPush (duplicates included — the ack
+    re-states cum), it doubles as the push path's liveness reply, so it
+    consumes the sender's rtt stamp exactly like a Pong."""
+
+    cum: int
+
+
+@dataclass(frozen=True)
+class MsgDigestTree:
+    """One type's keyspace-range digest tree (schema v8 Merkle-range
+    repair, after "Big(ger) Sets", arXiv:1605.06424): sparse non-empty
+    leaves of the 256-bucket tree over sha256(key)[0], each leaf the
+    XOR of its keys' canonical per-key state hashes. Sent by a sync
+    responder for each type whose ROOT digest mismatches the
+    requester's — ~8 KB instead of a keyspace dump; the requester
+    compares leaves and pulls only divergent buckets via
+    MsgRangeRequest. An EMPTY tree (zero leaves) is legal: it means the
+    responder holds no keys of that type."""
+
+    name: str
+    leaves: tuple = ()  # tuple[(bucket: int, digest: bytes32), ...]
+
+
+@dataclass(frozen=True)
+class MsgRangeRequest:
+    """Pull one type's state for the named digest-tree buckets only.
+    The responder streams the range as chunked MsgPushDeltas frames
+    (the snapshot wire shape — converges idempotently) and closes with
+    MsgSyncDone; the requester walks remaining divergent buckets in
+    budgeted rounds, so repair bytes AND repair work scale with
+    divergence, never with keyspace. An empty bucket list is legal and
+    serves nothing but the SyncDone."""
+
+    name: str
+    buckets: tuple = ()  # tuple[int, ...]
+
+
+@dataclass(frozen=True)
+class MsgIntervalReset:
+    """The sender's delta log can no longer replay this receiver's gap
+    (held past the retransmit window, or evicted at the cap mid-
+    partition): "re-baseline your contiguity cursor to seq and pull a
+    range repair from me". The graceful-degradation rung between
+    interval retransmit and range repair — the receiver clears its
+    out-of-order set, adopts seq, and forces a digest-tree sync toward
+    the sender, so held-window loss demotes to range repair instead of
+    silent divergence (or a whole-state dump)."""
+
+    seq: int
+
+
+@dataclass(frozen=True)
 class MsgSyncRequest:
     """Bootstrap/rejoin full-state sync (beyond the reference, which can
     permanently miss deltas flushed while a peer was away —
@@ -85,4 +159,9 @@ Msg = (
     | MsgAnnounceAddrs
     | MsgPushDeltas
     | MsgSyncRequest
+    | MsgSeqPush
+    | MsgDeltaAck
+    | MsgDigestTree
+    | MsgRangeRequest
+    | MsgIntervalReset
 )

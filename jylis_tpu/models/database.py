@@ -12,6 +12,20 @@ import hashlib
 from contextlib import AsyncExitStack, asynccontextmanager
 
 from .help import DATATYPE_HELP, respond_help
+
+# keyspace-range fanout for the anti-entropy digest tree (schema v8):
+# every key lands in one of 256 stable buckets by the first byte of
+# sha256(key) — a function of the KEY alone, so converged replicas
+# bucket identically regardless of write order or backend. 256 leaves
+# of 32 bytes each keep a whole-tree frame ~8 KB sparse-encoded, small
+# enough to ship instead of a keyspace dump whenever root digests
+# mismatch.
+SYNC_FANOUT = 256
+
+
+def sync_bucket(key: bytes) -> int:
+    """The digest-tree leaf a key belongs to (stable across replicas)."""
+    return hashlib.sha256(key).digest()[0]
 from .manager import RepoManager
 from .repo_counters import RepoGCOUNT, RepoPNCOUNT
 from .repo_system import RepoSYSTEM
@@ -81,11 +95,35 @@ class Database:
         self._sync_xor: dict[str, bytes] = {
             n: bytes(32) for n in self.DATA_TYPES
         }
+        # the keyspace-range digest tree (schema v8 Merkle-range repair):
+        # per type, SYNC_FANOUT leaf accumulators — leaf b is the XOR of
+        # the per-key hashes of every key whose sync_bucket is b, so the
+        # XOR of all leaves IS _sync_xor and both update in the same
+        # O(dirty) incremental fold. A sync responder whose root
+        # mismatches ships these 256 x 32 bytes instead of the keyspace,
+        # and the requester pulls only divergent buckets.
+        self._sync_leaf: dict[str, list[int]] = {
+            n: [0] * SYNC_FANOUT for n in self.DATA_TYPES
+        }
+        # bucket -> live keys, maintained by the same O(dirty) fold: the
+        # range-serve path (dump_range_async) filters by membership here
+        # instead of re-hashing every key in the keyspace per round — a
+        # multi-round heal costs one sha256 per DIRTY key, not one per
+        # key per round. References only (the keys already live in
+        # _sync_hash), so the memory cost is pointer-sized.
+        self._sync_bkeys: dict[str, list[set]] = {
+            n: [set() for _ in range(SYNC_FANOUT)] for n in self.DATA_TYPES
+        }
         # SYSTEM DIGEST (the drill matrix's convergence probe, exposed
         # to any Redis client): the async serving path computes it
         # under the repo locks (apply_async intercept below); the sync
         # single-threaded path goes through this hook on RepoSYSTEM
         self.system.digest_fn = self._sync_digest_blocking
+        # SYSTEM DIGEST TYPES (the operator's divergence localizer):
+        # per-type digest lines so an operator can name the diverged
+        # TYPE before walking its ranges; same two-path wiring as the
+        # combined digest
+        self.system.digest_types_fn = self._sync_digest_types_blocking
 
     def _served_totals(self) -> dict[str, int]:
         """Commands served per type on BOTH paths (SYSTEM METRICS)."""
@@ -121,19 +159,29 @@ class Database:
         if not dirty:
             return
         hmap = self._sync_hash[name]
+        leaves = self._sync_leaf[name]
+        bkeys = self._sync_bkeys[name]
         x = int.from_bytes(self._sync_xor[name], "big")
         tag = name.encode()
         for key in dirty:
+            bucket = sync_bucket(key)
             old = hmap.pop(key, None)
             if old is not None:
-                x ^= int.from_bytes(old, "big")
+                o = int.from_bytes(old, "big")
+                x ^= o
+                leaves[bucket] ^= o
             canon = repo.sync_canon(key)
             if canon is not None:
                 h = hashlib.sha256(
                     tag + b"\x00" + len(key).to_bytes(4, "big") + key + canon
                 ).digest()
                 hmap[key] = h
-                x ^= int.from_bytes(h, "big")
+                hi = int.from_bytes(h, "big")
+                x ^= hi
+                leaves[bucket] ^= hi
+                bkeys[bucket].add(key)
+            else:
+                bkeys[bucket].discard(key)
         self._sync_xor[name] = x.to_bytes(32, "big")
 
     async def sync_type_digests_async(self) -> tuple[bytes, ...]:
@@ -154,6 +202,47 @@ class Database:
             b"".join(await self.sync_type_digests_async())
         ).digest()
 
+    async def sync_tree_async(self, name: str) -> tuple:
+        """One type's keyspace-range digest tree as SPARSE leaves:
+        ((bucket, 32-byte digest), ...) for the non-empty buckets only —
+        the MsgDigestTree payload. Folds the type's dirty keys first
+        (same O(dirty) incremental cost as the root digest)."""
+        mgr = self._map[name.encode()]
+        async with mgr._lock:
+            await asyncio.to_thread(self._sync_update_repo, name, mgr.repo)
+        return tuple(
+            (i, v.to_bytes(32, "big"))
+            for i, v in enumerate(self._sync_leaf[name])
+            if v
+        )
+
+    async def dump_range_async(self, name: str, buckets) -> list:
+        """One type's state RESTRICTED to the given digest-tree buckets,
+        in the wire-delta shape: the MsgRangeRequest serve path. Dump +
+        filter run in a worker thread under the repo lock, so a range
+        serve stalls only its own type and only briefly — and the bytes
+        it produces scale with the requested buckets, not the keyspace.
+        Key selection goes through the bucket index (folded current
+        first, O(dirty)), so a multi-round heal never re-hashes the
+        keyspace per round."""
+        mgr = self._map[name.encode()]
+
+        def dump_filtered():
+            self._sync_update_repo(name, mgr.repo)
+            bkeys = self._sync_bkeys[name]
+            wanted = set()
+            for b in buckets:
+                if 0 <= b < len(bkeys):
+                    wanted |= bkeys[b]
+            return [
+                (key, delta)
+                for key, delta in mgr.repo.dump_state()
+                if key in wanted
+            ]
+
+        async with mgr._lock:
+            return await asyncio.to_thread(dump_filtered)
+
     def _sync_digest_blocking(self) -> bytes:
         """The combined digest for SINGLE-THREADED callers (warmup,
         direct drives, tests): same bytes as sync_digest_async, no
@@ -164,6 +253,14 @@ class Database:
         return hashlib.sha256(
             b"".join(self._sync_xor[n] for n in self.DATA_TYPES)
         ).digest()
+
+    def _sync_digest_types_blocking(self) -> list[tuple[str, bytes]]:
+        """Per-type digests for SINGLE-THREADED callers — the sync-path
+        SYSTEM DIGEST TYPES (the serving path intercepts in apply_async,
+        which awaits the repo locks)."""
+        for name in self.DATA_TYPES:
+            self._sync_update_repo(name, self._map[name.encode()].repo)
+        return [(n, self._sync_xor[n]) for n in self.DATA_TYPES]
 
     def set_journal(self, journal) -> None:
         """Attach the delta write-ahead journal (journal/): every repo's
@@ -191,6 +288,21 @@ class Database:
 
     async def apply_async(self, resp, cmd: list[bytes]) -> None:
         """Serving path: per-repo locking + threaded drains (manager.py)."""
+        if (
+            len(cmd) == 3
+            and cmd[0] == b"SYSTEM"
+            and cmd[1] == b"DIGEST"
+            and cmd[2] == b"TYPES"
+        ):
+            # the per-type breakdown of the digest below: one
+            # "<TYPE> <hex>" line per data type, so an operator (or
+            # scripts/smoke3.py's gate) can localize a divergence to a
+            # type before walking its ranges
+            digests = await self.sync_type_digests_async()
+            resp.array_start(len(self.DATA_TYPES))
+            for name, digest in zip(self.DATA_TYPES, digests):
+                resp.string(f"{name} {digest.hex()}".encode())
+            return
         if len(cmd) == 2 and cmd[0] == b"SYSTEM" and cmd[1] == b"DIGEST":
             # served here (not in RepoSYSTEM.apply, which is sync):
             # the digest takes every DATA repo's lock in turn, which

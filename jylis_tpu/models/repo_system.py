@@ -26,7 +26,7 @@ SYSTEM_HELP = LeafHelp(
     "  SYSTEM METRICS\n"
     "  SYSTEM LATENCY\n"
     "  SYSTEM TRACE [count]\n"
-    "  SYSTEM DIGEST\n"
+    "  SYSTEM DIGEST [TYPES]\n"
     "  SYSTEM VERSION"
 )
 
@@ -69,6 +69,10 @@ class RepoSYSTEM:
         # computation (the async serving path intercepts SYSTEM DIGEST
         # in Database.apply_async instead — it must await repo locks)
         self.digest_fn = None
+        # ... and this to the per-type breakdown (SYSTEM DIGEST TYPES):
+        # [(name, 32-byte digest)] so operators localize divergence to a
+        # type before walking its digest-tree ranges
+        self.digest_types_fn = None
 
     def apply(self, resp, args: list[bytes]) -> bool:
         op = need(args, 0)
@@ -133,8 +137,16 @@ class RepoSYSTEM:
             return False
         if op == b"DIGEST":
             # single-threaded path only (warmup/tests/direct drives):
-            # the serving path's SYSTEM DIGEST is intercepted by
+            # the serving path's SYSTEM DIGEST [TYPES] is intercepted by
             # Database.apply_async, which awaits the repo locks
+            if len(args) > 1 and args[1] == b"TYPES":
+                if self.digest_types_fn is None:
+                    raise ParseError()
+                rows = self.digest_types_fn()
+                resp.array_start(len(rows))
+                for name, digest in rows:
+                    resp.string(f"{name} {digest.hex()}".encode())
+                return False
             if self.digest_fn is None:
                 raise ParseError()
             resp.string(self.digest_fn().hex().encode())

@@ -67,4 +67,8 @@ SEAMS = (
 GAUGES = (
     "cluster.converge_lag_ms",
     "cluster.backlog_ms",
+    # peers whose unacked delta gap fell off the retransmit window and
+    # are owed a range repair (schema v8 anti-entropy); pinned at 0 by
+    # the churn soak once every heal completes
+    "cluster.interval_dirty_peers",
 )

@@ -42,6 +42,13 @@ class Config:
     # seconds and the exponential-backoff ceiling in heartbeat ticks
     dial_timeout: float = 5.0
     dial_backoff_cap: int = 32
+    # extension: anti-entropy v2 tuning (cluster.py, schema v8) — the
+    # retransmit window (sequenced delta batches kept for per-peer
+    # ack-gap replay; a peer whose gap falls off is demoted to range
+    # repair) and the range-repair budget (digest-tree buckets pulled/
+    # served per round, the rejoin pacing knob)
+    delta_log_cap: int = 1024
+    range_budget: int = 64
     # extension: deterministic fault injection (faults.py); same syntax
     # as the JYLIS_FAILPOINTS env var, armed at startup
     failpoints: str = ""
@@ -140,18 +147,33 @@ def config_from_cli(argv: list[str] | None = None, log_out=None) -> Config:
         "cut and the old journal segment retired (docs/durability.md).",
     )
     parser.add_argument(
-        "--dial-timeout", type=float, default=5.0,
+        "--dial-timeout", type=float, default=Config.dial_timeout,
         help="Seconds before an outbound cluster dial attempt is "
         "abandoned (a blackholed peer would otherwise hang for the "
         "OS's minutes-long TCP timeout). Failed dials back off "
         "exponentially up to --dial-backoff-cap heartbeat ticks.",
     )
     parser.add_argument(
-        "--dial-backoff-cap", type=int, default=32,
+        "--dial-backoff-cap", type=int, default=Config.dial_backoff_cap,
         help="Ceiling, in heartbeat ticks, for the exponential re-dial "
         "backoff to an unreachable peer (deterministic jitter of up to "
         "half the backoff is added). Inbound contact from the address "
         "resets its backoff immediately.",
+    )
+    parser.add_argument(
+        "--delta-log-cap", type=int, default=Config.delta_log_cap,
+        help="Sequenced delta batches kept in the retransmit window for "
+        "per-peer ack-gap replay (schema v8 delta intervals). A peer "
+        "whose unacked gap falls off the window is marked "
+        "interval-dirty and demoted to Merkle-range repair — never a "
+        "whole-state dump (docs/replication.md).",
+    )
+    parser.add_argument(
+        "--range-budget", type=int, default=Config.range_budget,
+        help="Digest-tree buckets (of 256) pulled/served per "
+        "range-repair round: the rejoin pacing knob — smaller values "
+        "spread a big heal over more rounds so one rejoining node "
+        "cannot starve serving (docs/replication.md).",
     )
     parser.add_argument(
         "--failpoints", default="",
@@ -224,6 +246,8 @@ def config_from_cli(argv: list[str] | None = None, log_out=None) -> Config:
     config.journal_max_bytes = args.journal_max_bytes
     config.dial_timeout = args.dial_timeout
     config.dial_backoff_cap = args.dial_backoff_cap
+    config.delta_log_cap = args.delta_log_cap
+    config.range_budget = args.range_budget
     config.failpoints = args.failpoints
     config.metrics_port = args.metrics_port
     if args.lanes == "auto":

@@ -145,6 +145,18 @@ CLAIMS = [
     ("docs/operations.md", "concurrent-sharded", "vs_baseline", fmt_ratio,
      "lanes-vs-single-lane ratio of {} at 64 connections",
      "operations doc sharded ratio"),
+    # anti-entropy v2 round: the recorded rejoin-bytes ratio (range
+    # repair vs whole-state dump at ~5% range-local divergence on the
+    # 1M-key PNCOUNT store), pinned wherever the prose claims it
+    ("README.md", "sync-divergence", "vs_baseline", fmt_ratio,
+     "shipping {} fewer bytes", "README sync-divergence ratio"),
+    ("docs/replication.md", "sync-divergence", "vs_baseline", fmt_ratio,
+     "conversation at {} fewer bytes", "replication doc rejoin ratio"),
+    ("docs/operations.md", "sync-divergence", "vs_baseline", fmt_ratio,
+     "a rejoin at {} fewer bytes", "operations doc rejoin ratio"),
+    ("docs/replication.md", "sync-divergence", "divergent_frac",
+     lambda v: f"{v * 100:.2f}%",
+     "divergent keys measured at {}", "replication doc divergence frac"),
 ]
 
 

@@ -80,6 +80,12 @@ TAG_UNITS = {
     "_TAG_PUSH": "PushDeltas",
     "_TAG_SYNC_REQ": "SyncRequest",
     "_TAG_SYNC_DONE": "SyncDone",
+    # schema v8 (anti-entropy v2): delta intervals + Merkle-range repair
+    "_TAG_DELTA_ACK": "DeltaAck",
+    "_TAG_SEQ_PUSH": "SeqPush",
+    "_TAG_DIGEST_TREE": "DigestTree",
+    "_TAG_RANGE_REQ": "RangeRequest",
+    "_TAG_INTERVAL_RESET": "IntervalReset",
 }
 
 DELTA_TYPES = (
@@ -824,9 +830,14 @@ def build_corpus() -> dict:
     from jylis_tpu.cluster.framing import frame
     from jylis_tpu.cluster.msg import (
         MsgAnnounceAddrs,
+        MsgDeltaAck,
+        MsgDigestTree,
         MsgExchangeAddrs,
+        MsgIntervalReset,
         MsgPong,
         MsgPushDeltas,
+        MsgRangeRequest,
+        MsgSeqPush,
         MsgSyncDone,
         MsgSyncRequest,
     )
@@ -866,6 +877,19 @@ def build_corpus() -> dict:
         "msg/ExchangeAddrs": MsgExchangeAddrs(p2),
         "msg/AnnounceAddrs": MsgAnnounceAddrs(p2),
         "msg/SyncRequest": MsgSyncRequest((b"\x01" * 32, b"\x02" * 32)),
+        # schema v8 units, byte-pinned: cum/seq at varint edge values
+        # (127/128 straddle the LEB128 continuation bit), a sparse tree
+        # with first+last buckets, a budget-shaped range request, and
+        # the reset at a two-byte varint
+        "msg/DeltaAck": MsgDeltaAck(127),
+        "msg/SeqPush": MsgSeqPush(
+            128, "GCOUNT", ((b"k1", {1: 10, 2: 20}),)
+        ),
+        "msg/DigestTree": MsgDigestTree(
+            "PNCOUNT", ((0, b"\x03" * 32), (255, b"\x04" * 32))
+        ),
+        "msg/RangeRequest": MsgRangeRequest("PNCOUNT", (0, 64, 255)),
+        "msg/IntervalReset": MsgIntervalReset(300),
         "delta/TREG": MsgPushDeltas("TREG", ((b"k1", (b"v1", 7)),)),
         "delta/TLOG": MsgPushDeltas(
             "TLOG", ((b"k1", ([(b"e2", 9), (b"e1", 3)], 2)),)

@@ -70,7 +70,9 @@ PLACEHOLDER = "(describe this transition)"
 HANDLERS = {"role:active": "_active_msg", "role:passive": "_passive_msg"}
 SYNC_FUNCS = (
     "_maybe_request_sync", "_request_sync", "_serve_syncs",
-    "_data_frames", "_system_frames", "_stream_sync", "_send_frame",
+    "_chunk_frames", "_data_frames", "_range_frames", "_serve_ranges",
+    "_handle_tree", "_continue_ranges", "_force_range_repair",
+    "_track_seq", "_system_frames", "_stream_sync", "_send_frame",
 )
 DIAL_FUNCS = (
     "_heartbeat", "_sync_actives", "_dial", "_active_missed",
@@ -78,7 +80,8 @@ DIAL_FUNCS = (
 )
 RECV_FUNCS = ("_accept", "_read_loop")
 SEND_FUNCS = (
-    "broadcast_deltas", "_flush_held", "_send_to_actives", "_send",
+    "broadcast_deltas", "_log_delta", "_retransmit_unacked",
+    "_send_reset", "_flush_held", "_send_to_actives", "_send",
     "_broadcast_msg",
 )
 

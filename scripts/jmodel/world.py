@@ -152,6 +152,47 @@ class ModelDatabase:
     async def sync_type_digests_async(self) -> tuple[bytes, ...]:
         return (self._digest_g(), self._digest_t())
 
+    # ---- schema-v8 range tier (the real Database's digest-tree API) ----
+
+    @staticmethod
+    def _bucket(key: bytes) -> int:
+        # the product's sync_bucket (models/database.py): sha256(key)[0]
+        return hashlib.sha256(key).digest()[0]
+
+    def _key_hashes(self, name: str):
+        """(key, canonical per-key hash) pairs — converged replicas
+        produce identical pairs, so leaf digests compare across nodes
+        exactly like the real incremental tree."""
+        if name == "GCOUNT":
+            for k, rows in self.state.items():
+                if rows:
+                    yield k, hashlib.sha256(
+                        b"G\x00" + k + repr(sorted(rows.items())).encode()
+                    ).digest()
+        elif name == "TENSOR":
+            for k, t in self.state_t.items():
+                if t.mode != 0:
+                    yield k, hashlib.sha256(
+                        b"T\x00" + k + repr(t.canon()).encode()
+                    ).digest()
+
+    async def sync_tree_async(self, name: str) -> tuple:
+        leaves: dict[int, int] = {}
+        for key, h in self._key_hashes(name):
+            b = self._bucket(key)
+            leaves[b] = leaves.get(b, 0) ^ int.from_bytes(h, "big")
+        return tuple(
+            (b, v.to_bytes(32, "big"))
+            for b, v in sorted(leaves.items())
+            if v
+        )
+
+    async def dump_range_async(self, name: str, buckets) -> list:
+        bset = set(buckets)
+        dump = await self.dump_state_async(names=(name,))
+        batch = dump[0][1] if dump else []
+        return [(k, d) for k, d in batch if self._bucket(k) in bset]
+
     def _tensor_copy(self, t: Tensor) -> Tensor:
         out = Tensor()
         out.converge(t)
@@ -707,6 +748,46 @@ class World:
             stamps = [ts for ts, _ in c._held]
             if stamps != sorted(stamps):
                 raise Violation("held_fifo", f"{key}: held stamps {stamps}")
+            # delta-interval sender state (schema v8): the retransmit
+            # window is bounded and strictly seq-ordered, and no peer's
+            # acked watermark outruns the sender's own seq counter
+            if len(c._delta_log) > c._delta_log_cap:
+                raise Violation(
+                    "delta_log_bound",
+                    f"{key}: {len(c._delta_log)} logged > cap "
+                    f"{c._delta_log_cap}",
+                )
+            seqs = [s for s, _ in c._delta_log]
+            if seqs != sorted(seqs) or len(set(seqs)) != len(seqs):
+                raise Violation(
+                    "delta_log_order", f"{key}: window seqs {seqs}"
+                )
+            if seqs and seqs[-1] > c._delta_seq:
+                raise Violation(
+                    "delta_log_order",
+                    f"{key}: window head {seqs[-1]} > seq {c._delta_seq}",
+                )
+            for addr, st in c._peers.items():
+                if st.acked is not None and st.acked > c._delta_seq:
+                    raise Violation(
+                        "ack_bound",
+                        f"{key}->{addr}: acked {st.acked} > delta_seq "
+                        f"{c._delta_seq}",
+                    )
+            # receiver interval state: the out-of-order park is bounded
+            # and strictly above the contiguity cursor
+            for skey, ooo in c._recv_ooo.items():
+                if len(ooo) > cluster_mod.RECV_OOO_CAP:
+                    raise Violation(
+                        "ooo_bound", f"{key}<-{skey}: {len(ooo)} parked"
+                    )
+                cum = c._recv_cum.get(skey, 0)
+                if ooo and min(ooo) <= cum + 1:
+                    raise Violation(
+                        "ooo_order",
+                        f"{key}<-{skey}: parked {sorted(ooo)[:4]} at cum "
+                        f"{cum} (contiguous seqs must collapse)",
+                    )
             # dial backoff: bounded above by cap(+jitter), monotone
             # while failures accumulate (reset only by contact)
             for addr, st in c._peers.items():
@@ -809,6 +890,36 @@ class World:
                         f"{key}->{addr}: {len(conn.pong_sent)} stranded "
                         "rtt stamps after quiescence",
                     )
+                if conn.range_pending:
+                    raise Violation(
+                        "range_walk_done",
+                        f"{key}->{addr}: range walk stalled with "
+                        f"{sorted(conn.range_pending)} pending",
+                    )
+            # the v8 repair machinery fully drains at quiescence: no
+            # parked out-of-order seqs, no queued range serves, and no
+            # peer still owed a range repair (interval-dirty)
+            if any(c._recv_ooo.values()):
+                raise Violation(
+                    "ooo_drained",
+                    f"{key}: out-of-order seqs parked after quiescence",
+                )
+            if c._range_queue:
+                raise Violation(
+                    "range_queue_drained",
+                    f"{key}: {len(c._range_queue)} range serves queued",
+                )
+            for addr, st in sorted(
+                c._peers.items(), key=lambda kv: str(kv[0])
+            ):
+                if st.interval_dirty and str(addr) in {
+                    str(i.addr) for i in self.instances.values() if i.alive
+                }:
+                    raise Violation(
+                        "dirty_cleared",
+                        f"{key}->{addr}: still interval-dirty after "
+                        "quiescence (range repair never completed)",
+                    )
         for cid, conn in sorted(self.net.conns.items()):
             for direction in ("fwd", "rev"):
                 link = conn.link(direction)
@@ -884,6 +995,11 @@ class World:
                         # dedup-merges with a fresh one and the
                         # eviction subtree is never explored
                         self._rel(tick, c._last_activity.get(conn)),
+                        # the requester's range-walk cursor (v8)
+                        sorted(
+                            (n, tuple(b)) for n, b in
+                            conn.range_pending.items()
+                        ),
                     ]
                     for a, conn in sorted(
                         c._actives.items(), key=lambda kv: str(kv[0])
@@ -902,6 +1018,27 @@ class World:
                     )
                     if st.fails or st.next_dial_tick > tick
                 },
+                # delta-interval state (v8): the sender's seq counter +
+                # retransmit window, per-peer ack watermarks and dirty
+                # flags, and the receiver's per-sender cursors/parks —
+                # all protocol-relevant (a state differing only here
+                # behaves differently on the next reconnect)
+                "interval": [
+                    c._delta_seq,
+                    [[seq, self._sha(data)] for seq, data in c._delta_log],
+                    sorted(
+                        (str(a), st.acked, st.interval_dirty, st.reset_seq)
+                        for a, st in c._peers.items()
+                        if st.acked is not None or st.interval_dirty
+                    ),
+                    sorted(c._recv_cum.items()),
+                    sorted(
+                        (skey, tuple(sorted(ooo)))
+                        for skey, ooo in c._recv_ooo.items()
+                        if ooo
+                    ),
+                    len(c._range_queue),
+                ],
                 "held": [
                     [rank[ts], self._sha(data)] for ts, data in c._held
                 ],

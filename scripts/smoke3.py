@@ -113,8 +113,25 @@ def run_smoke(ports) -> None:
               == b'{"k":"v"}', f"UJSON on :{p}")
         until(deadline, lambda p=p: once(p, "TENSOR", "GET", "emb")
               == tensor_want, f"TENSOR on :{p}")
-    # the acceptance gate: converged replicas answer SYSTEM DIGEST with
-    # equal hex (covers TENSOR beside every other type)
+    # the acceptance gate, upgraded to the per-type breakdown (SYSTEM
+    # DIGEST TYPES): all three nodes must agree on EVERY type's digest
+    # line — a divergence is localized to its type in the failure
+    # output instead of one opaque combined hash
+    def digest_types_match() -> bool:
+        rows = [once(p, "SYSTEM", "DIGEST", "TYPES") for p in ports]
+        assert all(len(r) == len(rows[0]) for r in rows), rows
+        mismatched = [
+            tuple(bytes(line).split()[0] for line in r if line not in rows[0])
+            for r in rows[1:]
+        ]
+        assert all(not m for m in mismatched), (
+            f"per-type digest mismatch (diverged types: {mismatched})"
+        )
+        return True
+
+    until(deadline, digest_types_match,
+          "SYSTEM DIGEST TYPES match across all three nodes")
+    # the combined digest must agree with the per-type agreement
     until(
         deadline,
         lambda: len({bytes(once(p, "SYSTEM", "DIGEST")) for p in ports}) == 1,

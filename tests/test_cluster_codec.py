@@ -10,9 +10,14 @@ import pytest
 from jylis_tpu.cluster import codec, framing
 from jylis_tpu.cluster.msg import (
     MsgAnnounceAddrs,
+    MsgDeltaAck,
+    MsgDigestTree,
     MsgExchangeAddrs,
+    MsgIntervalReset,
     MsgPong,
     MsgPushDeltas,
+    MsgRangeRequest,
+    MsgSeqPush,
     MsgSyncDone,
 )
 from jylis_tpu.ops.p2set import P2Set
@@ -97,6 +102,82 @@ def test_push_deltas_ujson_roundtrip():
     assert gu.entries == u.entries
     assert gu.ctx.vv == u.ctx.vv
     assert gu.ctx.cloud == u.ctx.cloud
+
+
+# ---- schema v8 wire surface (anti-entropy v2) ------------------------------
+# Decoder robustness for the delta-interval + Merkle-range messages,
+# mirroring the discipline the transport frame gets below: round-trips
+# at the varint edge values and the full u64 range, truncation at every
+# byte refused as CodecError (never a crash or a mis-parse), and the
+# boundary payloads (empty tree, empty range) legal on the wire.
+
+U64_MAX = (1 << 64) - 1
+
+V8_MESSAGES = [
+    MsgDeltaAck(0),
+    MsgDeltaAck(127),       # LEB128 single-byte ceiling
+    MsgDeltaAck(128),       # first two-byte varint
+    MsgDeltaAck(U64_MAX),   # full u64 range rides the varint
+    MsgSeqPush(1, "GCOUNT", ((b"k", {1: 5}),)),
+    MsgSeqPush(U64_MAX, "TREG", ((b"k", (b"v", 9)), (b"j", (b"", 0)))),
+    MsgSeqPush(7, "PNCOUNT", ()),  # empty batch is legal (flush quirk)
+    MsgDigestTree("GCOUNT", ()),   # empty tree: responder holds no keys
+    MsgDigestTree("UJSON", ((0, b"\x05" * 32), (255, b"\x06" * 32))),
+    MsgDigestTree("TREG", tuple((i, bytes([i]) * 32) for i in range(256))),
+    MsgRangeRequest("TLOG", ()),   # empty range serves only the SyncDone
+    MsgRangeRequest("TENSOR", (0, 31, 255)),
+    MsgIntervalReset(0),
+    MsgIntervalReset(U64_MAX),
+]
+
+
+def test_v8_messages_roundtrip_both_paths():
+    for msg in V8_MESSAGES:
+        body = codec.encode(msg)
+        assert codec.decode(body) == msg, msg
+        # oracle and fast path must agree byte-for-byte and value-wise
+        assert codec._encode_oracle(msg) == body, msg
+        assert codec._decode_oracle(body) == msg, msg
+
+
+def test_v8_seq_push_matches_push_deltas_after_prefix():
+    """The schema pins msg7's name+batch bytes to msg3's after the
+    tag+seq prefix — the property the native fast-path wrapper relies
+    on. Byte-check it directly."""
+    batch = ((b"k1", {1: 10, 2: 20}), (b"k2", {7: 1}))
+    push = codec.encode(MsgPushDeltas("GCOUNT", batch))
+    seq_push = codec.encode(MsgSeqPush(5, "GCOUNT", batch))
+    assert seq_push[0] == 7 and seq_push[1] == 5
+    assert seq_push[2:] == push[1:]
+
+
+def test_v8_truncation_at_every_byte_is_codec_error():
+    for msg in V8_MESSAGES:
+        body = codec.encode(msg)
+        for i in range(len(body)):
+            try:
+                got = codec.decode(body[:i])
+            except codec.CodecError:
+                continue
+            # the empty-prefix case of a tag-only message decodes as
+            # nothing else; any other prefix success is a mis-frame
+            raise AssertionError(f"{msg}: prefix {i} decoded as {got}")
+
+
+def test_v8_trailing_bytes_are_codec_error():
+    for msg in V8_MESSAGES:
+        with pytest.raises(codec.CodecError):
+            codec.decode(codec.encode(msg) + b"\x00")
+
+
+def test_v8_negative_and_overlong_varints_refused():
+    # a varint continuing past the u64-sized reader bound must refuse,
+    # not spin or wrap (10 continuation bytes > any u64)
+    with pytest.raises(codec.CodecError):
+        codec.decode(bytes([6]) + b"\xff" * 10)
+    # a tree leaf length that claims more bytes than the frame carries
+    with pytest.raises(codec.CodecError):
+        codec.decode(bytes([8]) + b"\x04TREG\x01\x00\xff")
 
 
 def test_decode_rejects_garbage():

@@ -1,0 +1,511 @@
+"""Delta-interval replication (schema v8) pinning tests.
+
+The contract (docs/replication.md, "Efficient State-based CRDTs by
+Delta-Mutation", arXiv:1410.2803): every content-carrying delta batch
+is sequenced per sender and kept in a bounded retransmit window;
+receivers ack the cumulative contiguous seq; reconnection reships
+EXACTLY the unacked window — and when the window can no longer replay
+a peer's gap (cap eviction mid-partition), that peer is marked
+interval-dirty and demoted to Merkle-range repair via MsgIntervalReset,
+NEVER silently lost and NEVER a whole-state dump.
+"""
+
+import asyncio
+
+import pytest
+
+import jylis_tpu  # noqa: F401
+from jylis_tpu import faults
+from jylis_tpu.cluster import Cluster, codec
+from jylis_tpu.cluster import cluster as cluster_mod
+from jylis_tpu.cluster.cluster import _Conn, check_frame
+from jylis_tpu.cluster.framing import FrameReader
+from jylis_tpu.cluster.msg import (
+    MsgDeltaAck,
+    MsgIntervalReset,
+    MsgRangeRequest,
+    MsgSeqPush,
+    MsgSyncDone,
+)
+from jylis_tpu.utils.address import Address
+
+from test_cluster import TICK, Node, converge_wait, grab_ports, meshed, resp_call
+from test_held_queue import _SinkWriter, _batch, _pushed_keys, _solo_cluster
+
+
+def _msg_types(raw: bytes) -> list[str]:
+    """Decode a recorded write stream into message type names."""
+    frames = FrameReader()
+    frames.append(bytes(raw))
+    out = []
+    for body in frames:
+        checked = check_frame(body)
+        assert checked is not None
+        _origin_ms, payload = checked
+        out.append(type(codec.decode(payload)).__name__)
+    return out
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _attach_peer(cl, port="1", name="peer"):
+    """Established active conn + its _PeerState, like a healthy mesh."""
+    w = _SinkWriter()
+    addr = Address("127.0.0.1", port, name)
+    conn = _Conn(w, addr)
+    conn.established = True
+    cl._actives[addr] = conn
+    st = cl._peers[addr] = cluster_mod._PeerState()
+    return w, addr, conn, st
+
+
+def test_broadcasts_are_sequenced_and_logged():
+    cl = _solo_cluster()
+    w, addr, conn, st = _attach_peer(cl)
+    for key in (b"a", b"b", b"c"):
+        cl.broadcast_deltas(_batch(key))
+    assert cl._delta_seq == 3
+    assert [seq for seq, _ in cl._delta_log] == [1, 2, 3]
+    assert _pushed_keys(w.wrote) == [b"a", b"b", b"c"]
+    # keepalives (content-free SYSTEM) are NOT sequenced
+    cl.broadcast_deltas(("SYSTEM", [(b"_log", ([], 0))]))
+    assert cl._delta_seq == 3
+    assert len(cl._delta_log) == 3
+
+
+def test_reconnect_reships_exactly_the_unacked_window():
+    cl = _solo_cluster()
+    w, addr, conn, st = _attach_peer(cl)
+    for key in (b"a", b"b", b"c", b"d"):
+        cl.broadcast_deltas(_batch(key))
+    # the peer acked through seq 2, then its conn churned
+    st.acked = 2
+    w2 = _SinkWriter()
+    conn2 = _Conn(w2, addr)
+    conn2.established = True
+    cl._actives[addr] = conn2
+    cl._retransmit_unacked(conn2)
+    assert _pushed_keys(w2.wrote) == [b"c", b"d"]
+    assert cl._stats["deltas_reshipped"] == 2
+    # the reshipped frames are stamped for rtt (acks will pop them)
+    assert len(conn2.pong_sent) == 2
+
+
+def test_no_ack_history_means_no_replay():
+    """A brand-new peer bootstraps through the digest-tree sync; a
+    1024-frame replay of history it was never owed would be waste."""
+    cl = _solo_cluster()
+    w, addr, conn, st = _attach_peer(cl)
+    for key in (b"a", b"b"):
+        cl.broadcast_deltas(_batch(key))
+    st.acked = None
+    w2 = _SinkWriter()
+    conn2 = _Conn(w2, addr)
+    conn2.established = True
+    cl._actives[addr] = conn2
+    cl._retransmit_unacked(conn2)
+    assert w2.wrote == bytearray()
+    assert cl._stats["deltas_reshipped"] == 0
+
+
+def test_cap_eviction_marks_behind_peer_interval_dirty():
+    """The satellite fix: held-window loss used to be a counter + warn;
+    now cap eviction marks every behind peer dirty and announces the
+    demotion the moment the peer is reachable."""
+    cl = _solo_cluster()
+    cl._delta_log_cap = 2
+    w, addr, conn, st = _attach_peer(cl)
+    st.acked = 1  # the peer saw seq 1 only
+    for key in (b"a", b"b", b"c", b"d"):
+        cl.broadcast_deltas(_batch(key))
+    # window now holds [3, 4]; seqs 1-2 evicted past the peer's ack
+    assert [seq for seq, _ in cl._delta_log] == [3, 4]
+    assert st.interval_dirty
+    assert cl.metrics_totals()["interval_dirty_peers"] == 1
+    assert cl._stats["interval_resets_sent"] >= 1
+    # the reset demotes optimistically: watermark jumps to the current
+    # seq so retransmit never replays a window we declared unreplayable
+    assert st.acked == cl._delta_seq
+
+
+def test_gap_on_reconnect_sends_reset_not_partial_replay():
+    cl = _solo_cluster()
+    cl._delta_log_cap = 2
+    w, addr, conn, st = _attach_peer(cl)
+    for key in (b"a", b"b", b"c", b"d"):
+        cl.broadcast_deltas(_batch(key))
+    # peer acked 1, window starts at 3: the gap (seq 2) is unreplayable
+    st.acked = 1
+    w2 = _SinkWriter()
+    conn2 = _Conn(w2, addr)
+    conn2.established = True
+    cl._actives[addr] = conn2
+    cl._retransmit_unacked(conn2)
+    assert st.interval_dirty
+    assert cl._stats["interval_resets_sent"] == 1
+    # exactly one frame left: the IntervalReset, no partial replay
+    keys = _pushed_keys(w2.wrote)
+    assert keys == []
+
+
+def test_reestablishment_resends_a_possibly_lost_reset():
+    """A MsgIntervalReset lost in flight (conn died first, injected
+    send loss) must go out again on the next establishment even when no
+    new writes advanced delta_seq — the idempotence guard's own
+    bookkeeping (acked = reset_seq = delta_seq) would otherwise satisfy
+    itself forever and strand the peer on its stale cursor."""
+    cl = _solo_cluster()
+    cl._delta_log_cap = 2
+    w, addr, conn, st = _attach_peer(cl)
+    for key in (b"a", b"b", b"c", b"d"):
+        cl.broadcast_deltas(_batch(key))
+    st.acked = 1  # gap fell off the window -> reset on reconnect
+    w2 = _SinkWriter()
+    conn2 = _Conn(w2, addr)
+    conn2.established = True
+    cl._actives[addr] = conn2
+    cl._retransmit_unacked(conn2)
+    assert _msg_types(w2.wrote) == ["MsgIntervalReset"]
+    # the reset never arrived; the conn churns again with NO new writes
+    w3 = _SinkWriter()
+    conn3 = _Conn(w3, addr)
+    conn3.established = True
+    cl._actives[addr] = conn3
+    cl._retransmit_unacked(conn3)
+    assert _msg_types(w3.wrote) == ["MsgIntervalReset"]
+    assert cl._stats["interval_resets_sent"] == 2
+
+
+def test_oversized_replay_demotes_to_range_repair(monkeypatch):
+    """The reconnection replay writes synchronously (no drain between
+    frames): a window bigger than RETRANSMIT_BYTES_CAP must demote to
+    range repair via MsgIntervalReset instead of blowing through the
+    conn's write-buffer limit mid-replay and churning the redial."""
+    cl = _solo_cluster()
+    w, addr, conn, st = _attach_peer(cl)
+    for key in (b"a", b"b", b"c"):
+        cl.broadcast_deltas(_batch(key))
+    st.acked = 1  # two frames pending
+    monkeypatch.setattr(cluster_mod, "RETRANSMIT_BYTES_CAP", 1)
+    w2 = _SinkWriter()
+    conn2 = _Conn(w2, addr)
+    conn2.established = True
+    cl._actives[addr] = conn2
+    cl._retransmit_unacked(conn2)
+    assert st.interval_dirty
+    assert cl._stats["interval_resets_sent"] == 1
+    assert cl._stats["deltas_reshipped"] == 0
+    # exactly one frame went out: the reset, never a partial replay
+    assert _msg_types(w2.wrote) == ["MsgIntervalReset"]
+
+
+def test_replay_skips_frames_the_held_flush_will_ship():
+    """Frames still in the held queue reach a reconnecting peer through
+    the upcoming held flush (strict FIFO): replaying them from the
+    retransmit window too would ship every one twice and answer with
+    duplicate acks."""
+    cl = _solo_cluster()
+    w, addr, conn, st = _attach_peer(cl)
+    cl.broadcast_deltas(_batch(b"a"))
+    cl.broadcast_deltas(_batch(b"b"))
+    st.acked = 1  # peer acked a; b was sent but is still unacked
+    # peer churns away: subsequent writes are held AND window-logged
+    del cl._actives[addr]
+    cl.broadcast_deltas(_batch(b"c"))
+    cl.broadcast_deltas(_batch(b"d"))
+    assert len(cl._held) == 2
+    w2 = _SinkWriter()
+    conn2 = _Conn(w2, addr)
+    conn2.established = True
+    cl._actives[addr] = conn2
+    cl._retransmit_unacked(conn2)
+    # only the non-held gap (b) replays; c/d ride the held flush once
+    assert _pushed_keys(w2.wrote) == [b"b"]
+    assert cl._stats["deltas_reshipped"] == 1
+    cl._flush_held()
+    assert _pushed_keys(w2.wrote) == [b"b", b"c", b"d"]
+    assert not cl._held
+
+
+def test_one_outstanding_range_request_per_conn():
+    """The requester side of the repair budget: several mismatched
+    types' tree tasks finishing together must not each start their own
+    range stream — one MsgRangeRequest in flight per conn, the next
+    round pulled only by the closing MsgSyncDone."""
+    cl = _solo_cluster()
+    w, addr, conn, st = _attach_peer(cl)
+    conn.range_pending = {"GCOUNT": [0, 1], "PNCOUNT": [2]}
+    cl._continue_ranges(conn)
+    cl._continue_ranges(conn)  # a second tree task re-entering
+    assert _msg_types(w.wrote).count("MsgRangeRequest") == 1
+    # the round's SyncDone clears the flag and pulls the next type
+    asyncio.run(cl._active_msg(conn, MsgSyncDone()))
+    assert _msg_types(w.wrote).count("MsgRangeRequest") == 2
+    assert not conn.range_pending
+    # the walk is done: further SyncDones pull nothing
+    asyncio.run(cl._active_msg(conn, MsgSyncDone()))
+    assert _msg_types(w.wrote).count("MsgRangeRequest") == 2
+
+
+def test_range_request_beyond_budget_is_served_in_full():
+    """A requester with a bigger --range-budget than ours deletes the
+    whole request from its pending cursor the moment it sends: serving
+    only our budget's worth would strand the rest until the next
+    periodic digest exchange. Over-budget requests stream in
+    budget-sized sub-rounds, closed by exactly one MsgSyncDone."""
+    cl = _solo_cluster()
+    cl._range_budget = 2
+
+    async def main():
+        w = _SinkWriter()
+        conn = _Conn(w, None)
+        conn.established = True
+        conn.peer_addr = Address("127.0.0.1", "9", "req")
+        cl._passives.add(conn)
+        await cl._passive_msg(conn, MsgRangeRequest("GCOUNT", (0, 1, 2, 3, 4)))
+        for _ in range(200):
+            if not cl._range_serve_inflight and not cl._range_queue:
+                break
+            await asyncio.sleep(0.01)
+        assert not cl._range_queue
+        return w
+
+    w = asyncio.run(main())
+    assert cl._stats["ranges_served"] == 5
+    assert _msg_types(w.wrote).count("MsgSyncDone") == 1
+
+
+def test_receiver_tracks_contiguity_and_acks_cumulative():
+    cl = _solo_cluster()
+
+    async def main():
+        conn = _Conn(_SinkWriter(), None)
+        conn.established = True
+        conn.peer_addr = Address("127.0.0.1", "9", "sender")
+        cl._passives.add(conn)
+        skey = str(conn.peer_addr)
+        # first contact baselines at the observed seq
+        await cl._passive_msg(conn, MsgSeqPush(5, "GCOUNT", ()))
+        assert cl._recv_cum[skey] == 5
+        # contiguous advance
+        await cl._passive_msg(conn, MsgSeqPush(6, "GCOUNT", ()))
+        assert cl._recv_cum[skey] == 6
+        # a gap parks out of order; cum holds
+        await cl._passive_msg(conn, MsgSeqPush(8, "GCOUNT", ()))
+        assert cl._recv_cum[skey] == 6
+        assert cl._recv_ooo[skey] == {8}
+        # the retransmit fills the gap: park collapses
+        await cl._passive_msg(conn, MsgSeqPush(7, "GCOUNT", ()))
+        assert cl._recv_cum[skey] == 8
+        assert skey not in cl._recv_ooo
+        # a duplicate below cum re-states the ack, cursor unchanged
+        await cl._passive_msg(conn, MsgSeqPush(3, "GCOUNT", ()))
+        assert cl._recv_cum[skey] == 8
+
+    asyncio.run(main())
+
+
+def test_interval_reset_rebases_receiver_and_forces_repair():
+    cl = _solo_cluster()
+
+    async def main():
+        conn = _Conn(_SinkWriter(), None)
+        conn.established = True
+        conn.peer_addr = Address("127.0.0.1", "9", "sender")
+        cl._passives.add(conn)
+        skey = str(conn.peer_addr)
+        await cl._passive_msg(conn, MsgSeqPush(5, "GCOUNT", ()))
+        await cl._passive_msg(conn, MsgSeqPush(9, "GCOUNT", ()))
+        assert cl._recv_ooo[skey] == {9}
+        cl._sync_req_tick[conn.peer_addr] = cl._tick  # cooldown armed
+        await cl._passive_msg(conn, MsgIntervalReset(42))
+        assert cl._recv_cum[skey] == 42
+        assert skey not in cl._recv_ooo
+        # the cooldown toward the sender is cleared: next contact pulls
+        assert conn.peer_addr not in cl._sync_req_tick
+        assert cl._stats["interval_resets_recv"] == 1
+
+    asyncio.run(main())
+
+
+def test_stale_incarnation_ack_triggers_rebase_reset():
+    """A crash-rebooted sender restarts at seq 0 while receivers still
+    hold its old (higher) cursor: their acks outrun the new counter,
+    which must trigger a re-base reset — not a silently dead interval
+    tier."""
+    cl = _solo_cluster()
+    w, addr, conn, st = _attach_peer(cl)
+    cl.broadcast_deltas(_batch(b"a"))  # delta_seq == 1
+
+    async def main():
+        await cl._active_msg(conn, MsgDeltaAck(999))
+
+    asyncio.run(main())
+    assert cl._stats["interval_resets_sent"] == 1
+    assert st.acked == cl._delta_seq  # re-based, not adopted
+
+
+def test_blip_heals_by_retransmit_through_real_wire():
+    """End to end: pushes silently dropped on the wire (injected send
+    loss) heal on reconnection by exact retransmit — well inside one
+    sync period, with the reshipped count visible in CLUSTER metrics."""
+
+    async def main():
+        p_a, p_b = grab_ports(2)
+        a = Node("inta", p_a)
+        b = Node("intb", p_b, seeds=[a.config.addr])
+        await a.start()
+        await b.start()
+        try:
+            assert await converge_wait(lambda: meshed(a, b), ticks=60)
+            await asyncio.sleep(4 * TICK)  # establishment sync settles
+            # healthy sequenced write first: B acks it, so A holds real
+            # interval history for B (no ack history = no replay, by
+            # design — bootstrap covers that case instead)
+            assert await resp_call(a.server.port, b"GCOUNT INC warm 1\r\n")
+
+            def b_acked():
+                st = a.cluster._peers.get(b.config.addr)
+                return st is not None and st.acked is not None
+
+            assert await converge_wait(b_acked, ticks=60)
+            # arm silent send loss on EVERY outbound cluster write (the
+            # failpoint registry is process-global: a ~0.3 s two-way
+            # blackout where every frame "succeeds" without arriving),
+            # then write on A: B never sees the pushes, no acks advance
+            faults.arm("cluster.write", "drop", None, None)
+            for i in range(3):
+                got = await resp_call(
+                    a.server.port,
+                    b"GCOUNT INC lost%d 7\r\n" % i,
+                )
+                assert got == b"+OK\r\n"
+                await asyncio.sleep(2 * TICK)  # one flush window each
+            faults.disarm("cluster.write")
+
+            # force the conn churn that makes A re-establish and replay
+            for conn in list(a.cluster._actives.values()):
+                a.cluster._drop(conn)
+
+            async def b_has_all():
+                for i in range(3):
+                    out = await resp_call(
+                        b.server.port, b"GCOUNT GET lost%d\r\n" % i
+                    )
+                    if out != b":7\r\n":
+                        return False
+                return True
+
+            deadline = asyncio.get_event_loop().time() + 60 * TICK
+            ok = False
+            while asyncio.get_event_loop().time() < deadline:
+                if await b_has_all():
+                    ok = True
+                    break
+                await asyncio.sleep(TICK)
+            assert ok, "retransmit never healed the blip"
+            assert a.cluster._stats["deltas_reshipped"] >= 1
+        finally:
+            await a.stop()
+            await b.stop()
+
+    asyncio.run(main())
+
+
+def test_over_budget_partition_heals_range_repaired_never_full_dump():
+    """The satellite acceptance: a partition that outlives the
+    retransmit window must still end digest-matched — through the
+    interval-dirty -> range-repair ladder, with the legacy whole-state
+    dump counter pinned at ZERO on both nodes."""
+
+    async def main():
+        p_a, p_b = grab_ports(2)
+        a = Node("ovra", p_a)
+        b = Node("ovrb", p_b, seeds=[a.config.addr])
+        a.cluster._delta_log_cap = 4  # make the window overrunnable
+        await a.start()
+        await b.start()
+        try:
+            assert await converge_wait(lambda: meshed(a, b), ticks=60)
+            # healthy phase: one write replicates (B acks, so A holds
+            # real interval history for B)
+            assert await resp_call(a.server.port, b"GCOUNT INC warm 1\r\n")
+
+            async def b_warm():
+                out = await resp_call(b.server.port, b"GCOUNT GET warm\r\n")
+                return out == b":1\r\n"
+
+            deadline = asyncio.get_event_loop().time() + 60 * TICK
+            while asyncio.get_event_loop().time() < deadline:
+                if await b_warm():
+                    break
+                await asyncio.sleep(TICK)
+            assert await b_warm()
+
+            # partition: B's cluster stack goes away entirely
+            b.cluster.dispose()
+            await asyncio.sleep(2 * TICK)
+
+            # writes far past the 4-batch window, one flush each
+            for i in range(10):
+                got = await resp_call(
+                    a.server.port, b"GCOUNT INC part%d 3\r\n" % i
+                )
+                assert got == b"+OK\r\n", got
+                await asyncio.sleep(2 * TICK)
+
+            # the window overran B's watermark: B is interval-dirty
+            def b_dirty():
+                return (
+                    a.cluster.metrics_totals()["interval_dirty_peers"] >= 1
+                )
+
+            assert await converge_wait(b_dirty, ticks=80), (
+                a.cluster.metrics_totals()
+            )
+
+            # heal: B's cluster returns at the same address
+            b.cluster = Cluster(b.config, b.database)
+            await b.cluster.start()
+
+            async def digests_match():
+                da = await a.database.sync_digest_async()
+                db_ = await b.database.sync_digest_async()
+                return da == db_
+
+            deadline = asyncio.get_event_loop().time() + 200 * TICK
+            ok = False
+            while asyncio.get_event_loop().time() < deadline:
+                if await digests_match():
+                    ok = True
+                    break
+                await asyncio.sleep(TICK)
+            assert ok, "over-budget partition never digest-matched"
+            # the acceptance bar: the heal went interval -> range, and
+            # the legacy whole-state dump path NEVER fired
+            assert a.cluster._stats["sync_full_dumps"] == 0
+            assert b.cluster._stats["sync_full_dumps"] == 0
+            assert (
+                a.cluster._stats["ranges_served"] > 0
+                or b.cluster._stats["ranges_requested"] > 0
+            ), (a.cluster._stats, b.cluster._stats)
+
+            # ... and the dirty flag clears once B's pull digest-matches
+            def dirty_cleared():
+                return (
+                    a.cluster.metrics_totals()["interval_dirty_peers"] == 0
+                )
+
+            assert await converge_wait(
+                dirty_cleared, ticks=3 * cluster_mod.SYNC_PERIOD_TICKS
+            ), a.cluster.metrics_totals()
+        finally:
+            await a.stop()
+            await b.stop()
+
+    asyncio.run(main())

@@ -251,8 +251,11 @@ async def drill(site: str, action: str, tmp_path) -> None:
                 for n in nodes:
                     for conn in list(n.cluster._actives.values()):
                         n.cluster._drop(conn)
-            elif site == "cluster.sync_dump":
-                # a fresh rejoiner pulls a sync dump from the others
+            elif site in ("cluster.sync_dump", "sync.digest", "sync.range"):
+                # a fresh rejoiner's digest mismatch drives the v8 sync
+                # ladder: digest trees (sync.digest), budgeted range
+                # streams (sync.range), and the SYSTEM/SyncDone frames
+                # that still ride the dump seam (cluster.sync_dump)
                 await c.stop()
                 c = Node("sea", p_c, seeds=[a.config.addr])
                 await c.start()
@@ -442,6 +445,18 @@ SMOKE_CELLS = [
     ("journal.fsync", "error"),
 ]
 
+# partition-heal cells over the v8 sync seams (anti-entropy v2): each
+# cell kills/rejoins a node so the heal walks the range ladder THROUGH
+# the armed seam, asserts the seam FIRED, that the heal was RANGE
+# repair and not a whole-state dump, and (via the generic drill's
+# tail) that every node ends digest-matched
+SYNC_CELLS = [
+    ("sync.digest", "drop"),
+    ("sync.digest", "error"),
+    ("sync.range", "drop"),
+    ("sync.range", "error"),
+]
+
 # TENSOR action cells: {error, corrupt, crash} x one journal + one
 # cluster seam each — non-scalar binary payloads through the fault
 # classes most likely to mangle them (a corrupt cluster.write exercises
@@ -461,6 +476,53 @@ TENSOR_CELLS = [
 @pytest.mark.parametrize("site,action", SMOKE_CELLS)
 def test_chaos_smoke_cell(site, action, tmp_path):
     asyncio.run(drill(site, action, tmp_path))
+
+
+async def _drill_sync_cell(site, action, tmp_path):
+    """The generic drill plus the v8 partition-heal assertions: the
+    rejoin that fired the seam must have healed through the range tier
+    (ranges served, digest trees exchanged) with ZERO legacy whole-state
+    dumps anywhere."""
+    await drill(site, action, tmp_path)
+    # drill() tears its nodes down; the ladder assertions ride a fresh
+    # 3-node rejoin with the seam disarmed (post-heal behaviour)
+    p_a, p_b, p_c = grab_ports(3)
+    a = Node("aye", p_a)
+    b = Node("bee", p_b, seeds=[a.config.addr])
+    c = Node("sea", p_c, seeds=[a.config.addr])
+    await a.start()
+    await b.start()
+    await c.start()
+    nodes = [a, b, c]
+    try:
+        assert await converge_wait(lambda: meshed(a, b, c), ticks=200)
+        for i, n in enumerate(nodes):
+            await write_inc(n, b"cell", i + 1)
+        await wait_counts(nodes, b"cell", 6)
+        await c.stop()
+        c = Node("sea", p_c, seeds=[a.config.addr])
+        await c.start()
+        nodes[2] = c
+        await wait_counts(nodes, b"cell", 6)
+        await wait_digests_match(nodes)
+        served = sum(n.cluster._stats["ranges_served"] for n in nodes)
+        trees = sum(n.cluster._stats["sync_trees_sent"] for n in nodes)
+        dumps = sum(n.cluster._stats["sync_full_dumps"] for n in nodes)
+        assert trees > 0, "rejoin never exchanged a digest tree"
+        assert served > 0, "rejoin never range-repaired"
+        assert dumps == 0, f"legacy whole-state dump fired {dumps}x"
+    finally:
+        for n in nodes:
+            try:
+                await n.stop()
+            except Exception:
+                pass
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("site,action", SYNC_CELLS)
+def test_chaos_sync_cell(site, action, tmp_path):
+    asyncio.run(_drill_sync_cell(site, action, tmp_path))
 
 
 @pytest.mark.chaos

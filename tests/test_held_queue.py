@@ -41,12 +41,16 @@ class _SinkWriter:
     def write(self, data):
         self.wrote.extend(data)
 
+    async def drain(self):
+        pass  # the sync stream path drains between frames
+
     def close(self):
         self.closed = True
 
 
 def _pushed_keys(raw: bytes) -> list[bytes]:
-    """Decode a recorded write stream into MsgPushDeltas key lists."""
+    """Decode a recorded write stream into pushed key lists (MsgSeqPush
+    since schema v8; non-batch control frames are skipped)."""
     frames = FrameReader()
     frames.append(bytes(raw))
     out = []
@@ -55,7 +59,7 @@ def _pushed_keys(raw: bytes) -> list[bytes]:
         assert checked is not None
         _origin_ms, payload = checked
         msg = codec.decode(payload)
-        out.extend(key for key, _ in msg.batch)
+        out.extend(key for key, _ in getattr(msg, "batch", ()))
     return out
 
 

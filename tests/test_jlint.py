@@ -837,7 +837,12 @@ def test_real_native_surface_is_python_subset():
     # the oracle-only commands are exactly the declared deferrals
     manifest = json.load(open(jlint.MANIFEST_PATH))
     assert manifest["python_only"] == {
-        "SYSTEM": ["DIGEST", "GETLOG", "LATENCY", "METRICS", "TRACE", "VERSION"],
+        # TYPES is SYSTEM DIGEST TYPES' selector literal (the per-type
+        # digest breakdown), extracted as its own oracle-only word
+        "SYSTEM": [
+            "DIGEST", "GETLOG", "LATENCY", "METRICS", "TRACE", "TYPES",
+            "VERSION",
+        ],
         "TENSOR": ["GET", "MRG", "SET"],
         "TLOG": ["CLR", "TRIM", "TRIMAT"],
     }

@@ -149,6 +149,7 @@ def test_sync_replies_never_consume_rtt_stamps():
         try:
             conn = type("C", (), {})()
             conn.pong_sent = __import__("collections").deque([1.0, 2.0])
+            conn.range_pending = {}  # v8: SyncDone also steps range walks
             await solo.cluster._active_msg(conn, MsgSyncDone())
             assert list(conn.pong_sent) == [1.0, 2.0]
             count0 = solo.cluster._h_rtt.count

@@ -1,0 +1,347 @@
+"""16–32 node churn soak (nightly `make soak`): the anti-entropy v2
+acceptance run. A full in-process mesh — every Node a complete stack
+(System, Database, Server, Cluster) on real loopback TCP — driven
+through sustained writes × {kill, rejoin, partition, heal} churn, at a
+scale the repo never ran before this round (the previous ceiling was
+the 8-node churn test).
+
+What it pins, per the ISSUE-12 acceptance bar:
+
+* every node ends DIGEST-MATCHED (the combined per-type sync digest);
+* `converge_lag_ms` / `backlog_ms` stay bounded THROUGHOUT (sampled
+  every churn step, not just at the end: backlog under a flat bar; lag
+  bounded by elapsed wall time + slack — retransmitted frames carry
+  their TRUE original origin stamps, so a long partition's heal
+  legitimately reads as the partition's length — and decayed back
+  under 60 s once the churn stops);
+* ZERO legacy whole-state dumps: every heal rides the v8 ladder
+  (interval retransmit / digest-tree + range repair) — `sync_full_dumps`
+  is 0 on every node, and repair actually happened (`sync_trees_sent` /
+  `ranges_served` nonzero across the mesh);
+* `interval_dirty_peers` drains back to 0 once the churn stops (no peer
+  left permanently owed a repair).
+
+Partitions are injected at the dial seam (`Cluster(connect=...)` — the
+same seam jmodel uses) plus an abortive drop of the live conns between
+the partitioned groups, so a partition looks exactly like a real one:
+dials fail, established conns die, backoff engages, heal re-meshes.
+Kills are modelled as the cluster stack going away and a FRESH Cluster
+rejoining on the same Database later (the journal-replay-equivalent
+crash: acked local state survives, cluster state — acks, windows,
+cursors — starts cold, which is precisely the rejoin the ladder must
+heal without a dump).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+
+import pytest
+
+import jylis_tpu  # noqa: F401
+from jylis_tpu.cluster import Cluster
+from jylis_tpu.cluster.cluster import tcp_connect
+
+from test_cluster import TICK, Node, _CollectResp, grab_ports, resp_call
+
+# churn parameters, sized so the 16-node cell runs in a few minutes and
+# the 32-node cell stays inside the nightly budget
+ROUNDS = {16: 6, 32: 4}
+# the "bounded throughout" bars. converge_lag_ms reports TRUE delta
+# staleness — a retransmitted/held frame keeps its original origin
+# stamp, so a write delivered after a long partition legitimately reads
+# as that partition's length. "Bounded" therefore means: never more
+# than the wall time this run has existed (plus slack — anything past
+# that is the unstamped-origin / forged-stamp bug class), and DECAYED
+# back under a small bar once the churn stops (the EWMA must not pin).
+# backlog_ms has no such excuse: held/deferred work must never back up
+# past the flat bar.
+LAG_SLACK_MS = 120_000
+LAG_SETTLED_MS = 60_000
+BACKLOG_BOUND_MS = 120_000
+
+
+class ChurnNode(Node):
+    """A Node whose Cluster dials through a partition-aware seam."""
+
+    def __init__(self, name, port, seeds, world):
+        super().__init__(name, port, seeds)
+        self.world = world
+        self.cluster = Cluster(
+            self.config, self.database, connect=world.connect_fn(name)
+        )
+
+    def rebuild_cluster(self):
+        """The rejoin after a kill: a cold Cluster on the warm Database."""
+        self.cluster = Cluster(
+            self.config, self.database, connect=self.world.connect_fn(
+                self.config.addr.name
+            )
+        )
+
+
+class ChurnWorld:
+    """Partition bookkeeping shared by every node's dial seam."""
+
+    def __init__(self):
+        self.partitions: set[frozenset] = set()
+        self.addr_name: dict[str, str] = {}  # "host:port" -> node name
+
+    def register(self, node: ChurnNode):
+        a = node.config.addr
+        self.addr_name[f"{a.host}:{a.port}"] = a.name
+
+    def blocked(self, dialer: str, target: str) -> bool:
+        return frozenset((dialer, target)) in self.partitions
+
+    def connect_fn(self, dialer: str):
+        async def connect(addr):
+            target = self.addr_name.get(f"{addr.host}:{addr.port}")
+            if target is not None and self.blocked(dialer, target):
+                raise OSError(f"partitioned: {dialer} <-> {target}")
+            return await tcp_connect(addr)
+
+        return connect
+
+    def partition(self, nodes, a: ChurnNode, b: ChurnNode):
+        """Split a|b: future dials fail, live conns die abortively."""
+        na, nb = a.config.addr.name, b.config.addr.name
+        self.partitions.add(frozenset((na, nb)))
+        for x, other in ((a, b), (b, a)):
+            conn = x.cluster._actives.get(other.config.addr)
+            if conn is not None:
+                x.cluster._drop(conn)
+            for p in list(x.cluster._passives):
+                if p.peer_addr == other.config.addr:
+                    x.cluster._drop(p)
+
+    def heal_all(self):
+        self.partitions.clear()
+
+
+def _sample_gauges(nodes, worst, t0: float):
+    import time as _time
+
+    elapsed_ms = int((_time.time() - t0) * 1000)
+    for n in nodes:
+        if n.cluster._disposed:
+            continue
+        t = n.cluster.metrics_totals()
+        worst["lag"] = max(worst["lag"], t["converge_lag_ms"])
+        worst["backlog"] = max(worst["backlog"], t["backlog_ms"])
+    assert worst["lag"] < elapsed_ms + LAG_SLACK_MS, (worst, elapsed_ms)
+    assert worst["backlog"] < BACKLOG_BOUND_MS, worst
+
+
+async def _until(fn, what, ticks):
+    for _ in range(ticks):
+        if await fn():
+            return
+        await asyncio.sleep(TICK)
+    assert await fn(), what
+
+
+async def _resp_retry(port: int, payload: bytes, tries: int = 20) -> bytes:
+    """resp_call with retries: at 32 in-process nodes on a small CI
+    host a 2 s socket read can starve during mesh-formation bursts —
+    that is load, not a protocol failure, and the soak must not
+    conflate the two."""
+    last = None
+    for _ in range(tries):
+        try:
+            return await resp_call(port, payload)
+        except (OSError, asyncio.TimeoutError) as e:
+            last = e
+            await asyncio.sleep(4 * TICK)
+    raise AssertionError(f"resp probe never answered: {last!r}")
+
+
+@pytest.mark.soak
+@pytest.mark.slow  # nightly (`make soak`), not per-commit
+@pytest.mark.parametrize("n_nodes", (16, 32))
+def test_churn_scale_digest_matched_no_full_dumps(n_nodes):
+    rng = random.Random(1000 + n_nodes)
+
+    async def main():
+        ports = grab_ports(n_nodes)
+        world = ChurnWorld()
+        seed_addr = None
+        nodes: list[ChurnNode] = []
+        for i in range(n_nodes):
+            seeds = [seed_addr] if seed_addr is not None else []
+            n = ChurnNode("sc%02d" % i, ports[i], seeds, world)
+            world.register(n)
+            nodes.append(n)
+            if seed_addr is None:
+                seed_addr = n.config.addr
+        for n in nodes:
+            await n.start()
+        alive = {n.config.addr.name for n in nodes}
+        expected: dict[bytes, int] = {}
+        worst = {"lag": 0, "backlog": 0}
+        import time as _time
+
+        t0 = _time.time()
+        resp = _CollectResp()
+
+        def write(node: ChurnNode, key: bytes, amount: int):
+            node.database.manager("GCOUNT").apply(
+                resp, [b"GCOUNT", b"INC", key, b"%d" % amount]
+            )
+            expected[key] = expected.get(key, 0) + amount
+
+        try:
+            # mesh formation at scale: every alive node holds an
+            # established active to every other
+            async def meshed_all():
+                return all(
+                    sum(
+                        1
+                        for c in n.cluster._actives.values()
+                        if c.established
+                    )
+                    >= len(alive) - 1
+                    for n in nodes
+                    if n.config.addr.name in alive
+                )
+
+            # scale-aware deadlines: the 32-node mesh is ~1k conns — on
+            # a small CI host formation alone can take minutes
+            scale = n_nodes // 16
+            await _until(meshed_all, f"{n_nodes}-node mesh", 2400 * scale)
+
+            downed: list[ChurnNode] = []
+            for rnd in range(ROUNDS[n_nodes]):
+                live = [n for n in nodes if n.config.addr.name in alive]
+                # sustained writes: a spread of keys on a spread of nodes,
+                # a few through the real RESP socket for end-to-end cover
+                for j in range(8):
+                    node = rng.choice(live)
+                    write(node, b"sck%02d" % rng.randrange(24), j + 1)
+                sock_node = rng.choice(live)
+                got = await _resp_retry(
+                    sock_node.server.port, b"GCOUNT INC sock%d 1\r\n" % rnd
+                )
+                assert got == b"+OK\r\n"
+                expected[b"sock%d" % rnd] = (
+                    expected.get(b"sock%d" % rnd, 0) + 1
+                )
+
+                # churn: one partition pair + one kill OR one rejoin
+                if len(live) >= 2:
+                    pa, pb = rng.sample(live, 2)
+                    world.partition(nodes, pa, pb)
+                if downed and (rnd % 2 == 1):
+                    back = downed.pop()
+                    back.rebuild_cluster()
+                    await back.cluster.start()
+                    alive.add(back.config.addr.name)
+                elif len(live) > n_nodes // 2 + 1:
+                    victim = rng.choice(
+                        [n for n in live if n.config.addr.name != "sc00"]
+                    )
+                    victim.cluster.dispose()
+                    alive.discard(victim.config.addr.name)
+                    downed.append(victim)
+
+                # let the partition bite while writes keep flowing
+                for _ in range(6):
+                    live = [
+                        n for n in nodes if n.config.addr.name in alive
+                    ]
+                    write(
+                        rng.choice(live),
+                        b"sck%02d" % rng.randrange(24),
+                        1,
+                    )
+                    _sample_gauges(live, worst, t0)
+                    await asyncio.sleep(2 * TICK)
+                world.heal_all()
+                for _ in range(4):
+                    _sample_gauges(
+                        [n for n in nodes if n.config.addr.name in alive],
+                        worst,
+                        t0,
+                    )
+                    await asyncio.sleep(2 * TICK)
+
+            # final heal: everything rejoins, churn stops
+            world.heal_all()
+            for back in downed:
+                back.rebuild_cluster()
+                await back.cluster.start()
+                alive.add(back.config.addr.name)
+
+            async def digests_match():
+                digs = {
+                    (await n.database.sync_digest_async())
+                    for n in nodes
+                }
+                return len(digs) == 1
+
+            await _until(
+                digests_match, "post-churn digest match", 3000 * scale
+            )
+
+            # spot-check lattice totals (digest equality says replicas
+            # agree; this says they agree on the RIGHT state)
+            for key in (b"sck00", b"sck11", b"sock0"):
+                if key not in expected:
+                    continue
+                out = await _resp_retry(
+                    nodes[0].server.port,
+                    b"GCOUNT GET %s\r\n" % key,
+                )
+                assert out == b":%d\r\n" % expected[key], (key, out)
+
+            # the acceptance bars
+            dumps = sum(
+                n.cluster._stats["sync_full_dumps"] for n in nodes
+            )
+            trees = sum(
+                n.cluster._stats["sync_trees_sent"] for n in nodes
+            )
+            served = sum(
+                n.cluster._stats["ranges_served"] for n in nodes
+            )
+            reshipped = sum(
+                n.cluster._stats["deltas_reshipped"] for n in nodes
+            )
+            assert dumps == 0, f"whole-state dump fired {dumps}x under churn"
+            assert trees > 0, "no digest tree ever exchanged"
+            assert served > 0 or reshipped > 0, (
+                "churn healed with neither ranges nor retransmits?"
+            )
+
+            async def dirty_drained():
+                return all(
+                    n.cluster.metrics_totals()["interval_dirty_peers"] == 0
+                    for n in nodes
+                )
+
+            await _until(
+                dirty_drained, "interval-dirty peers drained", 3000 * scale
+            )
+
+            # bounded means SETTLED too: once churn stops and digests
+            # match, the lag EWMA must decay back under a small bar
+            # (digest-matched syncs fold zero-lag samples in; a pinned
+            # gauge would mean a peer never provably converged)
+            async def lag_settled():
+                return all(
+                    n.cluster.metrics_totals()["converge_lag_ms"]
+                    < LAG_SETTLED_MS
+                    for n in nodes
+                )
+
+            await _until(lag_settled, "converge_lag decayed", 3000 * scale)
+            assert worst["backlog"] < BACKLOG_BOUND_MS
+        finally:
+            for n in nodes:
+                try:
+                    await n.stop()
+                except Exception:
+                    pass
+
+    asyncio.run(main())

@@ -253,6 +253,44 @@ def test_digest_equal_across_nodes_and_backends():
     asyncio.run(main())
 
 
+def test_system_digest_types_localizes_divergence():
+    """SYSTEM DIGEST TYPES (the operator's divergence localizer): one
+    '<TYPE> <hex>' line per data type through the real serving path;
+    converged nodes agree line-for-line, and a single-type divergence
+    moves exactly that type's line."""
+
+    async def main():
+        pa = free_port()
+        a = Node("dgta", pa)
+        await a.start()
+        try:
+            out = await resp_call(a.server.port, b"SYSTEM DIGEST TYPES\r\n")
+            lines = [l for l in out.split(b"\r\n") if l and l[:1] not in b"*$"]
+            types = [l.split()[0] for l in lines]
+            assert types == [
+                b"TREG", b"TLOG", b"GCOUNT", b"PNCOUNT", b"UJSON", b"TENSOR"
+            ], lines
+            assert all(len(l.split()[1]) == 64 for l in lines), lines
+            before = dict(l.split() for l in lines)
+            got = await resp_call(a.server.port, b"GCOUNT INC k 7\r\n")
+            assert got == b"+OK\r\n"
+            out = await resp_call(a.server.port, b"SYSTEM DIGEST TYPES\r\n")
+            after = dict(
+                l.split()
+                for l in out.split(b"\r\n")
+                if l and l[:1] not in b"*$"
+            )
+            changed = [t for t in before if before[t] != after[t]]
+            assert changed == [b"GCOUNT"], changed
+            # the combined digest is the same fold the TYPES lines show
+            combined = await resp_call(a.server.port, b"SYSTEM DIGEST\r\n")
+            assert len(combined.strip().split(b"\r\n")[-1]) == 64
+        finally:
+            await a.stop()
+
+    asyncio.run(main())
+
+
 def test_periodic_digest_exchange_heals_silent_loss():
     """Round-5: deltas lost on the SENDER's churned outbound connection
     are invisible to the receiver — only the periodic digest exchange
@@ -553,21 +591,22 @@ def test_write_hot_behind_node_heals_from_mid_heal_responder(monkeypatch):
 
 
 def test_sync_streams_only_mismatched_types():
-    """Per-type digests (schema v4): a heal streams ONLY the data types
-    whose digests differ."""
+    """Per-type digests (schema v4; range-served since v8): a heal
+    range-repairs ONLY the data types whose digests differ — and never
+    takes the legacy whole-state dump path at all."""
 
     async def main():
         pa, pb = free_port(), free_port()
         a = Node("sela", pa)
         b = Node("selb", pb, seeds=[a.config.addr])
         streamed_types = []
-        orig = cluster_mod.Cluster._data_frames
+        orig = cluster_mod.Cluster._range_frames
 
-        def recording_frames(self, name):
+        def recording_frames(self, name, buckets):
             streamed_types.append(name)
-            return orig(self, name)
+            return orig(self, name, buckets)
 
-        cluster_mod.Cluster._data_frames = recording_frames
+        cluster_mod.Cluster._range_frames = recording_frames
         try:
             await a.start()
             await b.start()
@@ -623,10 +662,14 @@ def test_sync_streams_only_mismatched_types():
                     break
                 await asyncio.sleep(TICK)
             assert await healed(), "GCOUNT divergence never healed"
-            assert streamed_types, "no dump streamed at all"
+            assert streamed_types, "no range stream served at all"
             assert set(streamed_types) == {"GCOUNT"}, streamed_types
+            # v8 acceptance: a known-shape requester NEVER takes the
+            # legacy whole-state dump path
+            assert a.cluster._stats["sync_full_dumps"] == 0
+            assert b.cluster._stats["sync_full_dumps"] == 0
         finally:
-            cluster_mod.Cluster._data_frames = orig
+            cluster_mod.Cluster._range_frames = orig
             await a.stop()
             await b.stop()
 
