@@ -17,6 +17,11 @@ are encoded per data type (the wire shapes documented in each repo module):
     GCOUNT         {replica-id: u64}
     PNCOUNT        ({rid: u64}, {rid: u64})
     UJSON          dot-store entries + causal context (ops/ujson_host.py)
+    TENSOR         uniform 4-plane unit + AVG contribs (ops/tensor_host.py)
+    MAP            one FIELD unit (itype, ver, tomb, inner delta) under a
+                   packed (key, field) wire key — recursive (ops/compose.py)
+    BCOUNT         full escrow view (grants, incs, decs, xi, xd)
+                   (ops/bcount.py)
 
 A native C++ fast path for the MsgPushDeltas hot loop (the per-key delta
 packing on every anti-entropy broadcast/converge) lives in
@@ -32,6 +37,7 @@ from __future__ import annotations
 
 import hashlib
 
+from ..ops import compose
 from ..ops.p2set import P2Set
 from ..ops.tensor_host import Tensor
 from ..ops.ujson_host import UJSON
@@ -54,7 +60,7 @@ from .msg import (
     MsgSyncRequest,
 )
 
-SCHEMA_VERSION = 8
+SCHEMA_VERSION = 9
 
 # The canonical schema text: any change to the wire format MUST change this
 # string (bump SCHEMA_VERSION), which changes the signature, which makes
@@ -103,8 +109,27 @@ SCHEMA_VERSION = 8
 # them (a sender whose retransmit window evicted a receiver's gap
 # re-baselines that receiver and demotes it to range repair — never a
 # silent whole-state dump). msg7's name+batch encoding is byte-
-# identical to msg3 after the tag+seq prefix, so the native PushDeltas
-# fast path serves both.
+# identical to msg3 after the tag+seq prefix, so the native codec fast
+# path serves both.
+# v9: the composed types (ROADMAP item 4). Two new delta lines, the
+# SECOND delta-line change ever (so delta_signature() changes and the
+# v7/v8 delta digest joins the legacy acceptance — those files' frames
+# all still decode; v1-v6 remain covered by the older legacy entry).
+# delta/MAP is the first RECURSIVE unit: one FIELD of one map key —
+# the wire key is the packed (key, field) composite (klen:varint key
+# field), the unit is the field's product-lattice state (inner type
+# tag, per-replica edit counters, removal tombstone), and `val` is the
+# inner type's OWN delta encoding, one level deep (itype must be a
+# registered inner lattice: TREG, TLOG, GCOUNT, PNCOUNT — never MAP).
+# Decomposition means one field edit ships one unit, never the map,
+# and the digest tree / range-repair ladder operates per field.
+# delta/BCOUNT is the escrow counter's FULL per-key view (five
+# join-monotone components — grants/incs/decs and the two transfer
+# matrices); shipping the whole view keeps every state self-justifying
+# under join, which is what makes `0 <= value <= bound` hold on every
+# replica in every delivery schedule (ops/bcount.py). msg4's digest
+# order gains MAP,BCOUNT at the tail (positional vector, transport
+# level).
 _SCHEMA_TEXT = f"""jylis-tpu cluster schema v{SCHEMA_VERSION}
 varint=LEB128 bytes=varint-len-prefixed str=utf8-bytes
 wire=frame(crc32(origin_ms:u64be body):u32be origin_ms:u64be body)
@@ -115,7 +140,7 @@ msg0=Pong
 msg1=ExchangeAddrs(p2set)
 msg2=AnnounceAddrs(p2set)
 msg3=PushDeltas(name:str batch:[(key:bytes delta)])
-msg4=SyncRequest(digests:[bytes] order=TREG,TLOG,GCOUNT,PNCOUNT,UJSON,TENSOR)
+msg4=SyncRequest(digests:[bytes] order=TREG,TLOG,GCOUNT,PNCOUNT,UJSON,TENSOR,MAP,BCOUNT)
 msg5=SyncDone
 msg6=DeltaAck(cum:varint)
 msg7=SeqPush(seq:varint name:str batch:[(key:bytes delta)])
@@ -128,6 +153,8 @@ delta/GCOUNT=[(rid:varint v:varint)]
 delta/PNCOUNT=(gcount gcount)
 delta/UJSON=(entries:[(rid seq path:[str] token:str)] vv:[(rid seq)] cloud:[(rid seq)])
 delta/TENSOR=(mode:varint dim:varint val:bytes ts:bytes rid:bytes contribs:[(rid:varint ts:varint vec:bytes)])
+delta/MAP=(itype:str ver:[(rid:varint seq:varint)] tomb:[(rid:varint seq:varint)] val:delta/itype) key=(klen:varint key field) itype in TREG,TLOG,GCOUNT,PNCOUNT
+delta/BCOUNT=(grants:[(rid:varint v:varint)] incs:[(rid:varint v:varint)] decs:[(rid:varint v:varint)] xi:[(from:varint to:varint v:varint)] xd:[(from:varint to:varint v:varint)])
 """
 
 
@@ -233,20 +260,54 @@ delta/UJSON=(entries:[(rid seq path:[str] token:str)] vv:[(rid seq)] cloud:[(rid
 """
 
 
+# the v7/v8 window's schema (v8 touched only transport messages, so
+# both releases stamped ONE delta digest: v1-v6's lines plus
+# delta/TENSOR). Frozen verbatim like the other legacy texts so future
+# schema edits cannot silently change what those on-disk headers mean.
+_LEGACY_V8_TEXT = """jylis-tpu cluster schema v8
+varint=LEB128 bytes=varint-len-prefixed str=utf8-bytes
+wire=frame(crc32(origin_ms:u64be body):u32be origin_ms:u64be body)
+handshake=wire(sig:32B dialer-addr:addr?)
+addr=(host:str port:str name:str)
+p2set=(adds:[addr] removes:[addr])
+msg0=Pong
+msg1=ExchangeAddrs(p2set)
+msg2=AnnounceAddrs(p2set)
+msg3=PushDeltas(name:str batch:[(key:bytes delta)])
+msg4=SyncRequest(digests:[bytes] order=TREG,TLOG,GCOUNT,PNCOUNT,UJSON,TENSOR)
+msg5=SyncDone
+msg6=DeltaAck(cum:varint)
+msg7=SeqPush(seq:varint name:str batch:[(key:bytes delta)])
+msg8=DigestTree(name:str leaves:[(bucket:varint digest:bytes)] fanout=256 bucket=sha256(key)[0])
+msg9=RangeRequest(name:str buckets:[varint])
+msg10=IntervalReset(seq:varint)
+delta/TREG=(value:bytes ts:varint)
+delta/TLOG=delta/SYSTEM=(entries:[(value:bytes ts:varint)] cutoff:varint)
+delta/GCOUNT=[(rid:varint v:varint)]
+delta/PNCOUNT=(gcount gcount)
+delta/UJSON=(entries:[(rid seq path:[str] token:str)] vv:[(rid seq)] cloud:[(rid seq)])
+delta/TENSOR=(mode:varint dim:varint val:bytes ts:bytes rid:bytes contribs:[(rid:varint ts:varint vec:bytes)])
+"""
+
+
 def legacy_delta_signatures() -> tuple[bytes, ...]:
     """DELTA-schema digests of older releases whose frames this build
-    still decodes: the v1-v6 delta lines (unchanged across that whole
-    window) hash to one digest, stamped into every v4+ snapshot and
-    journal header on disk. v7 added delta/TENSOR — a pure extension,
-    so those files' frames all still decode; v8 touched only transport
-    messages, so v7 headers carry the CURRENT delta signature and need
-    no legacy entry."""
-    delta_lines = [
-        line
-        for line in _LEGACY_V6_TEXT.splitlines()
-        if line.startswith("delta/") or line.startswith("varint=")
-    ]
-    return (hashlib.sha256("\n".join(delta_lines).encode()).digest(),)
+    still decodes, stamped into v4+ snapshot and journal headers on
+    disk. Two windows: the v1-v6 delta lines (unchanged across that
+    whole span) hash to one digest, and the v7/v8 lines (v7 added
+    delta/TENSOR; v8 changed only transport messages) hash to another.
+    v9 added delta/MAP + delta/BCOUNT — pure extensions, so every
+    legacy file's frames still decode: they contain only old-type
+    units."""
+    out = []
+    for text in (_LEGACY_V6_TEXT, _LEGACY_V8_TEXT):
+        delta_lines = [
+            line
+            for line in text.splitlines()
+            if line.startswith("delta/") or line.startswith("varint=")
+        ]
+        out.append(hashlib.sha256("\n".join(delta_lines).encode()).digest())
+    return tuple(out)
 
 
 def legacy_snapshot_signatures() -> tuple[bytes, ...]:
@@ -439,6 +500,86 @@ def _r_tensor(r: _Reader) -> Tensor:
     return Tensor.from_wire(mode, dim, val, ts, rid, contribs)
 
 
+def _w_map(out: bytearray, unit: tuple) -> None:
+    # one FIELD's product-lattice unit (the v9 recursive shape): inner
+    # type tag, edit counters, tombstone, then the inner type's OWN
+    # delta encoding — branch-free (val is always present; the inner
+    # bottom is the join identity, so a tombstone-only unit ships it)
+    itype, ver, tomb, val = unit
+    if itype not in compose.REGISTRY:
+        raise CodecError(f"unregistered MAP value type: {itype}")
+    _w_str(out, itype)
+    _w_gcount_dict(out, ver)
+    _w_gcount_dict(out, tomb)
+    _w_delta(out, itype, val)
+
+
+_U64_MAX = (1 << 64) - 1
+
+
+def _r_u64_dict(r: _Reader) -> dict:
+    """A {rid: amount} span with BOTH sides bounded to u64: LEB128
+    admits ~2^70, and an oversized escrow amount or edit seq would be
+    journaled, then poison every arithmetic consumer on replay (the
+    TENSOR AVG-ts lesson)."""
+    d = _r_gcount_dict(r)
+    for rid, v in d.items():
+        if rid > _U64_MAX or v > _U64_MAX:
+            raise CodecError("rid or amount exceeds u64")
+    return d
+
+
+def _r_map(r: _Reader) -> tuple:
+    itype = r.str_()
+    if itype not in compose.REGISTRY:
+        raise CodecError(f"unregistered MAP value type: {itype}")
+    ver = _r_u64_dict(r)
+    tomb = _r_u64_dict(r)
+    val = _r_delta(r, itype)
+    return (itype, ver, tomb, val)
+
+
+def _w_xfer(out: bytearray, m: dict) -> None:
+    # a transfer matrix {(from, to): amount} as sorted triples
+    _w_varint(out, len(m))
+    for (f, t) in sorted(m):
+        _w_varint(out, f)
+        _w_varint(out, t)
+        _w_varint(out, m[(f, t)])
+
+
+def _r_xfer(r: _Reader) -> dict:
+    out: dict[tuple[int, int], int] = {}
+    for _ in range(r.varint()):
+        f = r.varint()
+        t = r.varint()
+        v = r.varint()
+        if f > _U64_MAX or t > _U64_MAX or v > _U64_MAX:
+            raise CodecError("rid or amount exceeds u64")
+        out[(f, t)] = v
+    return out
+
+
+def _w_bcount(out: bytearray, wire: tuple) -> None:
+    # the FULL per-key view, five join-monotone components (the
+    # self-justifying-state rule: funding evidence never lags a spend)
+    grants, incs, decs, xi, xd = wire
+    _w_gcount_dict(out, grants)
+    _w_gcount_dict(out, incs)
+    _w_gcount_dict(out, decs)
+    _w_xfer(out, xi)
+    _w_xfer(out, xd)
+
+
+def _r_bcount(r: _Reader) -> tuple:
+    grants = _r_u64_dict(r)
+    incs = _r_u64_dict(r)
+    decs = _r_u64_dict(r)
+    xi = _r_xfer(r)
+    xd = _r_xfer(r)
+    return (grants, incs, decs, xi, xd)
+
+
 def _w_delta(out: bytearray, name: str, delta) -> None:
     if name == "TREG":
         value, ts = delta
@@ -456,6 +597,10 @@ def _w_delta(out: bytearray, name: str, delta) -> None:
         _w_ujson(out, delta)
     elif name == "TENSOR":
         _w_tensor(out, delta)
+    elif name == "MAP":
+        _w_map(out, delta)
+    elif name == "BCOUNT":
+        _w_bcount(out, delta)
     else:
         raise CodecError(f"unknown data type: {name}")
 
@@ -473,6 +618,10 @@ def _r_delta(r: _Reader, name: str):
         return _r_ujson(r)
     if name == "TENSOR":
         return _r_tensor(r)
+    if name == "MAP":
+        return _r_map(r)
+    if name == "BCOUNT":
+        return _r_bcount(r)
     raise CodecError(f"unknown data type: {name}")
 
 

@@ -144,7 +144,7 @@ class MsgSyncRequest:
 
     digests: one 32-byte incremental digest per DATA type, in
     Database.DATA_TYPES order (TREG, TLOG, GCOUNT, PNCOUNT, UJSON,
-    TENSOR —
+    TENSOR, MAP, BCOUNT — models/database.py DATA_REPO_CLASSES —
     SYSTEM excluded: its log advances on connection events themselves,
     which would make two in-sync peers never match). Each is the XOR of
     sha256(canonical per-key state) over the type's keys."""

@@ -27,12 +27,34 @@ def sync_bucket(key: bytes) -> int:
     """The digest-tree leaf a key belongs to (stable across replicas)."""
     return hashlib.sha256(key).digest()[0]
 from .manager import RepoManager
+from .repo_bcount import RepoBCOUNT
 from .repo_counters import RepoGCOUNT, RepoPNCOUNT
+from .repo_map import RepoMAP
 from .repo_system import RepoSYSTEM
 from .repo_tensor import RepoTENSOR
 from .repo_treg import RepoTREG
 from .repo_tlog import RepoTLOG
 from .repo_ujson import RepoUJSON
+
+# THE data-type registry: every serving repo class, in the one fixed
+# order every replica shares (it is the SyncRequest digest-vector order
+# and the snapshot frame order). SYSTEM rides separately. Everything
+# that enumerates types — DATA_TYPES, the digest trees, SYSTEM DIGEST
+# TYPES, smoke3's per-type gate — derives from THIS tuple, so a new
+# type cannot silently fall out of a digest-match gate. New entries
+# append (the digest vector is positional across the wire).
+DATA_REPO_CLASSES = (
+    RepoTREG,
+    RepoTLOG,
+    RepoGCOUNT,
+    RepoPNCOUNT,
+    RepoUJSON,
+    RepoTENSOR,
+    RepoMAP,
+    RepoBCOUNT,
+)
+
+DATA_TYPE_NAMES = tuple(cls.name for cls in DATA_REPO_CLASSES)
 
 
 class Database:
@@ -66,15 +88,10 @@ class Database:
         self._served_py: dict[str, int] = {}
         self.system.served_fn = self._served_totals
         self.system.serving_fn = self.serving_totals
-        for repo in (
-            RepoTREG(identity, engine=self.native_engine),
-            RepoTLOG(identity, engine=self.native_engine),
-            RepoGCOUNT(identity, engine=self.native_engine),
-            RepoPNCOUNT(identity, engine=self.native_engine),
-            RepoUJSON(identity, engine=self.native_engine),
-            RepoTENSOR(identity, engine=self.native_engine),
-            self.system,
-        ):
+        for repo in tuple(
+            cls(identity, engine=self.native_engine)
+            for cls in DATA_REPO_CLASSES
+        ) + (self.system,):
             # timed_drain resolves the registry through this attribute,
             # so drain counters/histograms land per-Database
             repo.metrics = self.metrics
@@ -86,9 +103,9 @@ class Database:
         # a map of key -> sha256(canonical per-key state) and the running
         # XOR of those hashes. Updating costs O(keys dirty since the last
         # pass) — a reconnect never dumps the keyspace to compute 32 bytes.
-        self.DATA_TYPES = (
-            "TREG", "TLOG", "GCOUNT", "PNCOUNT", "UJSON", "TENSOR"
-        )
+        # Derived from the registry, never hand-listed: a new repo class
+        # lands in every digest surface automatically.
+        self.DATA_TYPES = DATA_TYPE_NAMES
         self._sync_hash: dict[str, dict[bytes, bytes]] = {
             n: {} for n in self.DATA_TYPES
         }

@@ -59,5 +59,7 @@ The following are valid data types (case sensitive):
   PNCOUNT - Positive/Negative Counter
   UJSON   - Unordered JSON (Nested Observed-Remove Maps and Sets)
   TENSOR  - Tensor Register (Per-Coordinate Convergent Merges)
+  MAP     - Composed Map (Fields Holding Any Registered Lattice)
+  BCOUNT  - Bounded Counter (Replica-Local Escrow, value <= bound)
   SYSTEM  - (miscellaneous system-level operations)
 """

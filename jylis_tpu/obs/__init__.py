@@ -53,6 +53,8 @@ SEAMS = (
     "drain.GCOUNT",
     "drain.PNCOUNT",
     "drain.TENSOR",
+    "drain.MAP",
+    "drain.BCOUNT",
     "server.native_burst",
     "server.py_dispatch",
     "journal.append",

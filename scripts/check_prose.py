@@ -157,6 +157,30 @@ CLAIMS = [
     ("docs/replication.md", "sync-divergence", "divergent_frac",
      lambda v: f"{v * 100:.2f}%",
      "divergent keys measured at {}", "replication doc divergence frac"),
+    # composed-types round (schema v9): the MAP decomposition record
+    # (hot-field-vs-whole-map ratio, the byte share against the 2%
+    # acceptance bar, the field-scoped range pull) and the BCOUNT
+    # contention record (end-to-end grants/sec, the local spend
+    # ceiling, the refusal rate), pinned wherever the prose claims them
+    ("docs/types/map.md", "map-hot-field", "value", fmt_ratio,
+     "ships {} fewer bytes", "map doc hot-field ratio"),
+    ("docs/types/map.md", "map-hot-field", "hot_field_pct",
+     lambda v: f"{v:.4f}%", "just {} of a whole-map ship",
+     "map doc hot-field byte share"),
+    ("docs/types/map.md", "map-hot-field", "range_pulled_fields", str,
+     "pulled only {} fields", "map doc range pull scope"),
+    ("README.md", "map-hot-field", "value", fmt_ratio,
+     "edit ships {} fewer bytes", "README map ratio"),
+    ("docs/types/bcount.md", "bcount-contention", "value",
+     lambda v: f"{v:.0f}", "sustains {} grants/sec end-to-end",
+     "bcount doc contention grants"),
+    ("docs/types/bcount.md", "bcount-contention", "local_grants_per_sec",
+     fmt_millions, "admits {} grants/sec with escrow in hand",
+     "bcount doc local spend ceiling"),
+    ("docs/types/bcount.md", "bcount-contention", "refusal_rate",
+     fmt_percent, "a {} refusal rate", "bcount doc refusal rate"),
+    ("README.md", "bcount-contention", "local_grants_per_sec", fmt_millions,
+     "escrow-checked spends at {} grants/sec", "README bcount rate"),
 ]
 
 

@@ -89,7 +89,8 @@ TAG_UNITS = {
 }
 
 DELTA_TYPES = (
-    "TREG", "TLOG", "SYSTEM", "GCOUNT", "PNCOUNT", "UJSON", "TENSOR"
+    "TREG", "TLOG", "SYSTEM", "GCOUNT", "PNCOUNT", "UJSON", "TENSOR",
+    "MAP", "BCOUNT",
 )
 
 _STRUCT_TOKENS = {"B": "u8", "H": "u16", "I": "u32", "Q": "u64", "i": "i32", "q": "i64"}
@@ -841,6 +842,7 @@ def build_corpus() -> dict:
         MsgSyncDone,
         MsgSyncRequest,
     )
+    from jylis_tpu.ops.compose import pack_field
     from jylis_tpu.ops.p2set import P2Set
     from jylis_tpu.ops.tensor_host import Tensor
     from jylis_tpu.ops.ujson_host import UJSON
@@ -903,6 +905,33 @@ def build_corpus() -> dict:
         ),
         "delta/UJSON": MsgPushDeltas("UJSON", ((b"k1", ujson_delta()),)),
         "delta/TENSOR": MsgPushDeltas("TENSOR", tensor_deltas()),
+        # v9 recursive MAP units: one key per registered inner lattice
+        # (content + tombstone evidence), plus a tombstone-only unit
+        # whose val is the inner bottom — every branch of the recursive
+        # shape byte-pins
+        "delta/MAP": MsgPushDeltas(
+            "MAP",
+            (
+                (pack_field(b"m1", b"ftreg"),
+                 ("TREG", {1: 2, 2: 1}, {1: 1}, (b"v", 7))),
+                (pack_field(b"m1", b"ftlog"),
+                 ("TLOG", {3: 1}, {}, (((b"e1", 9), (b"e0", 3)), 2))),
+                (pack_field(b"m2", b"fg"),
+                 ("GCOUNT", {1: 1}, {}, {1: 10, 2: 20})),
+                (pack_field(b"m2", b"fpn"),
+                 ("PNCOUNT", {2: 3}, {}, ({1: 10}, {2: 4}))),
+                (pack_field(b"m2", b"dead"),
+                 ("GCOUNT", {1: 1}, {1: 1}, {})),
+            ),
+        ),
+        # v9 escrow counter: the five-component full view with both
+        # transfer matrices populated (varint edges exercised by the
+        # 127/128 amounts)
+        "delta/BCOUNT": MsgPushDeltas(
+            "BCOUNT",
+            ((b"inv", ({1: 128}, {1: 127, 2: 4}, {2: 3},
+                       {(1, 2): 16}, {(2, 1): 5, (1, 3): 1})),),
+        ),
     }
     entries: dict[str, dict] = {}
     for name, msg in sorted(messages.items()):
@@ -927,7 +956,8 @@ def build_corpus() -> dict:
     # file/snapshot: header + one frame per data type (wire-delta dump)
     snap_blob = b"JYLSNAP1" + codec.delta_signature()
     for name in (
-        "TREG", "TLOG", "GCOUNT", "PNCOUNT", "UJSON", "TENSOR", "SYSTEM"
+        "TREG", "TLOG", "GCOUNT", "PNCOUNT", "UJSON", "TENSOR", "MAP",
+        "BCOUNT", "SYSTEM",
     ):
         key = "delta/" + name
         snap_blob += frame(codec._encode_oracle(messages[key]))

@@ -121,7 +121,13 @@ HARNESS_TYPES = {
     "PNCOUNT": {"lattice": "jylis_tpu.ops.hostref:PNCounter", "gen": "gen_pncount"},
     "UJSON": {"lattice": "jylis_tpu.ops.ujson_host:UJSON", "gen": "gen_ujson"},
     "TENSOR": {"lattice": "jylis_tpu.ops.tensor_host:Tensor", "gen": "gen_tensor"},
+    "BCOUNT": {"lattice": "jylis_tpu.ops.bcount:BCount", "gen": "gen_bcount"},
 }
+# MAP is NOT a static row: the rendered harness expands one MAP[inner]
+# row PER REGISTERED inner lattice at import time (ops/compose.REGISTRY),
+# so registering a new value type auto-generates its composed join laws
+# with no manifest edit. BCOUNT additionally carries the escrow-safety
+# law (random locally-checked histories never break 0 <= value <= bound).
 
 
 def _in_scope(rel: str) -> bool:
@@ -523,11 +529,18 @@ scripts/jlint/lattice_manifest.json — DO NOT EDIT BY HAND (jlint JL805
 fails on drift; edit the manifest/template in scripts/jlint/
 pass_lattice.py and regenerate).
 
-The dynamic half of the pass-8 lattice contract: for every one of the
-five CRDT lattices, the join must be commutative, associative, and
-idempotent over randomly generated delta states. Seeded RNG, no
-external property-testing dependency — hypothesis-style shrinking is
-traded for a fixed, replayable seed per case.
+The dynamic half of the pass-8 lattice contract: for every CRDT
+lattice — the flat types, the BCOUNT escrow counter, and the composed
+MAP instantiated over EVERY registered inner lattice
+(ops/compose.REGISTRY, expanded at import time so a newly registered
+value type auto-generates its composed join laws) — the join must be
+commutative, associative, and idempotent over randomly generated delta
+states. BCOUNT additionally carries the escrow-safety law: random
+concurrent histories in which every spend passed its replica's LOCAL
+rights check keep 0 <= value <= bound on every replica's view under
+every delivery order. Seeded RNG, no external property-testing
+dependency — hypothesis-style shrinking is traded for a fixed,
+replayable seed per case.
 """
 
 from __future__ import annotations
@@ -569,6 +582,10 @@ def _canon(x):
         # already representation-normal: packed canonical bytes + sorted
         # contribution tuples (tensor_host.Tensor.canon)
         return ("TS",) + x.canon()
+    if name == "BCount":
+        return ("BC",) + x.canon()
+    if name == "MapCRDT":
+        return ("MP",) + x.canon()
     # UJSON: entries + fully-compacted causal context
     x.ctx.compact()
     return (
@@ -676,9 +693,66 @@ def gen_tensor(rng, cls):
     return out
 
 
+def gen_bcount(rng, cls):
+    """Arbitrary monotone-component states: the JOIN laws hold for any
+    five pointwise-max components (the escrow-safety law below is what
+    needs history-consistent inputs, and generates its own)."""
+    b = cls()
+    for d in (b.grants, b.incs, b.decs):
+        for rid in rng.sample(range(1, 6), rng.randint(0, 3)):
+            d[rid] = rng.randint(1, 1000)
+    for m in (b.xi, b.xd):
+        for _ in range(rng.randint(0, 3)):
+            f, t = rng.randint(1, 5), rng.randint(1, 5)
+            if f != t:
+                m[(f, t)] = rng.randint(1, 100)
+    return b
+
+
+def _mk_gen_map(inner_name):
+    """A MAP generator specialised to one registered inner lattice:
+    random fields with random edit counters and tombstones over inner
+    states drawn from the REGISTRY's own generator — plus an occasional
+    cross-type field so the type-dominance rank is exercised."""
+    def gen(rng, cls):
+        from jylis_tpu.ops import compose
+        m = cls()
+        inner = compose.REGISTRY[inner_name]
+        for field in (b"f1", b"f2", b"f3")[: rng.randint(0, 3)]:
+            ver = {{
+                rid: rng.randint(1, 4)
+                for rid in rng.sample(range(1, 5), rng.randint(1, 2))
+            }}
+            tomb = (
+                {{rid: rng.randint(0, 5) for rid in sorted(ver)}}
+                if rng.random() < 0.4 else {{}}
+            )
+            m.converge_field(field, (inner_name, ver, tomb, inner.gen(rng)))
+        if rng.random() < 0.25:
+            other = rng.choice(sorted(compose.REGISTRY))
+            m.converge_field(
+                b"fx",
+                (other, {{1: rng.randint(1, 3)}}, {{}},
+                 compose.REGISTRY[other].gen(rng)),
+            )
+        return m
+    return gen
+
+
 LATTICES = [
 {type_rows}
 ]
+
+# the composed MAP, one row PER registered inner lattice: registering a
+# new value type in ops/compose.REGISTRY auto-generates its composed
+# join-law coverage here with no harness or manifest edit
+from jylis_tpu.ops import compose as _compose  # noqa: E402
+
+for _inner in sorted(_compose.REGISTRY):
+    LATTICES.append(
+        (f"MAP[{{_inner}}]", "jylis_tpu.ops.compose:MapCRDT",
+         _mk_gen_map(_inner))
+    )
 
 
 @pytest.mark.parametrize("name,path,gen", LATTICES, ids=[t[0] for t in LATTICES])
@@ -711,4 +785,42 @@ def test_join_idempotent(name, path, gen):
         b = gen(rng, cls)
         ab = _join(a, b)
         assert _canon(_join(ab, b)) == _canon(ab), (name, case)
+
+
+def test_bcount_escrow_safety():
+    """The BCOUNT escrow-safety law (ops/bcount.py): replay random
+    concurrent histories of grant/inc/dec/transfer over N replicas in
+    which every spend passes only its replica's LOCAL rights check,
+    deliver full-view states in arbitrary order, and require
+    0 <= value <= bound on EVERY replica's view after EVERY step. This
+    is the dynamic-law face of the invariant jmodel checks per explored
+    protocol state (scripts/jmodel/world.py)."""
+    from jylis_tpu.ops.bcount import BCount
+
+    for case in range(N_CASES):
+        rng = random.Random(f"{{SEED}}:BCOUNT:escrow:{{case}}")
+        n = rng.randint(2, 4)
+        states = [BCount() for _ in range(n)]
+        for step in range(rng.randint(5, 30)):
+            r = rng.randrange(n)
+            st = states[r]
+            roll = rng.random()
+            if roll < 0.15:
+                st.grant(r, rng.randint(1, 20))
+            elif roll < 0.40:
+                st.inc(r, rng.randint(1, 15))
+            elif roll < 0.65:
+                st.dec(r, rng.randint(1, 15))
+            elif roll < 0.80:
+                st.transfer(r, rng.randrange(n), rng.randint(1, 10),
+                            rng.choice(("INC", "DEC")))
+            else:
+                # anti-entropy: some replica's full view converges into
+                # another (any pair, any order — no causal delivery)
+                states[rng.randrange(n)].converge(
+                    copy.deepcopy(states[rng.randrange(n)])
+                )
+            for i, s in enumerate(states):
+                v, bound = s.value(), s.bound()
+                assert 0 <= v <= bound, (case, step, i, v, bound)
 '''

@@ -39,10 +39,11 @@ BUDGET_PATH = os.path.join(
 
 # committed smoke parameters (depth, quiesce-every): deep enough that
 # the three frontiers together clear the recorded model_min_states
-# floor (~17.5k distinct states on the recording host — the v8
-# anti-entropy machine grew the per-state surface; budget.json), shallow
-# enough for the per-commit budget. The soak tier (tests/test_model.py
-# -m soak) goes deeper on every axis.
+# floor (~56.8k distinct states on the recording host — the v9
+# composed-types actions (bdec/bxfer) grew the nodes2 frontier ~3x over
+# the v8-era 17.5k; budget.json), shallow enough for the per-commit
+# budget. The soak tier (tests/test_model.py -m soak) goes deeper on
+# every axis.
 SMOKE_PARAMS = {"nodes2": (6, 24), "nodes3": (4, 16), "lanes2": (4, 16)}
 
 COUNTEREXAMPLE_PATH = "jmodel_counterexample.json"

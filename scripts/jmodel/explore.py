@@ -78,7 +78,11 @@ def _touched(action: tuple) -> frozenset | None:
         target = rest.split("#", 1)[0]
         recv = target if direction == "fwd" else dialer
         return frozenset((_group_of(recv),))
-    if kind == "write":
+    if kind in ("write", "bdec"):
+        return frozenset((action[1],))
+    if kind == "bxfer":
+        # mutates only the SENDER's lattice (the receiver learns of the
+        # credit when the delta delivers, which is its own action)
         return frozenset((action[1],))
     return None  # kill / crash / part / heal
 
@@ -96,19 +100,25 @@ class Explorer:
         budgets: dict | None = None,
         quiesce_every: int = 16,
         max_states: int | None = None,
+        escrow_unsafe: bool = False,
     ):
         self.config = config
         self.depth = depth
         self.budgets = budgets
         self.quiesce_every = quiesce_every
         self.max_states = max_states
+        # arms the deliberately broken BCOUNT transfer rule (world.py):
+        # the exploration is then EXPECTED to find an invariant
+        # violation — the counterexample demonstration
+        self.escrow_unsafe = escrow_unsafe
         self.visited: set[str] = set()
         self.leaves = 0
         self.quiesced = 0
         self._runtime: Runtime | None = None
 
     def _replay(self, trace) -> World:
-        world = World(self.config, self.budgets, runtime=self._runtime)
+        world = World(self.config, self.budgets, runtime=self._runtime,
+                      escrow_unsafe=self.escrow_unsafe)
         try:
             for action in trace:
                 applied = world.apply(tuple(action))
@@ -133,11 +143,12 @@ class Explorer:
             }
             minimized = minimize(
                 self.config, f.trace, f.violation.name, self.budgets,
-                runtime=self._runtime,
+                runtime=self._runtime, escrow_unsafe=self.escrow_unsafe,
             )
             result.schedule = schedule_dict(
                 self.config, minimized, expect=f.violation.name,
-                note=f.violation.detail,
+                note=f.violation.detail, escrow_unsafe=self.escrow_unsafe,
+                budgets=self.budgets,
             )
         except _Done:
             result.capped = True
@@ -215,9 +226,10 @@ class Explorer:
 
 
 def schedule_dict(
-    config: str, actions, expect: str = "pass", note: str = ""
+    config: str, actions, expect: str = "pass", note: str = "",
+    escrow_unsafe: bool = False, budgets: dict | None = None,
 ) -> dict:
-    return {
+    out = {
         "schema": SCHEDULE_SCHEMA,
         "config": config,
         "actions": [list(a) for a in actions],
@@ -227,6 +239,16 @@ def schedule_dict(
         "expect": expect,
         "note": note,
     }
+    if escrow_unsafe:
+        # the schedule only fails against the deliberately broken
+        # escrow rule; the replayer must re-arm it
+        out["escrow_unsafe"] = True
+    if budgets:
+        # non-default budgets are part of the counterexample: without
+        # them a standalone replay silently skips now-disabled actions
+        # and degrades to a weaker test
+        out["budgets"] = dict(budgets)
+    return out
 
 
 def replay_schedule(
@@ -238,7 +260,9 @@ def replay_schedule(
     degrades to a weaker test, never a spurious failure."""
     if data.get("schema") != SCHEDULE_SCHEMA:
         raise ValueError(f"unknown schedule schema: {data.get('schema')!r}")
-    world = World(data["config"], budgets, runtime=runtime)
+    world = World(data["config"], budgets or data.get("budgets"),
+                  runtime=runtime,
+                  escrow_unsafe=bool(data.get("escrow_unsafe")))
     try:
         explicit_quiesce = False
         for raw in data["actions"]:
@@ -261,6 +285,7 @@ def replay_schedule(
 def minimize(
     config: str, trace: list, expect: str, budgets: dict | None = None,
     rounds: int = 4, runtime: Runtime | None = None,
+    escrow_unsafe: bool = False,
 ) -> list:
     """ddmin-lite over the action trace: greedily drop actions while
     replaying still hits the SAME invariant. Replays are cheap at
@@ -268,15 +293,14 @@ def minimize(
     the corpus replays forever."""
 
     def still_fails(candidate) -> bool:
-        v = replay_schedule(
-            {
-                "schema": SCHEDULE_SCHEMA,
-                "config": config,
-                "actions": [list(a) for a in candidate],
-            },
-            budgets,
-            runtime=runtime,
-        )
+        data = {
+            "schema": SCHEDULE_SCHEMA,
+            "config": config,
+            "actions": [list(a) for a in candidate],
+        }
+        if escrow_unsafe:
+            data["escrow_unsafe"] = True
+        v = replay_schedule(data, budgets, runtime=runtime)
         return v is not None and v.name == expect
 
     current = [tuple(a) for a in trace]

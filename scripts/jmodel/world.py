@@ -43,6 +43,8 @@ from jylis_tpu.cluster import cluster as cluster_mod
 from jylis_tpu.cluster.cluster import Cluster
 from jylis_tpu.lanes import wire_bridge
 from jylis_tpu.obs.registry import MetricsRegistry
+from jylis_tpu.ops import compose
+from jylis_tpu.ops.bcount import BCount
 from jylis_tpu.ops.tensor_host import Tensor, okey_u32
 from jylis_tpu.utils.address import Address
 from jylis_tpu.utils.config import Config
@@ -63,7 +65,29 @@ DEFAULT_BUDGETS = {
     "kills": 1,
     "crashes": 1,
     "partitions": 1,
+    # BCOUNT contention (schema v9): escrow-checked decrements per group
+    # and escrow transfers OUT of the seed-escrow group (global) — the
+    # schedules the `0 <= value <= bound` invariant must survive
+    "bdecs": 1,
+    "bxfers": 1,
 }
+
+# the modelled bounded counter: one key, bound granted (and matching
+# dec-escrow minted via incs) by the rid-1 replica's row — a CONVERGED
+# initial state every replica boots with, so the contended resource
+# exists before any schedule runs. Other replicas can decrement only
+# after an escrow transfer reaches them: exactly the interplay the
+# exploration must cover.
+BCOUNT_KEY = b"q"
+BCOUNT_SEED_RID = 1
+BCOUNT_BOUND = 2
+
+
+def _seed_bcount() -> BCount:
+    bc = BCount()
+    bc.grants[BCOUNT_SEED_RID] = BCOUNT_BOUND
+    bc.incs[BCOUNT_SEED_RID] = BCOUNT_BOUND
+    return bc
 
 
 class Violation(Exception):
@@ -89,21 +113,53 @@ class ModelDatabase:
     not (it heals back over the rejoin sync — the exact path worth
     exploring)."""
 
-    DATA_TYPES = ("GCOUNT", "TENSOR")
+    DATA_TYPES = ("GCOUNT", "TENSOR", "MAP", "BCOUNT")
 
-    def __init__(self, name: str, rid: int, journal=None):
+    def __init__(self, name: str, rid: int, journal=None,
+                 escrow_unsafe: bool = False):
         self.name = name
         self.rid = rid
+        self.escrow_unsafe = escrow_unsafe
         self.state: dict[bytes, dict[int, int]] = {}
         self.state_t: dict[bytes, Tensor] = {}
+        # MAP (schema v9): real compose.MapCRDT objects, keyed per map
+        # key; wire batches carry packed (key, field) composites exactly
+        # like the product. One write action edits a per-rid field (a
+        # deterministic function of the counter write, so the frontier
+        # grows no new axis and the WAL replay re-derives it).
+        self.state_m: dict[bytes, compose.MapCRDT] = {}
+        # BCOUNT (schema v9): real ops/bcount.BCount states; every
+        # replica boots with the SAME converged seed (the bound + the
+        # rid-1 escrow), so `0 <= value <= bound` is at stake from the
+        # first action
+        self.state_b: dict[bytes, BCount] = {BCOUNT_KEY: _seed_bcount()}
         self.pending: list[tuple[bytes, dict[int, int]]] = []
         self.pending_t: list[tuple[bytes, Tensor]] = []
-        self.journal: list[tuple[bytes, int]] = list(journal or ())
+        self.pending_m: list[tuple[bytes, tuple]] = []
+        self.pending_b: list[tuple[bytes, tuple]] = []
+        self.refused_decs = 0  # OUTOFBOUND analog: local-rights refusals
+        # WAL entries are tagged ops now that two kinds exist:
+        # ("w", key, n) counter writes (tensor + MAP edits re-derive),
+        # and ("bstate", wire) — the POST-MUTATION full per-key BCOUNT
+        # view, replayed by unconditional converge. This mirrors the
+        # product exactly: its journal stores the flushed full-view
+        # delta and its replay converges it back — replay NEVER re-runs
+        # a rights check (a journaled spend whose funding had arrived
+        # over the network before the crash must not vanish because the
+        # seed state alone cannot fund it; review fix).
+        self.journal: list[tuple] = list(journal or ())
         self.metrics = MetricsRegistry()
-        for key, n in self.journal:  # boot replay (both lattices)
-            rows = self.state.setdefault(key, {})
-            rows[self.rid] = max(rows.get(self.rid, 0), n)
-            self._tensor_join(key, self._tensor_delta(n))
+        for entry in self.journal:  # boot replay (all lattices)
+            if entry[0] == "w":
+                _, key, n = entry
+                rows = self.state.setdefault(key, {})
+                rows[self.rid] = max(rows.get(self.rid, 0), n)
+                self._tensor_join(key, self._tensor_delta(n))
+                self._map_edit(key, n)
+            elif entry[0] == "bstate":
+                self.state_b[BCOUNT_KEY].converge(
+                    BCount.from_wire(entry[1])
+                )
 
     def _tensor_delta(self, n: int) -> Tensor:
         # a function of (rid, counter value): replayable from the WAL
@@ -116,15 +172,57 @@ class ModelDatabase:
             self.state_t[key] = cur
         cur.converge(delta)
 
+    def _map_edit(self, key: bytes, n: int) -> tuple[bytes, tuple]:
+        """The MAP face of a counter write: bump a GCOUNT-valued field
+        owned by this rid in map key ``m``. Returns the decomposed
+        (packed composite, full field unit) delta entry."""
+        m = self.state_m.setdefault(b"m", compose.MapCRDT())
+        field = b"f%d" % self.rid
+        m.set_field(field, self.rid, "GCOUNT", [b"1"])
+        packed = compose.pack_field(b"m", field)
+        return (packed, m.fields[field].unit())
+
+    def _bcount_transfer(self, to_rid: int) -> bool:
+        """Move one unit of dec-escrow to another replica."""
+        return self.state_b[BCOUNT_KEY].transfer(self.rid, to_rid, 1, "DEC")
+
     def local_write(self, key: bytes = b"x") -> None:
         rows = self.state.setdefault(key, {})
         n = rows.get(self.rid, 0) + 1
         rows[self.rid] = n
-        self.journal.append((key, n))  # WAL before the network sees it
+        self.journal.append(("w", key, n))  # WAL before the network sees it
         self.pending.append((key, {self.rid: n}))
         t = self._tensor_delta(n)
         self._tensor_join(key, t)
         self.pending_t.append((key, t))
+        self.pending_m.append(self._map_edit(key, n))
+
+    def local_bdec(self) -> bool:
+        """One escrow-checked decrement; a refusal (insufficient local
+        dec-escrow — the RESP surface's OUTOFBOUND) changes no lattice
+        state and is counted. In escrow_unsafe mode the DELIBERATELY
+        BROKEN rule ships: the local rights check is skipped (the
+        canonical escrow bug — spending without owning the right), and
+        the explorer must surface it as a minimized `value < 0`
+        counterexample schedule."""
+        bc = self.state_b[BCOUNT_KEY]
+        if self.escrow_unsafe:
+            bc.decs[self.rid] = bc.decs.get(self.rid, 0) + 1
+        elif not bc.dec(self.rid, 1):
+            self.refused_decs += 1
+            return False
+        self.journal.append(("bstate", bc.to_wire()))
+        self.pending_b.append((BCOUNT_KEY, bc.to_wire()))
+        return True
+
+    def local_bxfer(self, to_rid: int) -> bool:
+        if not self._bcount_transfer(to_rid):
+            self.refused_decs += 1
+            return False
+        wire = self.state_b[BCOUNT_KEY].to_wire()
+        self.journal.append(("bstate", wire))
+        self.pending_b.append((BCOUNT_KEY, wire))
+        return True
 
     def _join(self, batch) -> None:
         for key, delta in batch:
@@ -140,6 +238,16 @@ class ModelDatabase:
         elif name == "TENSOR":
             for key, delta in batch:
                 self._tensor_join(bytes(key), delta)
+        elif name == "MAP":
+            for packed, unit in batch:
+                key, field = compose.unpack_field(bytes(packed))
+                self.state_m.setdefault(
+                    key, compose.MapCRDT()
+                ).converge_field(field, unit)
+        elif name == "BCOUNT":
+            for key, wire in batch:
+                bc = self.state_b.setdefault(bytes(key), BCount())
+                bc.converge(BCount.from_wire(wire))
 
     async def flush_deltas_async(self, fn) -> None:
         if self.pending:
@@ -148,9 +256,16 @@ class ModelDatabase:
         if self.pending_t:
             batch_t, self.pending_t = self.pending_t, []
             fn(("TENSOR", tuple(batch_t)))
+        if self.pending_m:
+            batch_m, self.pending_m = self.pending_m, []
+            fn(("MAP", tuple(batch_m)))
+        if self.pending_b:
+            batch_b, self.pending_b = self.pending_b, []
+            fn(("BCOUNT", tuple(batch_b)))
 
     async def sync_type_digests_async(self) -> tuple[bytes, ...]:
-        return (self._digest_g(), self._digest_t())
+        return (self._digest_g(), self._digest_t(), self._digest_m(),
+                self._digest_b())
 
     # ---- schema-v8 range tier (the real Database's digest-tree API) ----
 
@@ -174,6 +289,21 @@ class ModelDatabase:
                 if t.mode != 0:
                     yield k, hashlib.sha256(
                         b"T\x00" + k + repr(t.canon()).encode()
+                    ).digest()
+        elif name == "MAP":
+            # composite (key, field) leaves, exactly like the product's
+            # digest tree: range repair pulls divergent FIELDS
+            for k, m in self.state_m.items():
+                for field, f in m.fields.items():
+                    packed = compose.pack_field(k, field)
+                    yield packed, hashlib.sha256(
+                        b"M\x00" + packed + repr(f.canon()).encode()
+                    ).digest()
+        elif name == "BCOUNT":
+            for k, bc in self.state_b.items():
+                if not bc.is_bottom():
+                    yield k, hashlib.sha256(
+                        b"B\x00" + k + repr(bc.canon()).encode()
                     ).digest()
 
     async def sync_tree_async(self, name: str) -> tuple:
@@ -222,6 +352,29 @@ class ModelDatabase:
                         ],
                     )
                 )
+            elif n == "MAP":
+                out.append(
+                    (
+                        "MAP",
+                        [
+                            (compose.pack_field(k, field),
+                             m.fields[field].unit())
+                            for k, m in sorted(self.state_m.items())
+                            for field in sorted(m.fields)
+                        ],
+                    )
+                )
+            elif n == "BCOUNT":
+                out.append(
+                    (
+                        "BCOUNT",
+                        [
+                            (k, bc.to_wire())
+                            for k, bc in sorted(self.state_b.items())
+                            if not bc.is_bottom()
+                        ],
+                    )
+                )
             elif n == "SYSTEM":
                 out.append(("SYSTEM", []))
         return out
@@ -242,8 +395,25 @@ class ModelDatabase:
         )
         return hashlib.sha256(repr(canon).encode()).digest()
 
+    def _digest_m(self) -> bytes:
+        canon = sorted(
+            (k.hex(), m.canon()) for k, m in self.state_m.items()
+        )
+        return hashlib.sha256(repr(canon).encode()).digest()
+
+    def _digest_b(self) -> bytes:
+        canon = sorted(
+            (k.hex(), bc.canon())
+            for k, bc in self.state_b.items()
+            if not bc.is_bottom()
+        )
+        return hashlib.sha256(repr(canon).encode()).digest()
+
     def digest(self) -> bytes:
-        return hashlib.sha256(self._digest_g() + self._digest_t()).digest()
+        return hashlib.sha256(
+            self._digest_g() + self._digest_t() + self._digest_m()
+            + self._digest_b()
+        ).digest()
 
     def cells(self) -> dict[tuple, int]:
         """Per-cell monotonicity floor: counter cells AND tensor
@@ -264,6 +434,28 @@ class ModelDatabase:
             keys = okey_u32(np.frombuffer(t.val, "<u4"))
             for i, okey in enumerate(keys.tolist()):
                 out[("T", k, i)] = okey
+        # MAP: per-field edit counters, tombstone cells, and the inner
+        # GCOUNT columns are all monotone
+        for k, m in self.state_m.items():
+            for field, f in m.fields.items():
+                for rid, seq in f.ver.items():
+                    out[("Mv", k, field, rid)] = seq
+                for rid, seq in f.tomb.items():
+                    out[("Mt", k, field, rid)] = seq
+                if f.itype == "GCOUNT":
+                    for rid, v in f.val.items():
+                        out[("Mg", k, field, rid)] = v
+        # BCOUNT: every component cell is monotone (the join is
+        # pointwise max over all five)
+        for k, bc in self.state_b.items():
+            for tag, span in (
+                ("Bg", bc.grants), ("Bi", bc.incs), ("Bd", bc.decs),
+            ):
+                for rid, v in span.items():
+                    out[(tag, k, rid)] = v
+            for tag, mat in (("Bxi", bc.xi), ("Bxd", bc.xd)):
+                for (f_, t_), v in mat.items():
+                    out[(tag, k, f_, t_)] = v
         return out
 
 
@@ -378,6 +570,7 @@ class World:
         config_name: str,
         budgets: dict | None = None,
         runtime: Runtime | None = None,
+        escrow_unsafe: bool = False,
     ):
         if config_name not in CONFIG_NAMES:
             raise ValueError(f"unknown config {config_name!r}")
@@ -385,6 +578,11 @@ class World:
         self.budgets = dict(DEFAULT_BUDGETS)
         if budgets:
             self.budgets.update(budgets)
+        # escrow_unsafe arms ModelDatabase's deliberately broken
+        # transfer rule (no rights check, full-bound amount): the
+        # exploration MUST then find a schedule violating the bcount
+        # invariant — the counterexample demonstration in test_model.py
+        self.escrow_unsafe = escrow_unsafe
         self._owns_runtime = runtime is None
         self._runtime = runtime or Runtime()
         self.loop = self._runtime.loop
@@ -394,8 +592,13 @@ class World:
         self.instances: dict[str, Instance] = {}
         self.dbs: dict[str, ModelDatabase] = {}
         self._group_builders: dict[str, callable] = {}
-        self.used = {"dups": 0, "kills": 0, "crashes": 0, "partitions": 0}
+        self.used = {
+            "dups": 0, "kills": 0, "crashes": 0, "partitions": 0,
+            "bxfers": 0,
+        }
         self.writes_left: dict[str, int] = {}
+        self.bdecs_left: dict[str, int] = {}
+        self.group_rids: dict[str, int] = {}
         # invariant shadows: per-db lattice floor, per-(instance, addr)
         # last observed dial-backoff state
         self._floor: dict[str, dict] = {}
@@ -489,18 +692,22 @@ class World:
 
     def _node_group(self, name, addr, seeds, rid) -> None:
         def build(journal=None):
-            db = ModelDatabase(name, rid, journal)
+            db = ModelDatabase(name, rid, journal,
+                               escrow_unsafe=self.escrow_unsafe)
             self.dbs[name] = db
             self._spawn(name, name, addr, seeds, db)
 
         self._group_builders[name] = build
         self.writes_left[name] = self.budgets["writes"]
+        self.bdecs_left[name] = self.budgets["bdecs"]
+        self.group_rids[name] = rid
         build()
 
     def _lane_group(self, group, lane_id, n_addr, bus_addr, bus_seeds,
                     e_addr, rid) -> None:
         def build(journal=None):
-            db = ModelDatabase(group, rid, journal)
+            db = ModelDatabase(group, rid, journal,
+                               escrow_unsafe=self.escrow_unsafe)
             self.dbs[group] = db
             # main.py's exact wiring: every lane runs a bus instance
             # (lane 0's does not own the SYSTEM metrics section); lane 0
@@ -519,6 +726,8 @@ class World:
 
         self._group_builders[group] = build
         self.writes_left[group] = self.budgets["writes"]
+        self.bdecs_left[group] = self.budgets["bdecs"]
+        self.group_rids[group] = rid
         build()
 
     # ---- event-loop stepping ----------------------------------------------
@@ -580,11 +789,26 @@ class World:
         for group in self._groups():
             if self.writes_left.get(group, 0) > 0 and self._group_alive(group):
                 acts.append(("write", group))
+            if self.bdecs_left.get(group, 0) > 0 and self._group_alive(group):
+                acts.append(("bdec", group))
             if (
                 self.used["crashes"] < self.budgets["crashes"]
                 and self._group_alive(group)
             ):
                 acts.append(("crash", group))
+        # escrow transfers OUT of the seed-escrow group (the only group
+        # holding dec-rights before any transfer): the interplay the
+        # bcount invariant must survive — a transfer racing the sender's
+        # own decrements, delivered or lost against each receiver
+        if self.used["bxfers"] < self.budgets["bxfers"]:
+            for gfrom in self._groups():
+                if self.group_rids.get(gfrom) != BCOUNT_SEED_RID:
+                    continue
+                if not self._group_alive(gfrom):
+                    continue
+                for gto in self._groups():
+                    if gto != gfrom and self._group_alive(gto):
+                        acts.append(("bxfer", gfrom, gto))
         if self.config_name != "lanes2":
             groups = self._groups()
             for i, a in enumerate(groups):
@@ -636,6 +860,21 @@ class World:
                 and action[1] in self._group_builders
                 and self._group_alive(action[1])
             )
+        if kind == "bdec":
+            return (
+                self.bdecs_left.get(action[1], 0) > 0
+                and action[1] in self._group_builders
+                and self._group_alive(action[1])
+            )
+        if kind == "bxfer":
+            return (
+                self.used["bxfers"] < self.budgets["bxfers"]
+                and self.group_rids.get(action[1]) == BCOUNT_SEED_RID
+                and action[2] in self._group_builders
+                and action[1] != action[2]
+                and self._group_alive(action[1])
+                and self._group_alive(action[2])
+            )
         if kind == "crash":
             return (
                 action[1] in self._group_builders
@@ -680,6 +919,15 @@ class World:
         elif kind == "write":
             self.writes_left[action[1]] -= 1
             self._run(self.dbs[action[1]].local_write)
+        elif kind == "bdec":
+            self.bdecs_left[action[1]] -= 1
+            self._run(self.dbs[action[1]].local_bdec)
+        elif kind == "bxfer":
+            self.used["bxfers"] += 1
+            to_rid = self.group_rids[action[2]]
+            self._run(
+                lambda: self.dbs[action[1]].local_bxfer(to_rid)
+            )
         elif kind == "crash":
             self.used["crashes"] += 1
             self._crash_reboot(action[1])
@@ -735,6 +983,25 @@ class World:
                         f"{cells.get(cell, 0)}",
                     )
             self._floor[group] = cells
+            # BCOUNT escrow safety (schema v9): 0 <= value <= bound on
+            # EVERY replica's local view in EVERY reachable state — the
+            # invariant the escrow construction exists to enforce
+            # without coordination (ops/bcount.py). A deliberately
+            # broken escrow rule (World(escrow_unsafe=True)) must
+            # surface here as a minimized counterexample schedule.
+            for key, bc in db.state_b.items():
+                value, bound = bc.value(), bc.bound()
+                if value < 0:
+                    raise Violation(
+                        "bcount_negative",
+                        f"{group}: {key!r} value {value} < 0 "
+                        f"(decs outran the escrow that funded them)",
+                    )
+                if value > bound:
+                    raise Violation(
+                        "bcount_bound",
+                        f"{group}: {key!r} value {value} > bound {bound}",
+                    )
         for key, inst in self.instances.items():
             if not inst.alive:
                 continue
@@ -964,6 +1231,15 @@ class World:
                     (k.hex(), self._sha(repr(t.canon()).encode()))
                     for k, t in db.pending_t
                 ],
+                "pending_m": [
+                    (k.hex(), self._sha(repr(u).encode()))
+                    for k, u in db.pending_m
+                ],
+                "pending_b": [
+                    (k.hex(), self._sha(repr(w).encode()))
+                    for k, w in db.pending_b
+                ],
+                "refused": db.refused_decs,
                 "journal_len": len(db.journal),
             }
             for g, db in sorted(self.dbs.items())
@@ -1084,6 +1360,7 @@ class World:
             "partitions": sorted(sorted(p) for p in self.net.partitions),
             "used": sorted(self.used.items()),
             "writes_left": sorted(self.writes_left.items()),
+            "bdecs_left": sorted(self.bdecs_left.items()),
         }
 
     def state_hash(self) -> str:

@@ -73,7 +73,10 @@ def wait_up(ports, deadline) -> None:
               f"node on :{p} to come up")
 
 
-def run_smoke(ports) -> None:
+def run_smoke(ports, addrs=None) -> None:
+    """``addrs``: optional [(host, cluster_port, name)] triples — known
+    in --spawn mode, where they unlock the cross-node escrow-transfer
+    leg (replica ids derive from advertised addresses)."""
     deadline = time.time() + 180
     wait_up(ports, deadline)
 
@@ -90,6 +93,16 @@ def run_smoke(ports) -> None:
     assert once(ports[1], "TREG", "SET", "reg", "hello", 42) == b"OK"
     assert once(ports[2], "TLOG", "INS", "log", "entry", 7) == b"OK"
     assert once(ports[0], "UJSON", "SET", "doc", "k", '"v"') == b"OK"
+    # composed types (schema v9): MAP fields written on different nodes
+    # (decomposed per-field deltas converge them), one removed; BCOUNT
+    # escrow granted on node 0, transferred to node 2's replica, spent
+    # there — the bounded write requires the transfer to have converged
+    assert once(ports[0], "MAP", "TREG", "SET", "m", "fa", "va", 5) == b"OK"
+    assert once(ports[1], "MAP", "GCOUNT", "SET", "m", "fb", 4) == b"OK"
+    assert once(ports[2], "MAP", "TREG", "SET", "m", "dead", "x", 1) == b"OK"
+    assert once(ports[2], "MAP", "TREG", "DEL", "m", "dead") == b"OK"
+    assert once(ports[0], "BCOUNT", "GRANT", "inv", 9) == b"OK"
+    assert once(ports[0], "BCOUNT", "INC", "inv", 9) == b"OK"
     # TENSOR: two nodes write the same key; element-wise MAX must settle
     # both payloads' coordinate-wise maximum everywhere (binary-safe
     # bulk payloads over real sockets)
@@ -113,12 +126,47 @@ def run_smoke(ports) -> None:
               == b'{"k":"v"}', f"UJSON on :{p}")
         until(deadline, lambda p=p: once(p, "TENSOR", "GET", "emb")
               == tensor_want, f"TENSOR on :{p}")
+        until(deadline, lambda p=p: once(p, "MAP", "TREG", "GET", "m", "fa")
+              == [b"va", 5], f"MAP TREG field on :{p}")
+        until(deadline, lambda p=p: once(p, "MAP", "GCOUNT", "GET", "m", "fb")
+              == 4, f"MAP GCOUNT field on :{p}")
+        until(deadline,
+              lambda p=p: once(p, "MAP", "TREG", "GET", "m", "dead") is None,
+              f"MAP tombstone on :{p}")
+        until(deadline, lambda p=p: once(p, "BCOUNT", "GET", "inv")
+              == [9, 9], f"BCOUNT converged view on :{p}")
+    # escrow mobility across REAL nodes (spawn mode, where the cluster
+    # addresses — and so the advertised-address-derived replica ids —
+    # are known): node 0's replica hands dec-escrow to node 2's; the
+    # spend can only succeed after the transfer delta converges onto
+    # node 2, so the until() loop IS the end-to-end proof
+    want_value = 9
+    if addrs is not None:
+        from jylis_tpu.utils.address import Address
+
+        rid2 = Address(*addrs[2]).hash64()
+        assert once(ports[0], "BCOUNT", "TRANSFER", "inv", rid2, 3) == b"OK"
+        until(
+            deadline,
+            lambda: once(ports[2], "BCOUNT", "DEC", "inv", 3) == b"OK",
+            "transferred escrow to fund node 2's decrement",
+        )
+        want_value = 6
+    for p in ports:
+        until(deadline, lambda p=p: once(p, "BCOUNT", "GET", "inv")
+              == [want_value, 9], f"BCOUNT post-spend on :{p}")
+
     # the acceptance gate, upgraded to the per-type breakdown (SYSTEM
     # DIGEST TYPES): all three nodes must agree on EVERY type's digest
     # line — a divergence is localized to its type in the failure
-    # output instead of one opaque combined hash
+    # output instead of one opaque combined hash. The type list is read
+    # from the NODES (the registry's own enumeration), never hardcoded
+    # here: a future type cannot silently fall out of this gate.
     def digest_types_match() -> bool:
         rows = [once(p, "SYSTEM", "DIGEST", "TYPES") for p in ports]
+        types_seen = [bytes(line).split()[0] for line in rows[0]]
+        for required in (b"MAP", b"BCOUNT", b"GCOUNT", b"TENSOR"):
+            assert required in types_seen, (required, types_seen)
         assert all(len(r) == len(rows[0]) for r in rows), rows
         mismatched = [
             tuple(bytes(line).split()[0] for line in r if line not in rows[0])
@@ -162,7 +210,13 @@ def main() -> None:
                 if i > 0:
                     argv += ["--seed-addrs", seed]
                 procs.append(subprocess.Popen(argv, cwd=REPO))
-            run_smoke(ports)
+            run_smoke(
+                ports,
+                addrs=[
+                    ("127.0.0.1", str(cp), name)
+                    for cp, name in zip(cports, names)
+                ],
+            )
         finally:
             # terminate EVERY node even if one outlives its grace period:
             # a wedged first node must not leak the others (they hold the

@@ -180,6 +180,107 @@ def test_v8_negative_and_overlong_varints_refused():
         codec.decode(bytes([8]) + b"\x04TREG\x01\x00\xff")
 
 
+# ---- schema v9 wire surface (composed types) -------------------------------
+# The recursive MAP field unit and the BCOUNT full-escrow view get the
+# same decoder discipline as the v8 suite: round-trips over every inner
+# lattice and every boundary shape (empty map batch, tombstone-only
+# unit, inner-bottom values), truncation at EVERY byte refused as
+# CodecError, u64 bounds on escrow amounts and edit seqs enforced at
+# decode (LEB128 admits ~2^70; an oversized amount would journal, then
+# poison arithmetic on replay), and unregistered inner types refused.
+
+from jylis_tpu.ops.compose import pack_field  # noqa: E402
+
+
+def _v9_messages():
+    return [
+        # one key per registered inner lattice, content + mixed tombs
+        MsgPushDeltas("MAP", (
+            (pack_field(b"m", b"fr"), ("TREG", {1: 2}, {}, (b"v", 7))),
+            (pack_field(b"m", b"fl"),
+             ("TLOG", {2: 1}, {1: 1}, ([(b"e", 9)], 2))),
+            (pack_field(b"m", b"fg"), ("GCOUNT", {1: 1}, {}, {1: U64_MAX})),
+            (pack_field(b"m", b"fp"),
+             ("PNCOUNT", {3: 4}, {}, ({1: 10}, {2: 4}))),
+        )),
+        # tombstone-only unit: ver empty, val = inner bottom
+        MsgPushDeltas("MAP", (
+            (pack_field(b"m", b"dead"), ("TREG", {}, {1: 3}, (b"", 0))),
+            (pack_field(b"m", b"deadg"), ("GCOUNT", {}, {2: 1}, {})),
+        )),
+        MsgPushDeltas("MAP", ()),  # empty-map batch is legal
+        MsgPushDeltas("BCOUNT", (
+            (b"q", ({1: 128}, {1: 127, 2: 4}, {2: 3},
+                    {(1, 2): 16}, {(2, 1): 5})),
+        )),
+        MsgPushDeltas("BCOUNT", (
+            (b"edge", ({1: U64_MAX}, {}, {}, {}, {(1, 2): U64_MAX})),
+        )),
+        MsgPushDeltas("BCOUNT", ((b"empty", ({}, {}, {}, {}, {})),)),
+    ]
+
+
+def test_v9_composed_units_roundtrip():
+    for msg in _v9_messages():
+        body = codec.encode(msg)
+        assert codec.decode(body) == msg, msg
+        assert codec._encode_oracle(msg) == body, msg
+        assert codec._decode_oracle(body) == msg, msg
+
+
+def test_v9_truncation_at_every_byte_is_codec_error():
+    for msg in _v9_messages():
+        body = codec.encode(msg)
+        for i in range(len(body)):
+            try:
+                got = codec.decode(body[:i])
+            except codec.CodecError:
+                continue
+            raise AssertionError(f"{msg}: prefix {i} decoded as {got}")
+
+
+def test_v9_trailing_bytes_are_codec_error():
+    for msg in _v9_messages():
+        with pytest.raises(codec.CodecError):
+            codec.decode(codec.encode(msg) + b"\x00")
+
+
+def test_v9_escrow_amounts_bounded_to_u64():
+    """An amount or edit seq past u64 (legal LEB128, illegal lattice
+    value) must refuse at decode — never be journaled and poison the
+    arithmetic consumers on replay."""
+    over = U64_MAX + 1
+    cases = [
+        ("BCOUNT", (b"q", ({1: over}, {}, {}, {}, {}))),
+        ("BCOUNT", (b"q", ({}, {1: over}, {}, {}, {}))),
+        ("BCOUNT", (b"q", ({}, {}, {1: over}, {}, {}))),
+        ("BCOUNT", (b"q", ({}, {}, {}, {(1, 2): over}, {}))),
+        ("BCOUNT", (b"q", ({}, {}, {}, {}, {(over, 2): 1}))),
+        ("MAP", (pack_field(b"m", b"f"), ("TREG", {1: over}, {}, (b"", 0)))),
+        ("MAP", (pack_field(b"m", b"f"), ("TREG", {}, {1: over}, (b"", 0)))),
+    ]
+    for name, entry in cases:
+        # the writer is permissive (it never produces these); bound
+        # enforcement is the DECODER's contract
+        body = codec._encode_oracle(MsgPushDeltas(name, (entry,)))
+        with pytest.raises(codec.CodecError):
+            codec.decode(body)
+
+
+def test_v9_unregistered_inner_type_refused_both_ways():
+    unit = ("TREG", {1: 1}, {}, (b"v", 1))
+    good = codec.encode(MsgPushDeltas("MAP", ((b"\x01kf", unit),)))
+    # splice the itype string "TREG" -> "XREG": same lengths, unknown tag
+    bad = good.replace(b"\x04TREG", b"\x04XREG", 1)
+    with pytest.raises(codec.CodecError):
+        codec.decode(bad)
+    with pytest.raises(codec.CodecError):
+        codec.encode_delta("MAP", ("XREG", {}, {}, None))
+    # MAP itself is not a registered inner lattice: one level deep only
+    with pytest.raises(codec.CodecError):
+        codec.encode_delta("MAP", ("MAP", {}, {}, ("TREG", {}, {}, (b"", 0))))
+
+
 def test_decode_rejects_garbage():
     with pytest.raises(codec.CodecError):
         codec.decode(b"")

@@ -1,0 +1,412 @@
+"""Composed CRDTs (schema v9): MAP (lattice-of-lattices) and BCOUNT
+(bounded escrow counter) — semantics, RESP surface, decomposed-delta
+replication, digest/range behaviour, and journal crash-replay of
+nested deltas. The generated law harness (tests/test_lattice_laws.py)
+carries the join laws per registered inner type and the escrow-safety
+law; this file pins the SERVING-stack behaviour around them.
+"""
+
+import os
+
+import numpy as np  # noqa: F401
+
+import jylis_tpu  # noqa: F401
+import pytest
+
+from jylis_tpu import journal as journal_mod
+from jylis_tpu import persist
+from jylis_tpu.cluster import codec
+from jylis_tpu.cluster.msg import MsgPushDeltas
+from jylis_tpu.journal import Journal
+from jylis_tpu.models.database import DATA_TYPE_NAMES, Database, sync_bucket
+from jylis_tpu.ops import bcount, compose
+from jylis_tpu.server.resp import Respond
+
+from test_persist import Cap, call
+
+
+# one persistent outbox per Database, registered ONCE — the manager's
+# proactive flush emits into whatever sink is registered, so a fresh
+# lambda per pump would strand deltas in dead lists (production keeps
+# the broadcast sink for the node's lifetime)
+_OUTBOX: dict[int, list] = {}
+
+
+def mkdb(identity: int) -> Database:
+    db = Database(identity=identity, engine="python")
+    q: list = []
+    _OUTBOX[id(db)] = q
+    db.flush_deltas(q.append)
+    return db
+
+
+def drain(db: Database) -> list:
+    """Everything flushed since the last drain (explicit + proactive)."""
+    q = _OUTBOX[id(db)]
+    db.flush_deltas(q.append)
+    out, q[:] = list(q), []
+    return out
+
+
+def broadcast(src: Database, *dsts: Database) -> None:
+    """Flush src's deltas into every dst (the anti-entropy path, codec
+    round-tripped so the wire shape is what actually converges)."""
+    for name, batch in drain(src):
+        body = codec.encode(MsgPushDeltas(name, tuple(batch)))
+        msg = codec.decode(body)
+        for dst in dsts:
+            dst.converge_deltas((msg.name, list(msg.batch)))
+
+
+def pump(src: Database, dst: Database) -> None:
+    broadcast(src, dst)
+
+
+# ---- registry / packing ----------------------------------------------------
+
+
+def test_registry_covers_four_inner_lattices():
+    assert sorted(compose.REGISTRY) == ["GCOUNT", "PNCOUNT", "TLOG", "TREG"]
+
+
+def test_pack_field_roundtrips_and_rejects_garbage():
+    for key, field in [(b"", b""), (b"k", b"f"), (b"a" * 300, b"b" * 7),
+                       (b"\x00\xff", b"\x80")]:
+        assert compose.unpack_field(compose.pack_field(key, field)) == (
+            key, field
+        )
+    with pytest.raises(ValueError):
+        compose.unpack_field(b"")
+    with pytest.raises(ValueError):
+        compose.unpack_field(b"\x85")  # truncated varint
+    with pytest.raises(ValueError):
+        compose.unpack_field(b"\x05ab")  # length past the buffer
+
+
+# ---- MAP semantics ---------------------------------------------------------
+
+
+def test_map_one_field_edit_ships_one_field_not_the_map():
+    db = mkdb(1)
+    for i in range(64):
+        call(db, "MAP", "GCOUNT", "SET", "m", f"f{i}", "1")
+    drain(db)  # clear the initial dirt
+    call(db, "MAP", "GCOUNT", "SET", "m", "f3", "1")
+    maps = [b for n, b in drain(db) if n == "MAP"]
+    assert len(maps) == 1 and len(maps[0]) == 1
+    key, unit = maps[0][0]
+    assert compose.unpack_field(key) == (b"m", b"f3")
+    assert unit[0] == "GCOUNT" and unit[3] == {1: 2}
+
+
+def test_map_del_is_observed_remove_add_wins():
+    a, b = mkdb(1), mkdb(2)
+    call(a, "MAP", "TREG", "SET", "m", "f", "hello", "1")
+    pump(a, b)
+    # concurrent: a removes, b edits — neither has seen the other
+    call(a, "MAP", "TREG", "DEL", "m", "f")
+    call(b, "MAP", "TREG", "SET", "m", "f", "world", "9")
+    pump(a, b)
+    pump(b, a)
+    for db in (a, b):
+        assert call(db, "MAP", "TREG", "GET", "m", "f") == (
+            b"*2\r\n$5\r\nworld\r\n:9\r\n"
+        )
+    assert a._sync_digest_blocking() == b._sync_digest_blocking()
+    # a covering DEL (after seeing every edit) removes it everywhere
+    call(b, "MAP", "TREG", "DEL", "m", "f")
+    pump(b, a)
+    for db in (a, b):
+        assert call(db, "MAP", "TREG", "GET", "m", "f") == b"$-1\r\n"
+        assert call(db, "MAP", "TREG", "KEYS", "m") == b"*0\r\n"
+    assert a._sync_digest_blocking() == b._sync_digest_blocking()
+
+
+def test_map_set_after_del_resumes_from_retained_content():
+    """Removal hides; the inner content keeps converging under the
+    tombstone (content-GC is exactly what breaks associativity — see
+    ops/compose.py). A re-SET therefore resumes from the retained
+    state: documented composition semantics."""
+    db = mkdb(1)
+    call(db, "MAP", "GCOUNT", "SET", "m", "f", "5")
+    call(db, "MAP", "GCOUNT", "DEL", "m", "f")
+    assert call(db, "MAP", "GCOUNT", "GET", "m", "f") == b"$-1\r\n"
+    call(db, "MAP", "GCOUNT", "SET", "m", "f", "3")
+    assert call(db, "MAP", "GCOUNT", "GET", "m", "f") == b":8\r\n"
+
+
+def test_map_type_dominance_is_deterministic_everywhere():
+    """Two replicas concurrently claim one field with different inner
+    types: the lexicographically greater type name wins wholesale on
+    BOTH, so they converge (misconfiguration degrades deterministically,
+    never divergently)."""
+    a, b = mkdb(1), mkdb(2)
+    call(a, "MAP", "GCOUNT", "SET", "m", "f", "9")
+    call(b, "MAP", "TREG", "SET", "m", "f", "v", "1")
+    pump(a, b)
+    pump(b, a)
+    for db in (a, b):  # TREG > GCOUNT lexicographically
+        assert call(db, "MAP", "TREG", "GET", "m", "f") == (
+            b"*2\r\n$1\r\nv\r\n:1\r\n"
+        )
+        assert call(db, "MAP", "GCOUNT", "GET", "m", "f") == b"$-1\r\n"
+    assert a._sync_digest_blocking() == b._sync_digest_blocking()
+
+
+def test_map_unknown_type_and_bad_args_render_help():
+    db = mkdb(1)
+    out = call(db, "MAP", "NOPE", "SET", "m", "f", "1")
+    assert out.startswith(b"-BADCOMMAND")
+    out = call(db, "MAP", "TREG", "SET", "m", "f", "v")  # missing ts
+    assert out.startswith(b"-BADCOMMAND")
+    out = call(db, "MAP", "GCOUNT", "SET", "m", "f", "x")  # non-numeric
+    assert out.startswith(b"-BADCOMMAND")
+
+
+def test_map_digest_leaves_are_per_field_and_range_pull_is_field_scoped():
+    """The digest tree hashes packed (key, field) composites: two
+    replicas diverging in ONE field of a many-field map disagree in
+    exactly the buckets holding that field, and the range dump for
+    those buckets carries only their fields — never the whole map."""
+    import asyncio
+
+    a, b = mkdb(1), mkdb(2)
+    for i in range(200):
+        call(a, "MAP", "GCOUNT", "SET", "m", f"f{i}", "1")
+    pump(a, b)
+    assert a._sync_digest_blocking() == b._sync_digest_blocking()
+    call(a, "MAP", "GCOUNT", "SET", "m", "f7", "1")  # a diverges in f7
+    a.manager("MAP").repo.sync_prepare()
+
+    async def trees():
+        ta = dict(await a.sync_tree_async("MAP"))
+        tb = dict(await b.sync_tree_async("MAP"))
+        return ta, tb
+
+    ta, tb = asyncio.run(trees())
+    divergent = [k for k in set(ta) | set(tb) if ta.get(k) != tb.get(k)]
+    want_bucket = sync_bucket(compose.pack_field(b"m", b"f7"))
+    assert divergent == [want_bucket]
+
+    async def pull():
+        return await a.dump_range_async("MAP", divergent)
+
+    batch = asyncio.run(pull())
+    fields = {compose.unpack_field(k)[1] for k, _ in batch}
+    assert b"f7" in fields
+    # the pull is bucket-scoped: a handful of hash-colliding fields at
+    # most, never the 200-field map
+    assert len(batch) < 20
+    b.converge_deltas(("MAP", batch))
+    assert a._sync_digest_blocking() == b._sync_digest_blocking()
+
+
+# ---- BCOUNT semantics ------------------------------------------------------
+
+
+def test_bcount_cells_never_pass_u64():
+    """Review fix: every component cell is a u64 span on the wire
+    (decoders refuse past it), so mutations must refuse an overflow —
+    otherwise the origin encodes deltas every peer rejects and its own
+    journal becomes unreplayable."""
+    U64 = (1 << 64) - 1
+    db = mkdb(1)
+    assert call(db, "BCOUNT", "GRANT", "k", str(U64)) == b"+OK\r\n"
+    out = call(db, "BCOUNT", "GRANT", "k", "1")
+    assert out.startswith(b"-OUTOFBOUND"), out
+    # every delta this replica ever flushed still decodes (the codec's
+    # u64 bound is exactly what the mutation guard protects)
+    for name, batch in drain(db):
+        body = codec.encode(MsgPushDeltas(name, tuple(batch)))
+        codec.decode(body)
+    # the lattice-level guards refuse too (inc/dec/transfer cells)
+    bc = bcount.BCount()
+    bc.grant(1, U64)
+    assert bc.inc(1, U64)
+    assert not bc.inc(1, 1)  # rights exhausted AND cell at ceiling
+    assert bc.dec(1, U64)
+    assert not bc.dec(1, 1)
+    bc2 = bcount.BCount()
+    bc2.grant(1, U64)
+    bc2.incs[1] = U64  # dec-rights U64 with the decs cell empty
+    assert bc2.transfer(1, 2, U64, "DEC")  # fills the (1,2) cell exactly
+    assert not bc2.transfer(1, 2, 1, "DEC", unchecked=True)  # cell full
+
+
+def test_map_malformed_wire_key_drops_alone():
+    """Review fix: the codec treats MAP batch keys as opaque bytes, so
+    a buggy peer can ship a composite no unpack can parse. It must be
+    dropped at the converge boundary — alone — with every valid unit
+    buffered around it surviving the fold."""
+    db = mkdb(1)
+    repo = db.manager("MAP").repo
+    good = (compose.pack_field(b"m", b"f"),
+            ("GCOUNT", {2: 1}, {}, {2: 5}))
+    db.converge_deltas(("MAP", [
+        (b"\x80", ("GCOUNT", {2: 1}, {}, {2: 9})),  # truncated varint
+        good,
+        (b"\x05ab", ("GCOUNT", {2: 1}, {}, {2: 9})),  # length past end
+    ]))
+    assert call(db, "MAP", "GCOUNT", "GET", "m", "f") == b":5\r\n"
+    assert repo._dropped_units == 2
+    # digest machinery unaffected: only the valid unit is tracked
+    assert db._sync_digest_blocking() == db._sync_digest_blocking()
+
+
+def test_bcount_outofbound_is_typed_and_stateless():
+    db = mkdb(1)
+    call(db, "BCOUNT", "GRANT", "k", "10")
+    assert call(db, "BCOUNT", "INC", "k", "10") == b"+OK\r\n"
+    out = call(db, "BCOUNT", "INC", "k", "1")
+    assert out.startswith(b"-OUTOFBOUND")
+    assert call(db, "BCOUNT", "GET", "k") == b"*2\r\n:10\r\n:10\r\n"
+    out = call(db, "BCOUNT", "DEC", "k", "11")
+    assert out.startswith(b"-OUTOFBOUND")
+    assert call(db, "BCOUNT", "DEC", "k", "4") == b"+OK\r\n"
+    assert call(db, "BCOUNT", "GET", "k") == b"*2\r\n:6\r\n:10\r\n"
+    # a refusal ships nothing: no delta was created
+    drain(db)
+    out = call(db, "BCOUNT", "INC", "k", "999")
+    assert out.startswith(b"-OUTOFBOUND")
+    assert not [b for n, b in drain(db) if n == "BCOUNT"]
+
+
+def test_bcount_transfer_moves_spending_power():
+    a, b = mkdb(1), mkdb(2)
+    call(a, "BCOUNT", "GRANT", "k", "8")
+    call(a, "BCOUNT", "INC", "k", "8")
+    pump(a, b)
+    # b holds no dec-escrow: refuse
+    assert call(b, "BCOUNT", "DEC", "k", "1").startswith(b"-OUTOFBOUND")
+    assert call(a, "BCOUNT", "TRANSFER", "k", "2", "3") == b"+OK\r\n"
+    pump(a, b)
+    assert call(b, "BCOUNT", "DEC", "k", "3") == b"+OK\r\n"
+    assert call(b, "BCOUNT", "DEC", "k", "1").startswith(b"-OUTOFBOUND")
+    pump(b, a)
+    for db in (a, b):
+        assert call(db, "BCOUNT", "GET", "k") == b"*2\r\n:5\r\n:8\r\n"
+    assert a._sync_digest_blocking() == b._sync_digest_blocking()
+    # INC-escrow transfers move headroom the same way: b's decrements
+    # minted b's inc-escrow (it removed the units, it may restore them);
+    # b hands that headroom to a, whose own inc-escrow is spent
+    assert call(a, "BCOUNT", "INC", "k", "1").startswith(b"-OUTOFBOUND")
+    assert call(b, "BCOUNT", "TRANSFER", "k", "1", "2", "INC") == b"+OK\r\n"
+    pump(b, a)
+    assert call(a, "BCOUNT", "INC", "k", "2") == b"+OK\r\n"
+    pump(a, b)
+    for db in (a, b):
+        assert call(db, "BCOUNT", "GET", "k") == b"*2\r\n:7\r\n:8\r\n"
+
+
+def test_bcount_value_stays_bounded_under_interleaved_spend():
+    """Race the escrow across three replicas with arbitrary delivery:
+    every intermediate local view satisfies 0 <= value <= bound (the
+    lattice-level exhaustive version lives in jmodel; this is the
+    serving-stack face)."""
+    import random
+
+    rng = random.Random(0xC0)
+    dbs = [mkdb(i + 1) for i in range(3)]
+    call(dbs[0], "BCOUNT", "GRANT", "k", "30")
+    broadcast(dbs[0], dbs[1], dbs[2])
+    for _ in range(120):
+        db = rng.choice(dbs)
+        op = rng.random()
+        if op < 0.35:
+            call(db, "BCOUNT", "INC", "k", str(rng.randint(1, 4)))
+        elif op < 0.7:
+            call(db, "BCOUNT", "DEC", "k", str(rng.randint(1, 4)))
+        elif op < 0.85:
+            to = rng.choice([d for d in dbs if d is not db])
+            call(db, "BCOUNT", "TRANSFER", "k",
+                 str(to.system._identity), str(rng.randint(1, 3)),
+                 rng.choice(["INC", "DEC"]))
+        else:
+            src, dst = rng.sample(dbs, 2)
+            pump(src, dst)
+        for d in dbs:
+            bc = d.manager("BCOUNT").repo.counter(b"k")
+            assert bc is not None
+            assert 0 <= bc.value() <= bc.bound(), (bc.value(), bc.bound())
+    # final heal: full-state exchange (the rejoin-sync path) — partial
+    # deliveries above may have stranded deltas in drained outboxes
+    for src in dbs:
+        batch = src.manager("BCOUNT").repo.dump_state()
+        for dst in dbs:
+            if dst is not src:
+                dst.converge_deltas(("BCOUNT", list(batch)))
+    digests = {d._sync_digest_blocking() for d in dbs}
+    assert len(digests) == 1
+
+
+# ---- journal crash-replay of nested deltas ---------------------------------
+
+
+def test_journal_crash_replay_restores_nested_deltas(tmp_path):
+    """Torn-tail recovery with MAP + BCOUNT frames in the journal: the
+    replayed node restores field tombstones and escrow state, and a torn
+    trailing frame truncates cleanly (the crash-mid-append class)."""
+    db = mkdb(1)
+    j = Journal(str(tmp_path / "journal.jylis"), fsync="off")
+    j.open()
+    db.set_journal(j)  # before any write: every flush journals
+    call(db, "MAP", "TREG", "SET", "m", "f", "v1", "4")
+    call(db, "MAP", "GCOUNT", "SET", "m", "g", "9")
+    call(db, "MAP", "GCOUNT", "DEL", "m", "g")
+    call(db, "BCOUNT", "GRANT", "q", "12")
+    call(db, "BCOUNT", "INC", "q", "7")
+    call(db, "BCOUNT", "DEC", "q", "2")
+    drain(db)
+    j.close()
+
+    db2 = mkdb(1)
+    assert journal_mod.recover(db2, j.path) > 0
+    assert call(db2, "MAP", "TREG", "GET", "m", "f") == (
+        b"*2\r\n$2\r\nv1\r\n:4\r\n"
+    )
+    assert call(db2, "MAP", "GCOUNT", "GET", "m", "g") == b"$-1\r\n"
+    assert call(db2, "BCOUNT", "GET", "q") == b"*2\r\n:5\r\n:12\r\n"
+    # the escrow survives replay as SPENDABLE state: rid 1's rights are
+    # its own columns, restored exactly
+    assert call(db2, "BCOUNT", "DEC", "q", "5") == b"+OK\r\n"
+    assert call(db2, "BCOUNT", "DEC", "q", "1").startswith(b"-OUTOFBOUND")
+
+    # crash class: torn trailing frame truncates, the prefix replays
+    blob = open(j.path, "rb").read()
+    torn = str(tmp_path / "torn.jylis")
+    with open(torn, "wb") as f:
+        f.write(blob[:-3])
+    db3 = mkdb(1)
+    journal_mod.recover(db3, torn)  # must not raise; prefix converges
+    assert call(db3, "MAP", "TREG", "GET", "m", "f") == (
+        b"*2\r\n$2\r\nv1\r\n:4\r\n"
+    )
+
+
+def test_snapshot_roundtrip_nested_deltas_with_tombstones(tmp_path):
+    db = mkdb(1)
+    call(db, "MAP", "TREG", "SET", "m", "f", "v", "1")
+    call(db, "MAP", "TREG", "DEL", "m", "f")
+    call(db, "BCOUNT", "GRANT", "q", "3")
+    path = str(tmp_path / "snap.jylis")
+    persist.save_snapshot(db, path)
+    db2 = mkdb(1)
+    assert persist.load_snapshot(db2, path) == len(list(db2.managers()))
+    # the tombstone came back: the field stays dead and digests agree
+    assert call(db2, "MAP", "TREG", "GET", "m", "f") == b"$-1\r\n"
+    assert db._sync_digest_blocking() == db2._sync_digest_blocking()
+
+
+def test_registry_drives_every_digest_surface():
+    """The dynamic-enumeration satellite: DATA_TYPES, SYSTEM DIGEST
+    TYPES, and the digest-tree tables all derive from DATA_REPO_CLASSES
+    — MAP and BCOUNT cannot fall out of a digest-match gate."""
+    db = mkdb(1)
+    assert db.DATA_TYPES == DATA_TYPE_NAMES
+    assert "MAP" in db.DATA_TYPES and "BCOUNT" in db.DATA_TYPES
+    lines = db._sync_digest_types_blocking()
+    assert [n for n, _ in lines] == list(DATA_TYPE_NAMES)
+    cap = Cap()
+    db.apply(Respond(cap), [b"SYSTEM", b"DIGEST", b"TYPES"])
+    for name in DATA_TYPE_NAMES:
+        assert name.encode() in cap.buf

@@ -436,6 +436,130 @@ async def drill_tensor(site: str, action: str, tmp_path) -> None:
                 pass
 
 
+# ---- the composed-types drill (MAP + BCOUNT, schema v9) --------------------
+
+
+def _resp_array(*args: bytes) -> bytes:
+    out = b"*%d\r\n" % len(args)
+    for a in args:
+        out += b"$%d\r\n%s\r\n" % (len(a), a)
+    return out
+
+
+async def compose_cmd(node, *args: bytes) -> bytes:
+    return await resp_call(node.server.port, _resp_array(*args))
+
+
+async def wait_reply(nodes, args: tuple, want: bytes, ticks: int = 300):
+    got = {}
+
+    async def check():
+        for n in nodes:
+            got[n.config.addr.name] = await compose_cmd(n, *args)
+        return all(v == want for v in got.values())
+
+    deadline = asyncio.get_event_loop().time() + ticks * TICK
+    while asyncio.get_event_loop().time() < deadline:
+        if await check():
+            return
+        await asyncio.sleep(TICK)
+    assert await check(), (args, want, got)
+
+
+async def drill_compose(site: str, action: str, tmp_path) -> None:
+    """The generic drill with MAP + BCOUNT traffic: recursive field
+    units and full-escrow views journaled/gossiped THROUGH the injected
+    fault, every cell ending with converged composed reads, the escrow
+    invariant intact, and matched per-type digests (which now include
+    MAP and BCOUNT via the registry)."""
+    arg, budget = FAULT_ARGS[action]
+    data_dir = str(tmp_path / "bee") if site in DISK_SITES else None
+    p_a, p_b, p_c = grab_ports(3)
+    a = Node("aye", p_a)
+    b = DiskNode("bee", p_b, seeds=[a.config.addr], data_dir=data_dir)
+    c = Node("sea", p_c, seeds=[a.config.addr])
+    crashed: list[str] = []
+
+    def crash_handler(name):
+        crashed.append(name)
+        raise faults.FaultError(f"failpoint {name}: injected crash")
+
+    await a.start()
+    await b.start()
+    await c.start()
+    nodes = [a, b, c]
+    try:
+        assert await converge_wait(lambda: meshed(a, b, c), ticks=200)
+        # seed: every node owns one MAP field; a grants + fills escrow
+        for i, n in enumerate(nodes):
+            got = await compose_cmd(
+                n, b"MAP", b"GCOUNT", b"SET", b"drill", b"f%d" % i,
+                b"%d" % (i + 1),
+            )
+            assert got == b"+OK\r\n", got
+        assert await compose_cmd(
+            a, b"BCOUNT", b"GRANT", b"inv", b"10") == b"+OK\r\n"
+        assert await compose_cmd(
+            a, b"BCOUNT", b"INC", b"inv", b"10") == b"+OK\r\n"
+        for i in range(3):
+            await wait_reply(
+                nodes, (b"MAP", b"GCOUNT", b"GET", b"drill", b"f%d" % i),
+                b":%d\r\n" % (i + 1),
+            )
+        await wait_reply(nodes, (b"BCOUNT", b"GET", b"inv"),
+                         b"*2\r\n:10\r\n:10\r\n")
+
+        if action == "crash":
+            faults.set_crash_handler(crash_handler)
+        base_hits = faults.hits(site)
+        faults.arm(site, action, arg, budget)
+        # composed traffic riding THROUGH the armed seam: field edits, a
+        # field removal, and escrow spends (a's own rights fund them)
+        for i, n in enumerate(nodes):
+            await compose_cmd(n, b"MAP", b"GCOUNT", b"SET", b"drill",
+                              b"f%d" % i, b"10")
+        await compose_cmd(a, b"MAP", b"GCOUNT", b"SET", b"drill", b"gone",
+                          b"1")
+        await compose_cmd(a, b"MAP", b"GCOUNT", b"DEL", b"drill", b"gone")
+        await compose_cmd(a, b"BCOUNT", b"DEC", b"inv", b"4")
+        fired = await wait_pred(lambda: faults.hits(site) > base_hits)
+        assert fired, f"failpoint {site} never fired under {action}"
+
+        if action == "crash":
+            await wait_pred(lambda: bool(crashed), ticks=100)
+            assert crashed, f"crash at {site} never flagged"
+            faults.disarm(site)
+            await b.crash_stop()
+            b = DiskNode("bee", p_b, seeds=[a.config.addr], data_dir=data_dir)
+            await b.start()
+            nodes[1] = b
+
+        faults.disarm(site)
+        assert await converge_wait(
+            lambda: meshed_real(nodes), ticks=300
+        ), {n.config.addr.name: len(n.cluster._actives) for n in nodes}
+        for i in range(3):
+            await wait_reply(
+                nodes, (b"MAP", b"GCOUNT", b"GET", b"drill", b"f%d" % i),
+                b":%d\r\n" % (i + 11),
+            )
+        # the tombstoned field stays dead everywhere; escrow arithmetic
+        # survived the fault with the invariant intact
+        await wait_reply(nodes, (b"MAP", b"GCOUNT", b"GET", b"drill",
+                                 b"gone"), b"$-1\r\n")
+        await wait_reply(nodes, (b"BCOUNT", b"GET", b"inv"),
+                         b"*2\r\n:6\r\n:10\r\n")
+        await wait_digests_match(nodes)
+    finally:
+        faults.reset()
+        faults.set_crash_handler(None)
+        for n in nodes:
+            try:
+                await n.stop()
+            except Exception:
+                pass
+
+
 # ---- per-commit chaos smoke (make chaos: seconds, not minutes) -------------
 
 SMOKE_CELLS = [
@@ -529,6 +653,28 @@ def test_chaos_sync_cell(site, action, tmp_path):
 @pytest.mark.parametrize("site,action", TENSOR_CELLS)
 def test_chaos_tensor_cell(site, action, tmp_path):
     asyncio.run(drill_tensor(site, action, tmp_path))
+
+
+# composed-type action cells (schema v9): the same {error, corrupt,
+# crash} x {journal.append, cluster.write} grid TENSOR rides, but with
+# recursive MAP field units (tombstones included) and BCOUNT escrow
+# views through the fault — a corrupt cluster.write exercises the CRC
+# drop on a nested unit, a corrupt journal.append the boot-replay
+# refusal, crash the disk node's mid-traffic reboot with escrow replay
+COMPOSE_CELLS = [
+    ("journal.append", "error"),
+    ("cluster.write", "error"),
+    ("journal.append", "corrupt"),
+    ("cluster.write", "corrupt"),
+    ("journal.append", "crash"),
+    ("cluster.write", "crash"),
+]
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("site,action", COMPOSE_CELLS)
+def test_chaos_compose_cell(site, action, tmp_path):
+    asyncio.run(drill_compose(site, action, tmp_path))
 
 
 @pytest.mark.chaos

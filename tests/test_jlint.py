@@ -845,6 +845,10 @@ def test_real_native_surface_is_python_subset():
         ],
         "TENSOR": ["GET", "MRG", "SET"],
         "TLOG": ["CLR", "TRIM", "TRIMAT"],
+        # the composed types (schema v9) are host-only like TENSOR: the
+        # native engine defers their first words to the oracle
+        "MAP": ["DEL", "GET", "KEYS", "SET"],
+        "BCOUNT": ["DEC", "GET", "GRANT", "INC", "TRANSFER"],
     }
 
 
@@ -1027,7 +1031,8 @@ def test_real_codec_surfaces_are_symmetric_and_committed():
     # every cluster message and delta type is covered
     units = set(manifest["units"])
     for t in (
-        "TREG", "TLOG", "SYSTEM", "GCOUNT", "PNCOUNT", "UJSON", "TENSOR"
+        "TREG", "TLOG", "SYSTEM", "GCOUNT", "PNCOUNT", "UJSON", "TENSOR",
+        "MAP", "BCOUNT",
     ):
         assert f"delta/{t}" in units
     for m in ("Pong", "ExchangeAddrs", "AnnounceAddrs", "PushDeltas",
@@ -1035,9 +1040,9 @@ def test_real_codec_surfaces_are_symmetric_and_committed():
         assert f"msg/{m}" in units
     assert {"frame/header", "frame/wire", "file/journal", "file/snapshot"} <= units
     assert manifest["units"]["file/snapshot"]["accepts_legacy"] is True
-    # the journal reader also accepts the pre-v7 delta signature
+    # the journal reader also accepts the pre-v7/v9 delta signatures
     assert manifest["units"]["file/journal"]["accepts_legacy"] is True
-    assert manifest["legacy_snapshot_versions"] == [1, 2, 3, 6]
+    assert manifest["legacy_snapshot_versions"] == [1, 2, 3, 6, 8]
 
 
 # ---- pass 8: lattice discipline (JL801-JL805) -------------------------------
@@ -1126,7 +1131,7 @@ def test_real_lattice_manifest_and_harness_current():
     assert pass_lattice.check_manifest(project) == []
     manifest = pass_lattice.load_manifest()
     assert sorted(manifest["types"]) == [
-        "GCOUNT", "PNCOUNT", "TENSOR", "TLOG", "TREG", "UJSON",
+        "BCOUNT", "GCOUNT", "PNCOUNT", "TENSOR", "TLOG", "TREG", "UJSON",
     ]
     assert manifest["merge_roots"] == pass_lattice.extract_roots(project)
 

@@ -95,6 +95,83 @@ def test_exploration_is_deterministic():
     assert (a.states, a.leaves) == (b.states, b.leaves)
 
 
+# ---- BCOUNT escrow invariant (schema v9) -----------------------------------
+
+
+def test_bcount_decrement_transfer_schedules_hold_invariant():
+    """Exhaustive (bounded) exploration of concurrent BCOUNT decrement
+    and escrow-transfer schedules: `0 <= value <= bound` holds on every
+    replica's view in EVERY explored state. The bcount-focused budget
+    zeros the structural-fault axes so the frontier is spent on the
+    contention interplay: decs racing transfers racing delivery."""
+    with model_periods():
+        result = Explorer(
+            "nodes2",
+            6,
+            budgets={
+                "bdecs": 2, "bxfers": 1, "writes": 0, "kills": 0,
+                "crashes": 0, "partitions": 0, "dups": 0,
+            },
+            max_states=30_000,
+        ).run()
+    assert result.violation is None, result.violation
+    assert result.states > 500
+
+
+def test_broken_escrow_rule_yields_minimized_counterexample():
+    """Arm the DELIBERATELY broken escrow rule (decrement without the
+    local rights check — world.py escrow_unsafe) and the explorer must
+    find `value < 0`, minimize the schedule to the over-drawing
+    decrements alone, and produce a standalone-replayable artifact.
+    The same schedule replayed against the CORRECT rule holds every
+    invariant — the escrow check is exactly what the bound rests on."""
+    with model_periods():
+        result = Explorer(
+            "nodes2",
+            5,
+            budgets={"bdecs": 3, "bxfers": 1},
+            max_states=20_000,
+            escrow_unsafe=True,
+        ).run()
+        assert result.violation is not None
+        assert result.violation["invariant"] == "bcount_negative"
+        sched = result.schedule
+        assert sched["escrow_unsafe"] is True
+        # minimized to the decrement core: nothing structural survives
+        assert all(a[0] == "bdec" for a in sched["actions"]), sched["actions"]
+        assert len(sched["actions"]) == 3  # bound 2 + 1 overdraw
+        # the artifact replays standalone to the SAME violation
+        v = replay_schedule(json.loads(json.dumps(sched)))
+        assert v is not None and v.name == "bcount_negative"
+        # and the correct rule survives the identical schedule
+        safe = {k: v2 for k, v2 in sched.items() if k != "escrow_unsafe"}
+        assert replay_schedule(safe) is None
+
+
+def test_bcount_transfer_funds_remote_decrements():
+    """Directed schedule: the seed-escrow replica transfers a right to
+    B; after delivery B's previously-refused decrement succeeds, and the
+    quiesced world digest-matches with value within bounds."""
+    from scripts.jmodel.world import BCOUNT_KEY
+
+    with model_periods():
+        world = World("nodes2", {"bdecs": 2, "bxfers": 1})
+        try:
+            db_a, db_b = world.dbs["A"], world.dbs["B"]
+            # B holds no escrow yet: the local check refuses (OUTOFBOUND)
+            assert not db_b.local_bdec()
+            assert db_b.refused_decs == 1
+            assert db_a.local_bxfer(db_b.rid)
+            world.quiesce()  # ships the transfer, heals everything
+            assert db_b.local_bdec(), "delivered escrow must fund the dec"
+            world.quiesce()
+            bc_a = db_a.state_b[BCOUNT_KEY]
+            assert bc_a.value() == 1 and bc_a.bound() == 2
+            assert len(set(world._digests().values())) == 1
+        finally:
+            world.close()
+
+
 def test_lanes_world_bridges_and_converges():
     """The 2-lane config: a write on lane 1 reaches the external node E
     through the bus -> lane-0 bridge -> external mesh relay chain."""

@@ -212,7 +212,7 @@ def test_system_latency_and_trace_commands():
     db.apply(resp, [b"SYSTEM", b"LATENCY"])
     lines = resp.strings()
     # every declared seam reports, armed ones with non-zero percentiles
-    assert len([line for line in lines if line.startswith("drain.")]) == 5
+    assert len([line for line in lines if line.startswith("drain.")]) == 7
     (dispatch,) = [
         line for line in lines if line.startswith("server.py_dispatch ")
     ]

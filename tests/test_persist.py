@@ -42,6 +42,17 @@ def populate(db):
     call(db, "UJSON", "RM", "u", "name", '"alice"')
     call(db, "UJSON", "INS", "u", "tag", "1")
     call(db, "TENSOR", "SET", "t", "MAX", "0", b"\x00\x00\x80?\x00\x00\x00\xc0")
+    # composed types (schema v9): MAP fields over three inner lattices
+    # (one tombstoned — the tombstone must survive the round trip) and a
+    # BCOUNT with spent escrow
+    call(db, "MAP", "TREG", "SET", "m", "fr", "val", "11")
+    call(db, "MAP", "GCOUNT", "SET", "m", "fg", "6")
+    call(db, "MAP", "TLOG", "SET", "m", "fl", "entry", "2")
+    call(db, "MAP", "TREG", "SET", "m", "dead", "x", "1")
+    call(db, "MAP", "TREG", "DEL", "m", "dead")
+    call(db, "BCOUNT", "GRANT", "b", "50")
+    call(db, "BCOUNT", "INC", "b", "20")
+    call(db, "BCOUNT", "DEC", "b", "5")
     db.system.inslog("a log line")
 
 
@@ -56,6 +67,12 @@ READS = {
     ("TENSOR", "GET", "t"): (
         b"*3\r\n$3\r\nMAX\r\n$8\r\n\x00\x00\x80?\x00\x00\x00\xc0\r\n:0\r\n"
     ),
+    ("MAP", "TREG", "GET", "m", "fr"): b"*2\r\n$3\r\nval\r\n:11\r\n",
+    ("MAP", "GCOUNT", "GET", "m", "fg"): b":6\r\n",
+    ("MAP", "TLOG", "GET", "m", "fl"): b"*1\r\n*2\r\n$5\r\nentry\r\n:2\r\n",
+    ("MAP", "TREG", "GET", "m", "dead"): b"$-1\r\n",  # removed stays removed
+    ("MAP", "TREG", "KEYS", "m"): b"*1\r\n$2\r\nfr\r\n",
+    ("BCOUNT", "GET", "b"): b"*2\r\n:15\r\n:50\r\n",
 }
 
 
@@ -67,7 +84,7 @@ def test_roundtrip_all_types(tmp_path):
 
     db2 = Database(identity=1)
     n = persist.load_snapshot(db2, path)
-    assert n == 7  # one batch per data type
+    assert n == 9  # one batch per data type
     for req, want in READS.items():
         assert call(db2, *req) == want, req
     # the restored SYSTEM log still has the line
@@ -221,6 +238,36 @@ def test_online_snapshot_survives_sigkill(tmp_path):
         assert c.execute_command("TLOG", "SIZE", "log") == 1
     finally:
         stop_node(proc)
+
+
+def test_legacy_snapshot_truncated_at_frame_boundary_refused(tmp_path):
+    """Review fix: a legacy header pins its ERA's exact type-batch
+    count (or the current shape, for re-headered files) — a legacy
+    file truncated at a frame boundary must refuse, not silently load
+    a partial keyspace."""
+    from jylis_tpu.cluster import codec
+    from jylis_tpu.cluster.framing import FrameReader
+
+    db = Database(identity=1)
+    populate(db)
+    path = tmp_path / "snap"
+    persist.save_snapshot(db, str(path))
+    blob = path.read_bytes()
+    legacy = codec.legacy_snapshot_signatures()[0]
+    sig_end = len(persist.MAGIC) + len(legacy)
+    # split the body at frame boundaries, keep only 3 whole frames
+    frames = FrameReader(max_frame=1 << 62)
+    frames.append(blob[sig_end:])
+    bodies = list(frames)
+    from jylis_tpu.cluster.framing import frame as mk_frame
+
+    partial = persist.MAGIC + legacy + b"".join(
+        mk_frame(codec.encode(codec.decode(b))) for b in bodies[:3]
+    )
+    bad = tmp_path / "snap_partial"
+    bad.write_bytes(partial)
+    with pytest.raises(persist.SnapshotError):
+        persist.load_snapshot(Database(identity=1), str(bad))
 
 
 def test_legacy_v2_snapshot_header_loads(tmp_path):
