@@ -2036,6 +2036,503 @@ def config_bcount_contention() -> dict:
     return out
 
 
+# ---- sessions & regions benches (schema v10) --------------------------------
+
+
+def _zipf_ranks(n_keys: int, n: int, s: float = 0.99, seed: int = 7):
+    """Deterministic Zipfian key ranks (YCSB's default skew s=0.99):
+    the inverse-CDF over the truncated zeta weights."""
+    rng = np.random.default_rng(seed)
+    w = 1.0 / np.arange(1, n_keys + 1, dtype=np.float64) ** s
+    p = w / w.sum()
+    return rng.choice(n_keys, size=n, p=p)
+
+
+def _workload_latency(
+    conns: int,
+    rounds: int,
+    read_frac: float,
+    n_keys: int = 4096,
+    zipf: bool = True,
+    session: bool = False,
+    demote: bool = False,
+) -> dict[str, tuple]:
+    """{class: (p50_us, p99_us)} for a YCSB-style scenario: ``conns``
+    non-pipelined connections issuing GCOUNT GET/INC over a shared
+    keyspace with Zipfian (or uniform) key choice. ``session=True``
+    issues every read as SESSION READ <token> (token minted once per
+    conn via SESSION WRAP) — the session path's end-to-end cost.
+    ``demote=True`` demotes each connection to the Python dispatch path
+    first, which is the apples-to-apples baseline for the session
+    surface (SESSION commands are python-path by design)."""
+    import asyncio
+
+    from jylis_tpu.models.database import Database
+    from jylis_tpu.server.server import Server
+    from jylis_tpu.utils.config import Config
+    from jylis_tpu.utils.log import Log
+
+    async def measure():
+        cfg = Config()
+        cfg.port = "0"
+        cfg.log = Log.create_none()
+        db = Database(identity=1)
+        server = Server(cfg, db)
+        await server.start()
+        samples: dict[str, list[float]] = {"get": [], "inc": []}
+        try:
+
+            async def client(ci: int) -> None:
+                rng = np.random.default_rng(1000 + ci)
+                if zipf:
+                    ranks = _zipf_ranks(n_keys, rounds, seed=100 + ci)
+                else:
+                    ranks = np.random.default_rng(100 + ci).integers(
+                        0, n_keys, size=rounds
+                    )
+                reads = rng.random(rounds) < read_frac
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", server.port
+                )
+                try:
+
+                    async def read_until(counter, want: int) -> None:
+                        while counter.done < want:
+                            chunk = await reader.read(1 << 16)
+                            if not chunk:
+                                raise ConnectionError("server closed")
+                            counter.feed(chunk)
+
+                    primer = b"GCOUNT INC zk0 1\r\nGCOUNT GET zk0\r\n"
+                    want = 2
+                    if demote:
+                        primer = _demoter_cmd(ci) + b"\r\n" + primer
+                        want += 1
+                    writer.write(primer)
+                    await writer.drain()
+                    await read_until(RespReplyCounter(), want)
+                    for r_i in range(rounds):
+                        key = b"zk%d" % ranks[r_i]
+                        if reads[r_i]:
+                            payload = b"GCOUNT GET %s\r\n" % key
+                            cls = "get"
+                        else:
+                            payload = b"GCOUNT INC %s 1\r\n" % key
+                            cls = "inc"
+                        t0 = time.perf_counter()
+                        writer.write(payload)
+                        await writer.drain()
+                        await read_until(RespReplyCounter(), 1)
+                        samples[cls].append(time.perf_counter() - t0)
+                finally:
+                    writer.close()
+
+            async def session_client(ci: int) -> None:
+                # like client(), but every read is SESSION READ with a
+                # token minted once via SESSION WRAP — split out so the
+                # non-session path above stays byte-simple
+                rng = np.random.default_rng(1000 + ci)
+                ranks = _zipf_ranks(n_keys, rounds, seed=100 + ci)
+                reads = rng.random(rounds) < read_frac
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", server.port
+                )
+                try:
+
+                    async def read_until(counter, want: int) -> None:
+                        while counter.done < want:
+                            chunk = await reader.read(1 << 16)
+                            if not chunk:
+                                raise ConnectionError("server closed")
+                            counter.feed(chunk)
+
+                    primer = b"GCOUNT INC zk0 1\r\nGCOUNT GET zk0\r\n"
+                    want = 2
+                    if demote:
+                        primer = _demoter_cmd(ci) + b"\r\n" + primer
+                        want += 1
+                    writer.write(primer)
+                    await writer.drain()
+                    await read_until(RespReplyCounter(), want)
+                    token = await _session_token_over_wire(
+                        reader, writer, b"zk0"
+                    )
+                    for r_i in range(rounds):
+                        key = b"zk%d" % ranks[r_i]
+                        if reads[r_i]:
+                            cmd = [b"SESSION", b"READ", token, b"GCOUNT",
+                                   b"GET", key]
+                            payload = b"*%d\r\n" % len(cmd) + b"".join(
+                                b"$%d\r\n%s\r\n" % (len(w), w) for w in cmd
+                            )
+                            cls = "get"
+                        else:
+                            payload = b"GCOUNT INC %s 1\r\n" % key
+                            cls = "inc"
+                        t0 = time.perf_counter()
+                        writer.write(payload)
+                        await writer.drain()
+                        await read_until(RespReplyCounter(), 1)
+                        samples[cls].append(time.perf_counter() - t0)
+                finally:
+                    writer.close()
+
+            runner = session_client if session else client
+            await asyncio.gather(*(runner(i) for i in range(conns)))
+        finally:
+            await server.dispose()
+        return samples
+
+    samples = asyncio.run(measure())
+    out = {}
+    for name, xs in samples.items():
+        if not xs:
+            continue
+        xs.sort()
+        p50 = xs[len(xs) // 2]
+        p99 = xs[min(len(xs) - 1, int(len(xs) * 0.99))]
+        out[name] = (round(p50 * 1e6, 1), round(p99 * 1e6, 1))
+    return out
+
+
+def _plain_latency_under_load(bg_session: bool, fg_conns: int = 4,
+                              bg_conns: int = 4, rounds: int = 150) -> tuple:
+    """(p50_us, p99_us) of plain GCOUNT GETs on ``fg_conns`` foreground
+    connections while ``bg_conns`` background connections issue either
+    SESSION READ traffic (bg_session=True) or the same plain GETs at a
+    MATCHED, paced rate (~500 ops/s per conn — an unpaced background
+    saturates the 2-core recording host and measures scheduler
+    contention, not the path). The with/without-session ratio isolates
+    the session path's tax on the node's plain serving latency — the
+    `serving-latency` overhead the acceptance bar bounds (same
+    connection count, same op rate, the ONLY difference is whether the
+    background rides the SESSION surface)."""
+    import asyncio
+
+    from jylis_tpu.models.database import Database
+    from jylis_tpu.server.server import Server
+    from jylis_tpu.utils.config import Config
+    from jylis_tpu.utils.log import Log
+
+    async def measure():
+        cfg = Config()
+        cfg.port = "0"
+        cfg.log = Log.create_none()
+        db = Database(identity=1)
+        server = Server(cfg, db)
+        await server.start()
+        stop = asyncio.Event()
+        samples: list[float] = []
+        try:
+
+            async def read_until(reader, counter, want: int) -> None:
+                while counter.done < want:
+                    chunk = await reader.read(1 << 16)
+                    if not chunk:
+                        raise ConnectionError("server closed")
+                    counter.feed(chunk)
+
+            async def background(ci: int) -> None:
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", server.port
+                )
+                try:
+                    # BOTH background arms ride the python dispatch path
+                    # (demoted): session commands are python-path by
+                    # design, so a native-path plain background would
+                    # measure the engine-vs-python gap, not the session
+                    # machinery
+                    writer.write(
+                        _demoter_cmd(1000 + ci)
+                        + b"\r\nGCOUNT INC bg%d 1\r\n" % ci
+                    )
+                    await writer.drain()
+                    await read_until(reader, RespReplyCounter(), 2)
+                    if bg_session:
+                        tok = await _session_token_over_wire(
+                            reader, writer, b"bg%d" % ci
+                        )
+                        cmd = [b"SESSION", b"READ", tok, b"GCOUNT",
+                               b"GET", b"bg%d" % ci]
+                        payload = b"*%d\r\n" % len(cmd) + b"".join(
+                            b"$%d\r\n%s\r\n" % (len(w), w) for w in cmd
+                        )
+                    else:
+                        payload = b"GCOUNT GET bg%d\r\n" % ci
+                    while not stop.is_set():
+                        writer.write(payload)
+                        await writer.drain()
+                        await read_until(reader, RespReplyCounter(), 1)
+                        await asyncio.sleep(0.002)  # the matched pace
+                finally:
+                    writer.close()
+
+            async def foreground(ci: int) -> None:
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", server.port
+                )
+                try:
+                    writer.write(b"GCOUNT INC fg%d 1\r\n" % ci)
+                    await writer.drain()
+                    await read_until(reader, RespReplyCounter(), 1)
+                    for _ in range(rounds):
+                        t0 = time.perf_counter()
+                        writer.write(b"GCOUNT GET fg%d\r\n" % ci)
+                        await writer.drain()
+                        await read_until(reader, RespReplyCounter(), 1)
+                        samples.append(time.perf_counter() - t0)
+                finally:
+                    writer.close()
+
+            bg = [
+                asyncio.ensure_future(background(i))
+                for i in range(bg_conns)
+            ]
+            await asyncio.sleep(0.1)  # background loops spinning
+            await asyncio.gather(*(foreground(i) for i in range(fg_conns)))
+            stop.set()
+            await asyncio.gather(*bg, return_exceptions=True)
+        finally:
+            stop.set()
+            await server.dispose()
+        return samples
+
+    samples = asyncio.run(measure())
+    samples.sort()
+    p50 = samples[len(samples) // 2]
+    p99 = samples[min(len(samples) - 1, int(len(samples) * 0.99))]
+    return (round(p50 * 1e6, 1), round(p99 * 1e6, 1))
+
+
+async def _session_token_over_wire(reader, writer, key: bytes) -> bytes:
+    """SESSION WRAP GCOUNT INC <key> 1 -> the minted token (binary-safe
+    positional parse of the [reply, token] array)."""
+    wrap = [b"SESSION", b"WRAP", b"GCOUNT", b"INC", key, b"1"]
+    writer.write(
+        b"*%d\r\n" % len(wrap)
+        + b"".join(b"$%d\r\n%s\r\n" % (len(w), w) for w in wrap)
+    )
+    await writer.drain()
+    buf = b""
+    while True:
+        chunk = await reader.read(1 << 16)
+        if not chunk:
+            raise ConnectionError("server closed")
+        buf += chunk
+        if not buf.startswith(b"*2\r\n+OK\r\n$"):
+            if len(buf) >= 10:
+                raise AssertionError(buf[:64])
+            continue
+        j = buf.find(b"\r\n", 10)
+        if j < 0:
+            continue
+        n = int(buf[10:j])
+        if len(buf) >= j + 2 + n + 2:
+            return buf[j + 2 : j + 2 + n]
+
+
+def config_workload_zipf() -> dict:
+    """YCSB-style skewed workload (ROADMAP item 5b): Zipfian (s=0.99)
+    hot keys over a 4096-key GCOUNT space, read-heavy (95/5) and
+    write-heavy (50/50) scenarios at 16 non-pipelined connections,
+    p50/p99 per command class — plus the session path measured
+    apples-to-apples: SESSION READ vs a plain python-path GET on
+    demoted connections (the SESSION surface is python-path by design;
+    `session_overhead_frac` is the p50 tax of carrying the guarantee)."""
+    read_heavy = _workload_latency(16, 150, read_frac=0.95)
+    write_heavy = _workload_latency(16, 150, read_frac=0.50)
+    uniform = _workload_latency(16, 150, read_frac=0.95, zipf=False)
+    plain_py = _workload_latency(8, 120, read_frac=1.0, demote=True)
+    sess_py = _workload_latency(
+        8, 120, read_frac=1.0, demote=True, session=True
+    )
+    # the acceptance number: plain serving latency with a matched-rate
+    # background differing ONLY in riding the SESSION surface —
+    # median-of-5 paired runs after a discarded warmup pair (the
+    # 2-core recording host's first runs carry scheduler noise from
+    # the scenarios above)
+    _plain_latency_under_load(bg_session=True, fg_conns=1, bg_conns=2,
+                              rounds=100)  # warmup, discarded
+    pairs = [
+        (
+            _plain_latency_under_load(
+                bg_session=True, fg_conns=1, bg_conns=2, rounds=400
+            ),
+            _plain_latency_under_load(
+                bg_session=False, fg_conns=1, bg_conns=2, rounds=400
+            ),
+        )
+        for _ in range(5)
+    ]
+    # publish the PAIR whose ratio is the median, so the two recorded
+    # latency tuples reproduce the recorded overhead exactly
+    pairs.sort(key=lambda p: p[0][0] / max(p[1][0], 1e-9))
+    with_sess, without_sess = pairs[len(pairs) // 2]
+    serving_overhead = with_sess[0] / max(without_sess[0], 1e-9) - 1.0
+    return {
+        "metric": (
+            "YCSB-style Zipfian workload (s=0.99, 4096 keys, 16 conns): "
+            "p50/p99 per command class"
+        ),
+        "value": read_heavy["get"][1],
+        "unit": "us p99 (GET, read-heavy zipf)",
+        # skew factor: what the hot-key pile-up costs vs uniform keys
+        "vs_baseline": round(
+            read_heavy["get"][1] / max(uniform["get"][1], 1e-9), 2
+        ),
+        "read_heavy_us": read_heavy,
+        "write_heavy_us": write_heavy,
+        "uniform_read_us": uniform,
+        "session_read_us": sess_py,
+        "python_read_us": plain_py,
+        "plain_get_us_with_session_load": with_sess,
+        "plain_get_us_with_plain_load": without_sess,
+        "serving_latency_overhead_frac": round(serving_overhead, 4),
+        "note": (
+            "serving_latency_overhead_frac = plain GET p50 with "
+            "session-reading background connections over the same with "
+            "plain-reading background at a MATCHED paced rate (paired, "
+            "median of 5) — the session path's tax on serving-latency; "
+            "acceptance <= 0.05. "
+            "session_read_us vs python_read_us is the END-TO-END cost "
+            "of a SESSION READ itself (bigger request, token decode + "
+            "reply token, array reply) against a plain GET on the same "
+            "python dispatch path — the price of carrying the "
+            "guarantee, paid only by session commands."
+        ),
+    }
+
+
+_WAN_SPAWN = (
+    "from jylis_tpu.utils.vcpu import force_virtual_cpu; "
+    "force_virtual_cpu(8); "
+    "import sys; from jylis_tpu.main import main; main(sys.argv[1:])"
+)
+
+
+def _spawn_wan_node(port, cport, name, region, seed=None, failpoints=""):
+    import os
+    import subprocess
+    import sys
+
+    argv = [
+        sys.executable, "-c", _WAN_SPAWN, "--port", str(port),
+        "--addr", f"127.0.0.1:{cport}:{name}", "--region", region,
+        "--heartbeat-time", "0.2", "--log-level", "warn",
+    ]
+    if seed:
+        argv += ["--seed-addrs", seed]
+    if failpoints:
+        argv += ["--failpoints", failpoints]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.Popen(
+        argv,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        env=env,
+        stdout=subprocess.DEVNULL,
+    )
+
+
+def _wan_converge_lag(rtt_s: float, writes: int = 5) -> float:
+    """Median write->visible lag (ms) from region r1's member node to
+    region r2's node, with ``rtt_s`` of one-way WAN latency injected at
+    the bridge relay seam (cluster.relay=sleep). Three REAL processes:
+    r1 = {bridge a, member b}, r2 = {c}; the measured path is b -> a
+    (intra) -> relay(+rtt) -> c."""
+    import socket
+
+    def call(port, cmd: bytes) -> bytes:
+        s = socket.create_connection(("127.0.0.1", port), timeout=10)
+        try:
+            s.sendall(cmd)
+            s.settimeout(10)
+            return s.recv(1 << 16)
+        finally:
+            s.close()
+
+    ports = [_free_port() for _ in range(3)]
+    cports = sorted(_free_port() for _ in range(3))
+    # the smallest address string is the deterministic bridge: give the
+    # intended bridge the smallest cluster port (all ephemeral ports
+    # print 5 digits, so numeric order IS string order)
+    seed = f"127.0.0.1:{cports[0]}:wan-a"
+    fp = f"cluster.relay=sleep:{rtt_s}" if rtt_s > 0 else ""
+    procs = [
+        _spawn_wan_node(ports[0], cports[0], "wan-a", "r1", failpoints=fp),
+        _spawn_wan_node(ports[1], cports[1], "wan-b", "r1", seed=seed),
+        _spawn_wan_node(ports[2], cports[2], "wan-c", "r2", seed=seed),
+    ]
+    try:
+        deadline = time.time() + 180
+        for p in ports:
+            while True:
+                if time.time() > deadline:
+                    raise RuntimeError("wan node never came up")
+                try:
+                    if call(p, b"GCOUNT GET boot\r\n").startswith(b":"):
+                        break
+                except OSError:
+                    time.sleep(0.3)
+        # wait until the relay path works at all (topology settled)
+        call(ports[1], b"GCOUNT INC warm 1\r\n")
+        while call(ports[2], b"GCOUNT GET warm\r\n") != b":1\r\n":
+            if time.time() > deadline:
+                raise RuntimeError("relay path never converged")
+            time.sleep(0.05)
+        lags = []
+        for i in range(writes):
+            time.sleep(0.6)  # a fresh proactive-flush window per write
+            key = b"w%d" % i
+            t0 = time.perf_counter()
+            assert call(ports[1], b"GCOUNT INC %s 1\r\n" % key) == b"+OK\r\n"
+            while call(ports[2], b"GCOUNT GET %s\r\n" % key) != b":1\r\n":
+                if time.perf_counter() - t0 > 60:
+                    raise RuntimeError("write never became visible")
+                time.sleep(0.002)
+            lags.append((time.perf_counter() - t0) * 1e3)
+        lags.sort()
+        return lags[len(lags) // 2]
+    finally:
+        for pr in procs:
+            pr.terminate()
+        for pr in procs:
+            try:
+                pr.wait(timeout=30)
+            except Exception:
+                pr.kill()
+                pr.wait(timeout=10)
+
+
+def config_wan_converge() -> dict:
+    """Multi-region convergence lag vs injected WAN RTT (ROADMAP item
+    5a): three real node processes in two regions (r1 = bridge + one
+    member, r2 = one node), writes on the r1 MEMBER, visibility polled
+    on the r2 node — the full member -> bridge -> relay -> remote-region
+    path, with the WAN latency injected at the bridge's relay seam via
+    the failpoint machinery (cluster.relay=sleep:RTT)."""
+    sweep = {}
+    for rtt_ms in (0, 20, 80):
+        sweep[str(rtt_ms)] = round(_wan_converge_lag(rtt_ms / 1e3), 1)
+    base = max(sweep["0"], 1e-9)
+    return {
+        "metric": (
+            "multi-region convergence lag vs injected inter-region RTT "
+            "(2 regions, 3 real nodes, bridge relay)"
+        ),
+        "value": sweep["80"],
+        "unit": "ms median write->visible lag at 80ms injected RTT",
+        # the injected-RTT tax over the zero-RTT relay path
+        "vs_baseline": round(sweep["80"] / base, 2),
+        "base_lag_ms": sweep["0"],
+        "converge_lag_ms": sweep,
+        "note": (
+            "lag is measured client-side: write acked on the r1 member "
+            "until first successful read on the r2 node; the relay seam "
+            "sleeps once per relayed batch, so lag ~ base + RTT"
+        ),
+    }
+
+
 CONFIGS = {
     "gcount-smoke": config_gcount_smoke,
     "concurrent": config_concurrent,
@@ -2054,6 +2551,8 @@ CONFIGS = {
     "pallas-tensor-merge": config_pallas_tensor_merge,
     "map-hot-field": config_map_hot_field,
     "bcount-contention": config_bcount_contention,
+    "workload-zipf": config_workload_zipf,
+    "wan-converge": config_wan_converge,
 }
 
 
@@ -2130,6 +2629,20 @@ def smoke() -> None:
     assert mh["range_pulled_fields"] < mh["fields"], mh
     bc = _bcount_contention(n_replicas=8, bound=512)
     assert bc["oversell"] == 0 and bc["grants"] == 512, bc
+    # tiny workload-zipf pass: the Zipfian sampler, both scenario
+    # shapes, the SESSION WRAP/READ wire (binary token over RESP), and
+    # the paced paired-load harness behind the recorded overhead number
+    wl = _workload_latency(2, 6, read_frac=0.5)
+    assert all(p50 > 0 and p99 >= p50 for p50, p99 in wl.values()), wl
+    ws = _workload_latency(2, 6, read_frac=1.0, demote=True, session=True)
+    assert ws["get"][0] > 0, ws
+    pl = _plain_latency_under_load(
+        bg_session=True, fg_conns=1, bg_conns=1, rounds=6
+    )
+    assert pl[0] > 0, pl
+    # tiny wan-converge pass: 3 real regioned processes, one write,
+    # the member -> bridge -> relay -> remote-region visibility path
+    assert _wan_converge_lag(0.0, writes=1) > 0
     print(
         json.dumps(
             {

@@ -37,6 +37,7 @@ import zlib
 from collections import deque
 
 from .. import faults
+from .. import sessions as sessions_mod
 from ..obs.trace import now_ms
 from ..ops.p2set import P2Set
 from ..utils.address import Address
@@ -54,6 +55,8 @@ from .msg import (
     MsgPong,
     MsgPushDeltas,
     MsgRangeRequest,
+    MsgRegionGossip,
+    MsgRelayPush,
     MsgSeqPush,
     MsgSyncDone,
     MsgSyncRequest,
@@ -216,6 +219,11 @@ class Drop:
     UNEXPECTED = "unexpected_msg"
     DISPOSED = "disposed"
     BLACKLISTED = "blacklisted"
+    # region-aware peering (schema v10): the conn is out of the sparse
+    # WAN topology's policy (an out-of-region non-bridge peer) — dropped
+    # without peer-fault backoff, and _sync_actives never redials while
+    # the region map says so
+    REGION = "region_scope"
 
 
 class MsgDrop:
@@ -288,9 +296,10 @@ class _Conn:
     __slots__ = (
         "writer", "active_addr", "peer_addr", "established", "task",
         "sync_served_tick",
-        "sync_digests", "sync_defer_streak", "sync_defer_last_tick",
+        "sync_digests", "sync_svec", "sync_defer_streak",
+        "sync_defer_last_tick",
         "pong_sent", "last_write_dropped", "range_pending",
-        "range_inflight",
+        "range_inflight", "peer_region", "peer_epoch", "peer_srid",
     )
 
     def __init__(self, writer, active_addr: Address | None):
@@ -300,12 +309,20 @@ class _Conn:
         # handshake's dialer-address suffix (teardown log identity +
         # the inbound-contact backoff reset); None until handshake
         self.peer_addr: Address | None = None
+        # v10 handshake: the peer's region (topology classification)
+        # and boot epoch; on passive conns the two combine into the
+        # sender's session rid (sessions.make_rid), which keys every
+        # applied-vector advance for its SeqPush stream
+        self.peer_region = ""
+        self.peer_epoch = 0
+        self.peer_srid: str | None = None
         self.established = False
         self.task: asyncio.Task | None = None
         # tick of the last sync served on this conn (rate limit: repeated
         # requests within the cooldown get a SyncDone, not another dump)
         self.sync_served_tick: int | None = None
         self.sync_digests = ()  # the requester's per-type digests, if any
+        self.sync_svec = ()  # ... and its session vector (v10 adoption)
         # consecutive mid-heal serve deferrals for THIS requester, capped
         # (see _passive_msg's MsgSyncRequest branch). Per-connection, not
         # global (ADVICE round 5): a single shared streak lets the serve
@@ -427,6 +444,43 @@ class Cluster:
         # and flap the gauges last-writer-wins between the instances.
         self._obs_primary = register_system
         self._addr: Address = config.addr
+        # ---- sessions & regions (schema v10) ---------------------------
+        # boot epoch: the incarnation stamp of this instance's sequenced
+        # stream. A crash-reboot restarts _delta_seq at 0; without the
+        # epoch in the rid, peers' session vectors would alias the new
+        # stream's seqs 1..k onto the old incarnation's watermark and
+        # falsely verify post-reboot tokens (a real read-your-writes
+        # hole — jmodel's crash schedules cover it). Wall-ms through the
+        # injectable clock (deterministic under jmodel), floored by a
+        # persisted per-address counter when --data-dir is set so a
+        # clock stepping BACKWARDS across a reboot can never mint an
+        # epoch the previous incarnation already used (review find);
+        # clockless deployments accept the (sub-ms-window) residual.
+        self._epoch = self._boot_epoch(config)
+        self._srid = sessions_mod.make_rid(str(self._addr), self._epoch)
+        self._region = getattr(config, "region", "")
+        # {advertised address str -> (region name, epoch)}, learned
+        # from v10 handshakes and MsgRegionGossip: what the peering
+        # policy (_should_peer) classifies every known address with.
+        # VERSIONED by the subject node's boot epoch (highest wins):
+        # unversioned last-writer-wins would let peers re-gossiping a
+        # stale map oscillate everyone's classification after a node's
+        # region changes across a restart, flapping bridge election
+        # forever (review find). An empty region with a higher epoch
+        # legitimately CLEARS a stale one (the node restarted
+        # region-less).
+        self._regions: dict[str, tuple[str, int]] = {
+            str(self._addr): (self._region, self._epoch)
+        }
+        # the node's session index (sessions.SessionIndex) — owned by
+        # the Database and SHARED by every cluster instance of the node
+        # (bus + external on lane 0): applied-vector advances and
+        # digest-match adoptions feed it from any mesh; only the
+        # DRIVING instance binds its rid + flush hook for token minting
+        self._sessions = getattr(database, "sessions", None)
+        self._owns_session = drive_flush and self._sessions is not None
+        if self._owns_session:
+            self._sessions.bind(self._srid, self.flush_now)
         self._known_addrs: P2Set = P2Set([self._addr])
         for seed in config.seed_addrs:
             self._known_addrs.add(seed)
@@ -458,6 +512,11 @@ class Cluster:
             "sync_full_dumps": 0,       # legacy-shape fallback dumps ONLY
             "interval_resets_sent": 0,  # gaps we demoted to range repair
             "interval_resets_recv": 0,  # gaps peers demoted us over
+            # sessions & regions (schema v10): bridge relay traffic and
+            # topology prunes — WAN cost is observable, not inferred
+            "relays_sent": 0,           # origin-preserving re-exports out
+            "relays_recv": 0,           # relayed batches converged here
+            "region_prunes": 0,         # conns dropped to topology policy
         }
         self._drop_counts: dict[str, int] = {}
         # declared message-level drops (MsgDrop reasons): frame
@@ -490,6 +549,11 @@ class Cluster:
         # retransmit reships the ORIGINAL origin stamp, so the lag gauge
         # reports the delta's true staleness, not a fresh-looking lie.
         self._delta_seq = 0
+        # own-content ordinal (schema v10): ticks ONLY for this
+        # instance's own batches, never for relay frames — the session
+        # counter (gapless per origin, so contiguity survives relay
+        # hops; msg.py MsgSeqPush)
+        self._own_seq = 0
         self._delta_log: deque = deque()  # (seq, wired frame)
         self._delta_log_cap = getattr(config, "delta_log_cap", DELTA_LOG_CAP)
         self._range_budget = getattr(config, "range_budget", RANGE_REQ_BUCKETS)
@@ -552,6 +616,39 @@ class Cluster:
 
     # ---- lifecycle --------------------------------------------------------
 
+    def _boot_epoch(self, config) -> int:
+        """max(wall-ms, persisted floor + 1): epochs must be strictly
+        monotone per address across reboots — see the __init__ comment.
+        The sidecar file (`epoch.<addr-hash>` in --data-dir) is outside
+        every pinned on-disk format; all I/O is best-effort (a missing
+        dir or full disk degrades to the wall-clock epoch, never a
+        boot failure)."""
+        import os
+
+        now = int(self._clock.now_ms())
+        data_dir = getattr(config, "data_dir", "") or ""
+        if not data_dir:
+            return now
+        path = os.path.join(data_dir, f"epoch.{self._addr.hash64():016x}")
+        prev = -1
+        try:
+            # one tiny read at instance construction, before this
+            # cluster serves anything (the async call sites in main.py
+            # carry the blocking-ok suppressions)
+            with open(path, encoding="utf-8") as f:
+                prev = int(f.read().strip() or -1)
+        except (OSError, ValueError):
+            prev = -1
+        epoch = max(now, prev + 1)
+        try:
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                f.write(str(epoch))
+            os.replace(tmp, path)
+        except OSError:
+            pass  # best-effort: next boot falls back to wall time
+        return epoch
+
     async def start(self) -> None:
         try:
             self._server = await asyncio.start_server(
@@ -597,8 +694,23 @@ class Cluster:
             # serve path; a requester that crashed mid-episode would
             # otherwise leave backlog_ms climbing forever)
             self._defer_since_ms = None
+        self._prune_region_conns()
         if self._tick % ANNOUNCE_EVERY == 0:
             self._broadcast_msg(MsgAnnounceAddrs(self._known_addrs.copy()))
+            if any(r for r, _ in self._regions.values()):
+                # region membership rides the announce cadence (v10):
+                # without it, an address learned through gossip could
+                # never be classified before a wasted dial. Region-less
+                # clusters skip the frame entirely — their wire traffic
+                # is unchanged from v9's shape.
+                self._broadcast_msg(
+                    MsgRegionGossip(
+                        tuple(
+                            (a, r, e)
+                            for a, (r, e) in sorted(self._regions.items())
+                        )
+                    )
+                )
         if self._tick % SYNC_PERIOD_TICKS == 0:
             # periodic anti-entropy digest exchange (see SYNC_PERIOD_TICKS).
             # Deferred while LOCAL writes are flowing: a write-hot node
@@ -677,7 +789,8 @@ class Cluster:
             "deltas_reshipped", "ranges_requested", "ranges_served",
             "sync_bytes_sent", "sync_bytes_recv", "sync_trees_sent",
             "sync_full_dumps", "interval_resets_sent",
-            "interval_resets_recv",
+            "interval_resets_recv", "relays_sent", "relays_recv",
+            "region_prunes",
         ):
             out[key] = self._stats[key]
         for reason in sorted(self._drop_counts):
@@ -752,15 +865,81 @@ class Cluster:
             if self._tick - last > IDLE_TICKS_LIMIT:
                 self._drop(conn, Drop.IDLE)
 
+    # ---- region-aware peering (schema v10) ---------------------------------
+
+    def _bridge_of(self, region: str) -> str | None:
+        """The deterministic bridge of ``region``: the lexicographically
+        smallest known address classified into it. Every node computes
+        this from the same gossiped region map, so the sparse topology
+        converges without election traffic (the lane-0 bridge pattern,
+        generalized: ONE member of each region speaks WAN)."""
+        return min(
+            (
+                str(a)
+                for a in self._known_addrs
+                if self._regions.get(str(a), ("", 0))[0] == region
+            ),
+            default=None,
+        )
+
+    def _is_bridge(self) -> bool:
+        return bool(self._region) and (
+            self._bridge_of(self._region) == str(self._addr)
+        )
+
+    def _should_peer(self, addr: Address) -> bool:
+        """The dial policy: region-less nodes (and region-less or
+        unknown peers) keep the classic full mesh — bootstrap and mixed
+        deployments degrade to v9 behavior; within a region the mesh
+        stays full; across regions only the two bridges dial each
+        other. Never affects PASSIVE acceptance: transient policy
+        disagreement while gossip spreads costs a redundant conn, not a
+        partition."""
+        if not self._region:
+            return True
+        r = self._regions.get(str(addr), ("", 0))[0]
+        if not r:
+            return True
+        if r == self._region:
+            return True
+        return self._is_bridge() and str(addr) == self._bridge_of(r)
+
+    def _fold_regions(self, entries) -> None:
+        """Fold (addr, region, epoch) triples: higher epoch wins (the
+        subject node's own boot epoch is the version — it stamped the
+        value into its handshakes/gossip, so the freshest incarnation's
+        classification converges monotonically everywhere). Our own
+        entry is never re-classified: we ARE its authority."""
+        me = str(self._addr)
+        for addr_s, region, epoch in entries:
+            if addr_s == me:
+                continue
+            cur = self._regions.get(addr_s)
+            if cur is None or epoch > cur[1]:
+                self._regions[addr_s] = (region, epoch)
+
+    def _prune_region_conns(self) -> None:
+        """Drop actives the (possibly just-gossiped) region map says we
+        should not hold — the heartbeat half of the sparse topology
+        (the other half is _sync_actives never redialing them)."""
+        for addr, conn in list(self._actives.items()):
+            if not self._should_peer(addr):
+                self._stats["region_prunes"] += 1
+                self._drop(conn, Drop.REGION)
+
     def _sync_actives(self) -> None:
         """Dial an active connection to every known peer we lack
         (cluster.pony:51-71). Unlike the reference's redial-every-tick
         loop, each address runs a dial state machine: a failed dial
         backs the address off exponentially (deterministic jitter,
         capped), so an unreachable peer costs a bounded trickle of
-        attempts instead of one per heartbeat."""
+        attempts instead of one per heartbeat. Region-aware peering
+        (v10) additionally skips addresses outside the sparse topology
+        (_should_peer)."""
         for addr in self._known_addrs:
             if addr == self._addr or addr in self._actives:
+                continue
+            if not self._should_peer(addr):
                 continue
             st = self._peers.get(addr)
             if st is None:
@@ -800,11 +979,17 @@ class Cluster:
             return
         conn.writer = writer
         self._mark_activity(conn)  # handshake counts against the idle clock
-        # handshake: our schema signature, plus our advertised address so
-        # the passive side can identify this peer (teardown logs) and
-        # reset its own dial backoff toward us (inbound contact proves
-        # the address is alive again)
-        conn.send_raw(self._wire(self._serial + codec.encode_addr(self._addr)))
+        # handshake (v10): our schema signature, plus the hello suffix —
+        # advertised address (the passive side's teardown-log identity
+        # and inbound-contact backoff reset), region (topology
+        # classification) and boot epoch (the session-rid incarnation
+        # stamp keying our SeqPush stream in the peer's applied vector)
+        conn.send_raw(
+            self._wire(
+                self._serial
+                + codec.encode_hello(self._addr, self._region, self._epoch)
+            )
+        )
         await self._read_loop(conn, reader, active=True)
 
     def _active_missed(self, addr: Address) -> None:
@@ -929,11 +1114,18 @@ class Cluster:
             return False
         extra = body[sig_len:]
         if active:
-            # the passive echo is the bare signature; we know who we
-            # dialed, so a successful handshake resets the backoff
-            if extra:
+            # the passive echo (v10) carries the peer's region + epoch;
+            # we know who we dialed, so a successful handshake resets
+            # the backoff
+            try:
+                conn.peer_region, conn.peer_epoch = codec.decode_echo(extra)
+            except codec.CodecError:
                 self._drop(conn, Drop.HANDSHAKE)
                 return False
+            self._fold_regions(
+                ((str(conn.active_addr), conn.peer_region,
+                  conn.peer_epoch),)
+            )
             st = self._peers.get(conn.active_addr)
             if st is not None:
                 st.fails = 0
@@ -941,14 +1133,32 @@ class Cluster:
         else:
             if extra:
                 try:
-                    conn.peer_addr = codec.decode_addr(extra)
+                    conn.peer_addr, conn.peer_region, conn.peer_epoch = (
+                        codec.decode_hello(extra)
+                    )
                 except codec.CodecError:
                     self._drop(conn, Drop.HANDSHAKE)
                     return False
+                # the sender's session rid: every sequenced batch this
+                # conn delivers advances the applied vector under it
+                conn.peer_srid = sessions_mod.make_rid(
+                    str(conn.peer_addr), conn.peer_epoch
+                )
+                self._fold_regions(
+                    ((str(conn.peer_addr), conn.peer_region,
+                      conn.peer_epoch),)
+                )
                 self._inbound_contact(conn.peer_addr)
         conn.established = True
         self._mark_activity(conn)
         if active:
+            if not self._should_peer(conn.active_addr):
+                # the echo just taught us this peer is out of the sparse
+                # topology (an out-of-region non-bridge): prune now
+                # rather than carry a WAN conn policy forbids
+                self._stats["region_prunes"] += 1
+                self._drop(conn, Drop.REGION)
+                return False
             # we initiated: announce our membership view, replay the
             # peer's unacked delta window (the blip-sized heal: exactly
             # the missed batches, schema v8), then ask for missed state
@@ -958,8 +1168,13 @@ class Cluster:
             self._retransmit_unacked(conn)
             self._maybe_request_sync(conn)
         else:
-            # passive side echoes the signature back
-            conn.send_raw(self._wire(self._serial))
+            # passive side echoes the signature + its region/epoch back
+            conn.send_raw(
+                self._wire(
+                    self._serial
+                    + codec.encode_echo(self._region, self._epoch)
+                )
+            )
         return True
 
     # ---- message handling --------------------------------------------------
@@ -1049,8 +1264,12 @@ class Cluster:
             # Counted so the requester side of the sync conversation is
             # observable, not a silent ignore — then the range walk
             # continues if divergent buckets remain (each SyncDone
-            # closes one budgeted round).
+            # closes one budgeted round). A non-empty svec is the
+            # responder's digest-match proof (v10): byte-equal state
+            # means every write its vector covers is in ours — adopt.
             self._stats["sync_done_recv"] += 1
+            if msg.svec and self._sessions is not None:
+                self._sessions.adopt(dict(msg.svec))
             conn.range_inflight = False
             self._continue_ranges(conn)
             return
@@ -1061,13 +1280,17 @@ class Cluster:
             # range-scoped (or legacy full-state) sync data answering
             # our MsgSyncRequest / MsgRangeRequest: converge like any
             # push — the join is idempotent, so overlap with live
-            # deltas is harmless
+            # deltas is harmless. Unsequenced, so it advances no
+            # session watermark (the digest-match adoption is the sync
+            # path's session heal); the lane bridge still relays it
+            # (origin None) so siblings converge within the proactive
+            # cadence instead of a bus sync period.
             self._sync_rx_tick = self._tick  # mid-heal: defer serving dumps
             self._stats["sync_bytes_recv"] += nbytes
             await self._database.converge_async((msg.name, list(msg.batch)))
             self._record_push_lag(conn, origin_ms)
             if self.on_push is not None:
-                self.on_push(msg.name, list(msg.batch))
+                self.on_push(None, 0, msg.name, list(msg.batch))
             return
         self._log.err() and self._log.e(
             f"unexpected active message: {type(msg).__name__}"
@@ -1106,8 +1329,40 @@ class Cluster:
             self._send(conn, MsgDeltaAck(self._track_seq(conn, msg.seq)))
             await self._database.converge_async((msg.name, list(msg.batch)))
             self._record_push_lag(conn, origin_ms)
-            if self.on_push is not None:
-                self.on_push(msg.name, list(msg.batch))
+            # session watermark AFTER the converge completes (a waiter
+            # woken in between would serve a read the data has not
+            # reached), then the bridge re-export for first-sight
+            # content — the sender IS the origin on the direct path.
+            # The note rides the OWN-CONTENT ordinal (msg.oseq), never
+            # the transport seq: a bridge's relay frames consume
+            # transport seqs that downstream receivers can never
+            # observe under this rid, so transport-keyed watermarks
+            # would park forever one relay hop out (review find).
+            fresh = self._note_session(conn.peer_srid, msg.oseq)
+            await self._relay_fresh(
+                fresh, conn.peer_srid, msg.oseq, msg.name, msg.batch
+            )
+            return
+        if isinstance(msg, MsgRelayPush):
+            # the v10 origin-preserving relay: transport-wise exactly a
+            # SeqPush from this conn's sender (acked, interval-tracked,
+            # retransmittable), but the session watermark advances for
+            # the ORIGIN incarnation carried in the message — which is
+            # what lets a token minted in another region (or on another
+            # lane) verify here
+            self._stats["relays_recv"] += 1
+            self._send(conn, MsgDeltaAck(self._track_seq(conn, msg.seq)))
+            await self._database.converge_async((msg.name, list(msg.batch)))
+            self._record_push_lag(conn, origin_ms)
+            fresh = self._note_session(msg.origin, msg.oseq)
+            await self._relay_fresh(
+                fresh, msg.origin, msg.oseq, msg.name, msg.batch
+            )
+            return
+        if isinstance(msg, MsgRegionGossip):
+            # region membership gossip (v10): fold and let the next
+            # heartbeat's policy pass act on it (prune / dial)
+            self._fold_regions(msg.regions)
             return
         if isinstance(msg, MsgIntervalReset):
             # the sender's retransmit window lost our gap: re-base our
@@ -1175,7 +1430,7 @@ class Cluster:
             await self._database.converge_async((msg.name, list(msg.batch)))
             self._record_push_lag(conn, origin_ms)
             if self.on_push is not None:
-                self.on_push(msg.name, list(msg.batch))
+                self.on_push(None, 0, msg.name, list(msg.batch))
             return
         if isinstance(msg, MsgAnnounceAddrs):
             self._converge_addrs(msg.known_addrs)
@@ -1271,6 +1526,7 @@ class Cluster:
             conn.sync_served_tick = self._tick
             self._stats["sync_served"] += 1
             conn.sync_digests = tuple(msg.digests)
+            conn.sync_svec = tuple(msg.svec)
             self._sync_waiters.append(conn)
             if self._sync_dump_inflight:
                 return  # the running dump task will serve this waiter too
@@ -1338,6 +1594,72 @@ class Cluster:
         if conn is not None and conn.established:
             self._maybe_request_sync(conn)
 
+    # ---- sessions (schema v10) ---------------------------------------------
+
+    def _note_session(self, origin: str | None, seq: int) -> bool:
+        """Advance the node's applied-interval vector for one CONVERGED
+        sequenced batch of ``origin``'s stream; True when it was
+        first-sight (the bridge relay predicate). A conn whose
+        handshake carried no identity tracks nothing — safe: the vector
+        under-approximates and reads go STALE, never stale-served."""
+        if self._sessions is None or not origin:
+            return False
+        return self._sessions.note_applied(origin, seq)
+
+    async def _relay_fresh(
+        self, fresh: bool, origin: str | None, oseq: int, name: str, batch
+    ) -> None:
+        """Bridge re-export of one first-sight sequenced batch. Lane
+        bridge: the on_push hook hands it to the sibling mesh instance.
+        Region bridge: this instance re-broadcasts it into its own
+        conns (intra peers + other regions' bridges; receivers' own
+        first-sight checks stop echo loops). The dedup is BEST-EFFORT
+        at-most-once: a seq evicted from the bounded park (PARK_CAP
+        overflow) reads as first-sight again if redelivered, costing a
+        redundant relay — never a correctness problem (joins are
+        idempotent), and retransmit overlap in the common case costs
+        no WAN traffic. Broadcasting to ALL actives (intra dups
+        included) is deliberate: subset sends would punch seq gaps in
+        this sender's stream at the skipped receivers, churning the
+        interval machinery and stalling session watermarks — the
+        amplification tradeoff is documented in operations.md."""
+        if not fresh or not origin:
+            return
+        relay_lane = self.on_push is not None
+        relay_region = bool(self._region) and self._is_bridge()
+        if not (relay_lane or relay_region):
+            return
+        try:
+            # cluster.relay: the WAN seam. sleep injects inter-region
+            # RTT (pacing this conn like real WAN backpressure — the
+            # wan-converge bench's knob); drop/error lose the relay,
+            # healed by the periodic digest sync.
+            await faults.async_point("cluster.relay")
+        except faults.FaultError:
+            return
+        if relay_lane:
+            self.on_push(origin, oseq, name, list(batch))
+        if relay_region:
+            self.relay_deltas(origin, oseq, (name, list(batch)))
+
+    async def flush_now(self) -> None:
+        """Token minting's flush barrier (sessions.SessionIndex.bind):
+        drain the pending local deltas through the same sink the
+        heartbeat uses, awaited — every prior local write is sequenced
+        (and note_local'd) before SESSION TOKEN reads the vector, so
+        the minted token provably covers the client's writes."""
+        await self._database.flush_deltas_async(
+            self.flush_sink or self.broadcast_deltas
+        )
+
+    def _session_svec(self) -> tuple:
+        """The vector as sorted wire pairs — snapshotted BEFORE the sync
+        digests it travels with are computed, so it never claims more
+        than the digested state holds."""
+        if self._sessions is None:
+            return ()
+        return tuple(sorted(self._sessions.vector().items()))
+
     # ---- bootstrap / rejoin full-state sync --------------------------------
 
     def _maybe_request_sync(self, conn: _Conn) -> None:
@@ -1363,6 +1685,10 @@ class Cluster:
 
     async def _request_sync(self, conn: _Conn) -> None:
         try:
+            # session vector BEFORE the digests (v10): the responder
+            # adopts it only on a digest match, and the proof argument
+            # needs vector <= digested state
+            svec = self._session_svec()
             # O(keys-written-since-last-pass): the incremental digests
             # never dump the keyspace to produce these 5 x 32 bytes
             digests = await self._database.sync_type_digests_async()
@@ -1374,7 +1700,7 @@ class Cluster:
             self._log.info() and self._log.i(
                 f"sync: requesting state from {conn.active_addr}"
             )
-            self._send(conn, MsgSyncRequest(digests))
+            self._send(conn, MsgSyncRequest(digests, svec))
             self._sync_req_tick[conn.active_addr] = self._tick
         finally:
             self._sync_req_inflight.discard(conn.active_addr)
@@ -1541,6 +1867,7 @@ class Cluster:
         try:
             while self._sync_waiters:
                 waiters, self._sync_waiters = self._sync_waiters, []
+                svec_snap = self._session_svec()  # before the digests
                 mine = await self._database.sync_type_digests_async()
                 types = self._database.DATA_TYPES
                 sys_frames = await self._system_frames()
@@ -1567,10 +1894,18 @@ class Cluster:
                             st = self._peers.get(conn.peer_addr)
                             if st is not None:
                                 self._mark_dirty(st, False)
+                        # digest match = byte-equal state: adopt the
+                        # requester's vector, and reply with ours (the
+                        # one place MsgSyncDone carries a non-empty
+                        # svec) — the session heal both ways (v10)
+                        if self._sessions is not None and conn.sync_svec:
+                            self._sessions.adopt(dict(conn.sync_svec))
                         self._log.info() and self._log.i(
                             "sync: peer digest match, zero data frames"
                         )
-                        await self._stream_sync(conn, sys_frames)
+                        await self._stream_sync(
+                            conn, sys_frames, svec=svec_snap
+                        )
                         continue
                     self._log.info() and self._log.i(
                         f"sync: digest trees for {'+'.join(miss)}"
@@ -1657,11 +1992,13 @@ class Cluster:
         self._mark_activity(conn)
         return True
 
-    async def _stream_sync(self, conn: _Conn, frames: list[bytes]) -> None:
+    async def _stream_sync(
+        self, conn: _Conn, frames: list[bytes], svec: tuple = ()
+    ) -> None:
         for data in frames:
             if not await self._send_frame(conn, data):
                 return
-        self._send(conn, MsgSyncDone())
+        self._send(conn, MsgSyncDone(svec))
 
     def _converge_addrs(self, other: P2Set) -> None:
         """Membership gossip convergence with stale-name self-healing
@@ -1707,7 +2044,7 @@ class Cluster:
         stamp behind the seam's back."""
         return wire_frame(body, origin_ms=self._clock.now_ms())
 
-    def broadcast_deltas(self, deltas) -> None:
+    def broadcast_deltas(self, deltas):
         """The _SendDeltasFn sink (cluster.pony:209-213), schema v8:
         serialise the batch once, write to every established active
         connection. Content-carrying batches are SEQUENCED (MsgSeqPush
@@ -1718,7 +2055,9 @@ class Cluster:
         Anything already held ships FIRST (strict FIFO: a late-joining
         peer sees pre-join writes in flush order, never a fresh batch
         jumping the queue), and a fresh batch that cannot ship queues
-        behind them."""
+        behind them. Returns (own srid, assigned seq) for sequenced
+        content — the lane bridge's tee relays the SAME batch into the
+        sibling mesh under that origin — or (None, 0) for keepalives."""
         name, batch = deltas
         if batch and name != "SYSTEM":
             # outbound data deltas exist only for LOCAL applies: the
@@ -1730,12 +2069,61 @@ class Cluster:
             self._flush_held()
             if not self._held:
                 self._send_to_actives(data, expect_pong=True)
-            return
+            return None, 0
         self._delta_seq += 1
+        self._own_seq += 1
+        seq = self._delta_seq
         data = self._wire(
-            codec.encode(MsgSeqPush(self._delta_seq, name, tuple(batch)))
+            codec.encode(MsgSeqPush(seq, self._own_seq, name, tuple(batch)))
         )
-        self._log_delta(self._delta_seq, data)
+        if self._owns_session:
+            # every local write in this batch is now sequenced: the
+            # vector's own entry advances, which is what a token minted
+            # after the flush barrier reads (sessions.py). The vector
+            # tracks the OWN-CONTENT ordinal, not the transport seq —
+            # relay frames never consume it, so receivers (direct or
+            # relay-hops away) see a gapless stream per origin.
+            self._sessions.note_local(self._srid, self._own_seq)
+        self._ship_sequenced(seq, data)
+        return self._srid, self._own_seq
+
+    def relay_deltas(self, origin: str, oseq: int, deltas) -> None:
+        """Re-export one first-sight sequenced batch into THIS mesh
+        with origin attribution preserved (lane bridge: called by the
+        sibling instance's on_push / the tee; region bridge:
+        _relay_fresh). Transport-wise identical to broadcast_deltas'
+        sequenced path — the frame takes this sender's next seq, rides
+        the delta log, is acked and retransmitted — so receivers'
+        per-sender contiguity survives bridge fan-out; only the session
+        watermark semantics differ (the ORIGIN's, carried verbatim)."""
+        name, batch = deltas
+        self._delta_seq += 1
+        seq = self._delta_seq
+        self._stats["relays_sent"] += 1
+        data = self._wire(
+            codec.encode(MsgRelayPush(seq, origin, oseq, name, tuple(batch)))
+        )
+        self._ship_sequenced(seq, data)
+
+    def push_unsequenced(self, deltas) -> None:
+        """Best-effort unsequenced content push (MsgPushDeltas) to the
+        established actives — the lane bridge's carrier for relayed
+        SYNC data (origin None). Deliberately outside the seq/ack/
+        retransmit machinery AND the session surface: re-originating
+        sync data as this instance's own sequenced stream would mint
+        own-content ordinals that one side of the bridge can never
+        observe, stranding every token that references them (review
+        find). Loss is healed by the receivers' own periodic digest
+        syncs, exactly like any sync-dump frame."""
+        name, batch = deltas
+        data = self._wire(codec.encode(MsgPushDeltas(name, tuple(batch))))
+        self._send_to_actives(data, expect_pong=True)
+
+    def _ship_sequenced(self, seq: int, data: bytes) -> None:
+        """Common tail of the two sequenced send paths: log into the
+        retransmit window, flush anything held first (strict FIFO),
+        then broadcast-or-hold."""
+        self._log_delta(seq, data)
         self._flush_held()
         if self._held or not self._send_to_actives(data, expect_pong=True):
             # nobody reachable right now (maybe nobody known yet): hold

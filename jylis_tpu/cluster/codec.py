@@ -55,12 +55,14 @@ from .msg import (
     MsgPong,
     MsgPushDeltas,
     MsgRangeRequest,
+    MsgRegionGossip,
+    MsgRelayPush,
     MsgSeqPush,
     MsgSyncDone,
     MsgSyncRequest,
 )
 
-SCHEMA_VERSION = 9
+SCHEMA_VERSION = 10
 
 # The canonical schema text: any change to the wire format MUST change this
 # string (bump SCHEMA_VERSION), which changes the signature, which makes
@@ -130,23 +132,44 @@ SCHEMA_VERSION = 9
 # replica in every delivery schedule (ops/bcount.py). msg4's digest
 # order gains MAP,BCOUNT at the tail (positional vector, transport
 # level).
+# v10: sessions & regions — transport-only (delta lines unchanged, so
+# delta_signature() is UNCHANGED from v9: every existing snapshot and
+# journal loads as-is). The dialer's handshake suffix becomes a hello
+# (advertised address + region name + boot epoch) and the passive echo
+# answers with its own region + epoch: the epoch is what keys session
+# vectors per incarnation (a rebooted sender's restarted seq counter
+# must never alias its previous stream), the region is what the
+# region-aware peering policy classifies conns by. msg4/msg5 gain the
+# session vector (svec) for digest-match adoption — byte-equal state is
+# the proof that lets a whole vector fold across. msg7 gains the sender's own-content ordinal (oseq — the
+# session counter, gapless per origin because relay frames never
+# consume it; transport acks stay on seq). msg11 is the
+# origin-preserving relay (transport-sequenced like msg7, its name+batch
+# bytes msg3's after the prefix, with the ORIGIN incarnation's rid+seq
+# carried verbatim hop to hop — how a session token minted in one
+# region or lane verifies in another). msg12 gossips {addr -> region}
+# on the announce cadence so dial policy can classify addresses it
+# never met.
 _SCHEMA_TEXT = f"""jylis-tpu cluster schema v{SCHEMA_VERSION}
 varint=LEB128 bytes=varint-len-prefixed str=utf8-bytes
 wire=frame(crc32(origin_ms:u64be body):u32be origin_ms:u64be body)
-handshake=wire(sig:32B dialer-addr:addr?)
+handshake=wire(sig:32B hello:(dialer-addr:addr region:str epoch:varint)?) echo=wire(sig:32B region:str epoch:varint)
 addr=(host:str port:str name:str)
 p2set=(adds:[addr] removes:[addr])
+svec=[(rid:str seq:varint)]
 msg0=Pong
 msg1=ExchangeAddrs(p2set)
 msg2=AnnounceAddrs(p2set)
 msg3=PushDeltas(name:str batch:[(key:bytes delta)])
-msg4=SyncRequest(digests:[bytes] order=TREG,TLOG,GCOUNT,PNCOUNT,UJSON,TENSOR,MAP,BCOUNT)
-msg5=SyncDone
+msg4=SyncRequest(digests:[bytes] order=TREG,TLOG,GCOUNT,PNCOUNT,UJSON,TENSOR,MAP,BCOUNT svec)
+msg5=SyncDone(svec match-only)
 msg6=DeltaAck(cum:varint)
-msg7=SeqPush(seq:varint name:str batch:[(key:bytes delta)])
+msg7=SeqPush(seq:varint oseq:varint name:str batch:[(key:bytes delta)])
 msg8=DigestTree(name:str leaves:[(bucket:varint digest:bytes)] fanout=256 bucket=sha256(key)[0])
 msg9=RangeRequest(name:str buckets:[varint])
 msg10=IntervalReset(seq:varint)
+msg11=RelayPush(seq:varint origin:str oseq:varint name:str batch:[(key:bytes delta)])
+msg12=RegionGossip(regions:[(addr:str region:str epoch:varint)])
 delta/TREG=(value:bytes ts:varint)
 delta/TLOG=delta/SYSTEM=(entries:[(value:bytes ts:varint)] cutoff:varint)
 delta/GCOUNT=[(rid:varint v:varint)]
@@ -394,6 +417,68 @@ def decode_addr(data: bytes) -> Address:
     if not r.done():
         raise CodecError("trailing bytes after address")
     return a
+
+
+def encode_hello(a: Address, region: str, epoch: int) -> bytes:
+    """The dialer's v10 handshake suffix: advertised address + region
+    name + boot epoch (the session-rid incarnation stamp)."""
+    out = bytearray()
+    _w_addr(out, a)
+    _w_str(out, region)
+    _w_varint(out, epoch)
+    return bytes(out)
+
+
+def decode_hello(data: bytes) -> tuple[Address, str, int]:
+    r = _Reader(data)
+    a = _r_addr(r)
+    region = r.str_()
+    epoch = r.varint()
+    if epoch > _U64_MAX:
+        raise CodecError("hello epoch exceeds u64")
+    if not r.done():
+        raise CodecError("trailing bytes after hello")
+    return a, region, epoch
+
+
+def encode_echo(region: str, epoch: int) -> bytes:
+    """The passive side's v10 handshake echo suffix."""
+    out = bytearray()
+    _w_str(out, region)
+    _w_varint(out, epoch)
+    return bytes(out)
+
+
+def decode_echo(data: bytes) -> tuple[str, int]:
+    r = _Reader(data)
+    region = r.str_()
+    epoch = r.varint()
+    if epoch > _U64_MAX:
+        raise CodecError("echo epoch exceeds u64")
+    if not r.done():
+        raise CodecError("trailing bytes after echo")
+    return region, epoch
+
+
+def _w_svec(out: bytearray, entries: tuple) -> None:
+    # session vector: pre-sorted (rid, seq) pairs (sessions.py)
+    _w_varint(out, len(entries))
+    for rid, seq in entries:
+        _w_str(out, rid)
+        _w_varint(out, seq)
+
+
+def _r_svec(r: _Reader) -> tuple:
+    # accumulator deliberately NOT named `out`: pass 7's symbolic
+    # evaluator reads `out.append` as the byte-writer primitive
+    entries = []
+    for _ in range(r.varint()):
+        rid = r.str_()
+        seq = r.varint()
+        if seq > _U64_MAX:
+            raise CodecError("svec seq exceeds u64")
+        entries.append((rid, seq))
+    return tuple(entries)
 
 
 def _w_p2set(out: bytearray, s: P2Set) -> None:
@@ -656,6 +741,8 @@ _TAG_SEQ_PUSH = 7
 _TAG_DIGEST_TREE = 8
 _TAG_RANGE_REQ = 9
 _TAG_INTERVAL_RESET = 10
+_TAG_RELAY_PUSH = 11
+_TAG_REGION_GOSSIP = 12
 
 
 def encode(msg: Msg) -> bytes:
@@ -675,6 +762,20 @@ def encode(msg: Msg) -> bytes:
         if fast is not None:
             out = bytearray((_TAG_SEQ_PUSH,))
             _w_varint(out, msg.seq)
+            _w_varint(out, msg.oseq)
+            out += fast[1:]
+            return bytes(out)
+    elif isinstance(msg, MsgRelayPush):
+        # msg11's name+batch bytes are msg3's after the
+        # tag+seq+origin+oseq prefix (schema text), same native reuse
+        from ..native import codec as ncodec
+
+        fast = ncodec.encode_push(MsgPushDeltas(msg.name, msg.batch))
+        if fast is not None:
+            out = bytearray((_TAG_RELAY_PUSH,))
+            _w_varint(out, msg.seq)
+            _w_str(out, msg.origin)
+            _w_varint(out, msg.oseq)
             out += fast[1:]
             return bytes(out)
     return _encode_oracle(msg)
@@ -686,6 +787,7 @@ def _encode_oracle(msg: Msg) -> bytes:
         out.append(_TAG_PONG)
     elif isinstance(msg, MsgSyncDone):
         out.append(_TAG_SYNC_DONE)
+        _w_svec(out, msg.svec)
     elif isinstance(msg, MsgExchangeAddrs):
         out.append(_TAG_EXCHANGE)
         _w_p2set(out, msg.known_addrs)
@@ -704,12 +806,14 @@ def _encode_oracle(msg: Msg) -> bytes:
         _w_varint(out, len(msg.digests))
         for d in msg.digests:
             _w_bytes(out, d)
+        _w_svec(out, msg.svec)
     elif isinstance(msg, MsgDeltaAck):
         out.append(_TAG_DELTA_ACK)
         _w_varint(out, msg.cum)
     elif isinstance(msg, MsgSeqPush):
         out.append(_TAG_SEQ_PUSH)
         _w_varint(out, msg.seq)
+        _w_varint(out, msg.oseq)
         _w_str(out, msg.name)
         _w_varint(out, len(msg.batch))
         for key, delta in msg.batch:
@@ -731,6 +835,23 @@ def _encode_oracle(msg: Msg) -> bytes:
     elif isinstance(msg, MsgIntervalReset):
         out.append(_TAG_INTERVAL_RESET)
         _w_varint(out, msg.seq)
+    elif isinstance(msg, MsgRelayPush):
+        out.append(_TAG_RELAY_PUSH)
+        _w_varint(out, msg.seq)
+        _w_str(out, msg.origin)
+        _w_varint(out, msg.oseq)
+        _w_str(out, msg.name)
+        _w_varint(out, len(msg.batch))
+        for key, delta in msg.batch:
+            _w_bytes(out, key)
+            _w_delta(out, msg.name, delta)
+    elif isinstance(msg, MsgRegionGossip):
+        out.append(_TAG_REGION_GOSSIP)
+        _w_varint(out, len(msg.regions))
+        for addr_s, region, epoch in msg.regions:
+            _w_str(out, addr_s)
+            _w_str(out, region)
+            _w_varint(out, epoch)
     else:
         raise CodecError(f"cannot encode {type(msg).__name__}")
     return bytes(out)
@@ -751,10 +872,29 @@ def decode(body: bytes) -> Msg:
         r = _Reader(body)
         r.pos = 1
         seq = r.varint()
+        oseq = r.varint()
+        if seq > _U64_MAX or oseq > _U64_MAX:
+            raise CodecError("seq exceeds u64")
         rest = bytes((_TAG_PUSH,)) + body[r.pos :]
         fast = ncodec.decode_push(rest)
         inner = fast if fast is not None else _decode_oracle(rest)
-        return MsgSeqPush(seq, inner.name, inner.batch)
+        return MsgSeqPush(seq, oseq, inner.name, inner.batch)
+    elif body and body[0] == _TAG_RELAY_PUSH:
+        # same trick for the relay: strip tag+seq+origin+oseq, decode
+        # the remainder as msg3, re-tag
+        from ..native import codec as ncodec
+
+        r = _Reader(body)
+        r.pos = 1
+        seq = r.varint()
+        origin = r.str_()
+        oseq = r.varint()
+        if seq > _U64_MAX or oseq > _U64_MAX:
+            raise CodecError("relay seq exceeds u64")
+        rest = bytes((_TAG_PUSH,)) + body[r.pos :]
+        fast = ncodec.decode_push(rest)
+        inner = fast if fast is not None else _decode_oracle(rest)
+        return MsgRelayPush(seq, origin, oseq, inner.name, inner.batch)
     return _decode_oracle(body)
 
 
@@ -767,7 +907,7 @@ def _decode_oracle(body: bytes) -> Msg:
     if tag == _TAG_PONG:
         msg: Msg = MsgPong()
     elif tag == _TAG_SYNC_DONE:
-        msg = MsgSyncDone()
+        msg = MsgSyncDone(_r_svec(r))
     elif tag == _TAG_EXCHANGE:
         msg = MsgExchangeAddrs(_r_p2set(r))
     elif tag == _TAG_ANNOUNCE:
@@ -779,16 +919,20 @@ def _decode_oracle(body: bytes) -> Msg:
         )
         msg = MsgPushDeltas(name, batch)
     elif tag == _TAG_SYNC_REQ:
-        msg = MsgSyncRequest(tuple(r.bytes_() for _ in range(r.varint())))
+        digests = tuple(r.bytes_() for _ in range(r.varint()))
+        msg = MsgSyncRequest(digests, _r_svec(r))
     elif tag == _TAG_DELTA_ACK:
         msg = MsgDeltaAck(r.varint())
     elif tag == _TAG_SEQ_PUSH:
         seq = r.varint()
+        oseq = r.varint()
+        if seq > _U64_MAX or oseq > _U64_MAX:
+            raise CodecError("seq exceeds u64")
         name = r.str_()
         batch = tuple(
             (r.bytes_(), _r_delta(r, name)) for _ in range(r.varint())
         )
-        msg = MsgSeqPush(seq, name, batch)
+        msg = MsgSeqPush(seq, oseq, name, batch)
     elif tag == _TAG_DIGEST_TREE:
         name = r.str_()
         leaves = tuple(
@@ -801,6 +945,27 @@ def _decode_oracle(body: bytes) -> Msg:
         msg = MsgRangeRequest(name, buckets)
     elif tag == _TAG_INTERVAL_RESET:
         msg = MsgIntervalReset(r.varint())
+    elif tag == _TAG_RELAY_PUSH:
+        seq = r.varint()
+        origin = r.str_()
+        oseq = r.varint()
+        if seq > _U64_MAX or oseq > _U64_MAX:
+            raise CodecError("relay seq exceeds u64")
+        name = r.str_()
+        batch = tuple(
+            (r.bytes_(), _r_delta(r, name)) for _ in range(r.varint())
+        )
+        msg = MsgRelayPush(seq, origin, oseq, name, batch)
+    elif tag == _TAG_REGION_GOSSIP:
+        entries = []
+        for _ in range(r.varint()):
+            addr_s = r.str_()
+            region = r.str_()
+            epoch = r.varint()
+            if epoch > _U64_MAX:
+                raise CodecError("gossip epoch exceeds u64")
+            entries.append((addr_s, region, epoch))
+        msg = MsgRegionGossip(tuple(entries))
     else:
         raise CodecError(f"unknown message tag: {tag}")
     if not r.done():

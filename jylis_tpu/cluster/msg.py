@@ -34,7 +34,16 @@ class MsgSyncDone:
     round-trip histogram stays exact: every Pong the active side
     receives then answers a stamped push/announce send in FIFO order,
     and sync replies — whose timing includes digest computation or a
-    whole dump stream — never consume a round-trip stamp."""
+    whole dump stream — never consume a round-trip stamp.
+
+    Schema v10: carries the responder's session vector — NON-EMPTY ONLY
+    on the digest-match branch, where byte-equal state proves every
+    write the responder's vector covers is in the requester's state too
+    (the adoption rule sessions.py relies on; any other branch sends it
+    empty). This is how a fresh joiner's session index bootstraps and
+    how a rebooted origin re-learns its own pre-crash watermark."""
+
+    svec: tuple = ()  # tuple[(rid: str, seq: int), ...]
 
 
 @dataclass(frozen=True)
@@ -68,9 +77,20 @@ class MsgSeqPush:
     delta-interval algorithm). Content-free keepalives (the SYSTEM
     deltas_size()==1 quirk) stay unsequenced MsgPushDeltas: sequencing
     them would burn retransmit-window slots on frames that carry
-    nothing."""
+    nothing.
+
+    Schema v10: also carries ``oseq``, the sender's OWN-CONTENT ordinal
+    — a second counter that ticks only for the sender's own batches,
+    never for the relay frames a bridge interleaves into its transport
+    stream. Session vectors (sessions.py) track oseq, not seq: oseq is
+    gapless per origin, so the same contiguity rule works at direct
+    receivers AND transitively through any number of relay hops, where
+    the intermediate bridges' transport-seq consumption is invisible.
+    The transport machinery (acks, retransmit, _recv_cum) stays on
+    ``seq``."""
 
     seq: int
+    oseq: int
     name: str
     batch: tuple  # tuple[(key: bytes, delta), ...]
 
@@ -147,9 +167,54 @@ class MsgSyncRequest:
     TENSOR, MAP, BCOUNT — models/database.py DATA_REPO_CLASSES —
     SYSTEM excluded: its log advances on connection events themselves,
     which would make two in-sync peers never match). Each is the XOR of
-    sha256(canonical per-key state) over the type's keys."""
+    sha256(canonical per-key state) over the type's keys.
+
+    Schema v10: also carries the requester's session vector, snapshotted
+    BEFORE its digests were computed (so the vector never claims more
+    than the digested state holds). On a digest match the responder
+    adopts it — the symmetric half of MsgSyncDone's svec."""
 
     digests: tuple = ()
+    svec: tuple = ()  # tuple[(rid: str, seq: int), ...]
+
+
+@dataclass(frozen=True)
+class MsgRelayPush:
+    """Schema v10 origin-preserving relay: a MsgSeqPush whose content
+    ORIGINATED at another replica, re-exported by a bridge (a region
+    bridge between WAN meshes, or lane 0 between the lane bus and the
+    external mesh). ``seq`` is the RELAYING sender's transport seq —
+    the frame rides its delta log, is acked by MsgDeltaAck and
+    retransmitted on reconnect exactly like a SeqPush, so transport
+    contiguity per sender is preserved even though bridges fan subsets
+    of traffic. ``origin``/``oseq`` are the originating incarnation's
+    rid (sessions.make_rid) and ITS batch seq, carried verbatim hop to
+    hop: receivers advance their session vector for the ORIGIN, which
+    is what lets a session token minted in one region verify in
+    another. name+batch bytes are msg3's after the prefix (native codec
+    fast path serves the relay hot path too)."""
+
+    seq: int
+    origin: str
+    oseq: int
+    name: str
+    batch: tuple  # tuple[(key: bytes, delta), ...]
+
+
+@dataclass(frozen=True)
+class MsgRegionGossip:
+    """Region membership gossip (schema v10): (advertised address,
+    region name, epoch) triples, broadcast on the announce cadence.
+    Regions also ride the handshake; the gossip is what lets a node
+    classify addresses it has never dialed (the region-aware peering
+    policy needs every KNOWN address's region to pick the
+    deterministic bridge and prune out-of-region dials). Each entry is
+    VERSIONED by the subject node's boot epoch and folds
+    highest-epoch-wins — unversioned gossip would let stale maps
+    oscillate the cluster's classification (and so bridge election)
+    forever after a node's region changes across a restart."""
+
+    regions: tuple = ()  # tuple[(addr: str, region: str, epoch: int), ...]
 
 
 Msg = (
@@ -164,4 +229,6 @@ Msg = (
     | MsgDigestTree
     | MsgRangeRequest
     | MsgIntervalReset
+    | MsgRelayPush
+    | MsgRegionGossip
 )

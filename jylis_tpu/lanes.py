@@ -127,6 +127,14 @@ def bus_config(config, lane_id: int):
     cfg.system_log_trim = config.system_log_trim
     cfg.dial_timeout = config.dial_timeout
     cfg.dial_backoff_cap = config.dial_backoff_cap
+    # the bus instance MINTS session tokens (it is the driving cluster
+    # that binds the lane's SessionIndex), so it needs the boot-epoch
+    # sidecar floor too: the supervisor reuses bus ports across lane
+    # respawns, and without the floor a backwards clock step across a
+    # respawn could re-mint a used epoch and alias the old stream
+    # (review find). Across SUPERVISOR restarts the ports (and so the
+    # rids) change anyway, which is safe by construction.
+    cfg.data_dir = config.data_dir
     cfg.log = config.log
     return cfg
 
@@ -152,20 +160,45 @@ def list_snapshots(data_dir: str) -> list[str]:
 def wire_bridge(bus, external) -> None:
     """Lane 0's two-mesh bridge. The bus instance drives the one
     database flush and tees it to both meshes; each mesh relays the
-    pushes it converged onto the other. Relay cannot echo: converge
-    never re-exports, and only lane 0 relays."""
+    first-sight pushes it converged onto the other. Relay cannot echo:
+    the session index's first-sight check dedupes per (origin, seq),
+    and only lane 0 relays.
+
+    Schema v10: relays preserve ORIGIN attribution (MsgRelayPush). The
+    tee ships the lane's own flush into the external mesh under its bus
+    rid + bus seq — so an external peer's applied vector tracks the
+    exact stream a token minted on this lane references — and each
+    mesh's converged sequenced pushes cross over with their origin
+    rid/seq intact. Unsequenced sync data (origin None) still crosses
+    as a plain broadcast: it advances no session watermark, but keeps
+    rejoin heals flowing between the meshes at the old cadence."""
 
     def tee(deltas) -> None:
-        bus.broadcast_deltas(deltas)
-        external.broadcast_deltas(deltas)
+        origin, oseq = bus.broadcast_deltas(deltas)
+        if origin is not None:
+            external.relay_deltas(origin, oseq, deltas)
+        else:
+            # content-free keepalives: the broadcast path's own
+            # unsequenced branch handles them
+            external.broadcast_deltas(deltas)
+
+    def relay_to(other):
+        def relay(origin, oseq, name, batch) -> None:
+            if origin is not None:
+                other.relay_deltas(origin, oseq, (name, batch))
+            else:
+                # relayed SYNC data (rejoin heals, range repairs):
+                # UNSEQUENCED on purpose — re-originating it as
+                # `other`'s own stream would consume own-content
+                # ordinals that the far side of the bridge can never
+                # observe, stranding tokens that reference them
+                other.push_unsequenced((name, batch))
+
+        return relay
 
     bus.flush_sink = tee
-    bus.on_push = lambda name, batch: external.broadcast_deltas(
-        (name, batch)
-    )
-    external.on_push = lambda name, batch: bus.broadcast_deltas(
-        (name, batch)
-    )
+    bus.on_push = relay_to(external)
+    external.on_push = relay_to(bus)
 
 
 class LaneClusters:

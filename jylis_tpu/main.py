@@ -186,6 +186,9 @@ async def run(argv: list[str] | None = None) -> None:
     # jlint: blocking-ok — pre-serving boot; warmup above already built
     # and memoised the native lib, so this resolves from cache
     database = Database(identity=identity, system_repo=system.repo)
+    # session-guarantee + admission-control knobs (docs/sessions.md)
+    database.session_wait_ms = config.session_wait_ms
+    database.set_admission_cap(config.admission_cap)
     log = config.log
     if lane_id is not None:
         # SYSTEM METRICS' LANE section: which lane this connection
@@ -260,6 +263,9 @@ async def run(argv: list[str] | None = None) -> None:
     server = Server(config, database)
     lane_tick_task = None
     if lane_id is None:
+        # jlint: blocking-ok — Cluster construction reads/writes the
+        # tiny boot-epoch sidecar (pre-serving boot, no clients on the
+        # loop yet; cluster.py Cluster._boot_epoch)
         cluster = Cluster(config, database)
     else:
         from . import lanes as lanes_mod
@@ -268,6 +274,8 @@ async def run(argv: list[str] | None = None) -> None:
         # framing, CRC, delta broadcast, digest-checked rejoin sync and
         # dial backoff all inherited. Lane 0 additionally runs the
         # node's ONE external cluster identity and bridges the meshes.
+        # jlint: blocking-ok — Cluster construction reads/writes the
+        # tiny boot-epoch sidecar (pre-serving boot, no clients yet)
         bus = Cluster(
             lanes_mod.bus_config(config, lane_id),
             database,
@@ -275,6 +283,7 @@ async def run(argv: list[str] | None = None) -> None:
         )
         external = None
         if lane_id == 0:
+            # jlint: blocking-ok — same pre-serving epoch-sidecar I/O
             external = Cluster(config, database, drive_flush=False)
             lanes_mod.wire_bridge(bus, external)
         cluster = lanes_mod.LaneClusters(bus, external)

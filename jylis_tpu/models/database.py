@@ -11,7 +11,16 @@ import asyncio
 import hashlib
 from contextlib import AsyncExitStack, asynccontextmanager
 
+from .. import sessions as sessions_mod
 from .help import DATATYPE_HELP, respond_help
+
+SESSION_HELP = """\
+The following are valid SESSION commands (docs/sessions.md):
+  SESSION TOKEN                 - mint this node's session token
+  SESSION WRAP <command...>     - apply a command, reply [reply, token]
+  SESSION READ <token> <command...> - serve once the token is covered
+                                  (bounded wait, then a STALE error),
+                                  reply [token', reply]"""
 
 # keyspace-range fanout for the anti-entropy digest tree (schema v8):
 # every key lands in one of 256 stable buckets by the first byte of
@@ -74,6 +83,14 @@ class Database:
         # process-global dicts in utils/metrics.py cross-talked between
         # Databases in one process, which this retires.
         self.metrics = MetricsRegistry()
+        # session guarantees (sessions.py): the node's applied-interval
+        # vector + waiter queue, fed by the cluster engine and served by
+        # the SESSION command family below. session_wait_ms is the
+        # bounded-wait knob (--session-wait-ms); admission_cap the
+        # per-command-class inflight cap (--admission-cap, 0 = off),
+        # pushed onto every manager by set_admission_cap.
+        self.sessions = sessions_mod.SessionIndex()
+        self.session_wait_ms = sessions_mod.SESSION_WAIT_MS_DEFAULT
         self.system = system_repo if system_repo is not None else RepoSYSTEM(identity)
         # ONE native engine shared by every data repo AND the server's
         # batch applier (server/server.py): single source of host truth.
@@ -95,9 +112,11 @@ class Database:
             # timed_drain resolves the registry through this attribute,
             # so drain counters/histograms land per-Database
             repo.metrics = self.metrics
-            self._map[repo.name.encode()] = RepoManager(
+            mgr = RepoManager(
                 repo.name, repo, repo.help, served=self._served_py
             )
+            mgr.registry = self.metrics  # admission BUSY refusal counts
+            self._map[repo.name.encode()] = mgr
 
         # incremental sync digest (round-5 verdict item 2): per data type,
         # a map of key -> sha256(canonical per-key state) and the running
@@ -141,6 +160,8 @@ class Database:
         # TYPE before walking its ranges; same two-path wiring as the
         # combined digest
         self.system.digest_types_fn = self._sync_digest_types_blocking
+        # SYSTEM METRICS' SESSION section (token/read/refusal counters)
+        self.system.session_fn = self.sessions.metrics_totals
 
     def _served_totals(self) -> dict[str, int]:
         """Commands served per type on BOTH paths (SYSTEM METRICS)."""
@@ -164,6 +185,7 @@ class Database:
             "native_cmds": native,
             "demoted_cmds": sum(self._served_py.values()),
             "demotions": self.metrics.serving_counters["demotions"],
+            "busy_refusals": self.metrics.serving_counters["busy_refusals"],
         }
 
     def _sync_update_repo(self, name: str, repo) -> None:
@@ -279,6 +301,66 @@ class Database:
             self._sync_update_repo(name, self._map[name.encode()].repo)
         return [(n, self._sync_xor[n]) for n in self.DATA_TYPES]
 
+    def set_admission_cap(self, cap: int) -> None:
+        """Per-command-class admission control (--admission-cap): each
+        data-type manager refuses lock-queued commands past ``cap``
+        in flight with a typed BUSY error, so one hot key's drain
+        backlog degrades ITS command class, never the node. 0 = off."""
+        for mgr in self._map.values():
+            mgr.admission_cap = cap
+
+    # ---- session guarantees (sessions.py, docs/sessions.md) ---------------
+
+    async def _mint_token(self) -> bytes:
+        """Force the pending local deltas through the cluster flush
+        path (so every prior write on this connection is sequenced and
+        the vector's own entry covers it), then encode the vector."""
+        if self.sessions.flush_fn is not None:
+            await self.sessions.flush_fn()
+        self.sessions.stats["tokens_minted"] += 1
+        return self.sessions.token_bytes()
+
+    async def _apply_session(self, resp, cmd: list[bytes]) -> None:
+        sess = self.sessions
+        op = cmd[1] if len(cmd) > 1 else b""
+        if op == b"TOKEN" and len(cmd) == 2:
+            resp.string(await self._mint_token())
+            return
+        if op == b"WRAP" and len(cmd) > 2 and cmd[2] != b"SESSION":
+            # the write reply carries the session token: one reply
+            # array of [inner reply, token], the token minted AFTER the
+            # inner command applied and flushed — read-your-writes
+            # portable from this ack onward
+            resp.array_start(2)
+            await self.apply_async(resp, cmd[2:])
+            resp.string(await self._mint_token())
+            return
+        if op == b"READ" and len(cmd) > 3 and cmd[3] != b"SESSION":
+            try:
+                token = sessions_mod.decode_token_memo(bytes(cmd[2]))
+            except sessions_mod.SessionError as e:
+                sess.stats["badtoken_refusals"] += 1
+                resp.err(f"BADTOKEN (unusable session token: {e})")
+                return
+            if not await sess.wait_dominated(token, self.session_wait_ms):
+                sess.stats["stale_refusals"] += 1
+                resp.err(
+                    "STALE (session token not covered within "
+                    f"{self.session_wait_ms}ms; retry here later or "
+                    "read where you wrote)"
+                )
+                return
+            sess.stats["reads_served"] += 1
+            # monotonic reads: the reply token is the join of what the
+            # client presented and what this replica has verified — and
+            # a SERVED read's vector dominates the token, so the join
+            # IS the vector (memoised bytes, not a fresh encode)
+            resp.array_start(2)
+            resp.string(sess.token_bytes())
+            await self.apply_async(resp, cmd[3:])
+            return
+        respond_help(resp, SESSION_HELP)
+
     def set_journal(self, journal) -> None:
         """Attach the delta write-ahead journal (journal/): every repo's
         flushed delta batches append to it before reaching the network
@@ -305,6 +387,13 @@ class Database:
 
     async def apply_async(self, resp, cmd: list[bytes]) -> None:
         """Serving path: per-repo locking + threaded drains (manager.py)."""
+        if cmd and cmd[0] == b"SESSION":
+            # session-guarantee surface (sessions.py): python-path only
+            # — the native engine defers unknown first words, so a
+            # session command rides the same per-repo async machinery
+            # its inner command needs anyway
+            await self._apply_session(resp, cmd)
+            return
         if (
             len(cmd) == 3
             and cmd[0] == b"SYSTEM"

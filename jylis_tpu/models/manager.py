@@ -78,6 +78,15 @@ class RepoManager:
         self._last_proactive = None
         self._shutdown = False
         self._lock = asyncio.Lock()
+        # admission control (Database.set_admission_cap): commands of
+        # THIS class queued behind the repo lock past the cap are
+        # refused with a typed BUSY instead of queuing without bound —
+        # a hot key whose drains back the lock up degrades its own
+        # command class, never the node. 0 = off (default). The
+        # registry counts refusals (SERVING busy_refusals).
+        self.admission_cap = 0
+        self.registry = None
+        self._inflight = 0
         # delta write-ahead journal (journal/journal.py), attached via
         # Database.set_journal: every flushed batch is handed to the
         # journal's writer thread before it reaches the network sink —
@@ -126,22 +135,40 @@ class RepoManager:
                 if self._apply_core(resp, cmd):
                     self._maybe_proactive_flush()
                 return
-        async with self._lock:
-            if self._shutdown:
-                # shutdown won the lock race while we queued behind a
-                # drain: the final flush already ran — accepting now
-                # would acknowledge a write that never replicates
-                resp.err(SHUTDOWN_ERR)
-                return
-            may = getattr(self.repo, "may_drain", None)
-            if may is not None and may(cmd[1:]):
-                replay = _ReplayResp()
-                changed = await asyncio.to_thread(self._apply_core, replay, cmd)
-                replay.replay(resp)
-            else:
-                changed = self._apply_core(resp, cmd)
-            if changed:
-                self._maybe_proactive_flush()
+        if self.admission_cap and self._inflight >= self.admission_cap:
+            # only lock-queued commands count as inflight (the inline
+            # fast path above never queues), so the cap binds exactly
+            # when this class is backed up behind its own drains
+            if self.registry is not None:
+                self.registry.note_serving("busy_refusals")
+                self.registry.trace_event("serving", "busy", "", self.name)
+            resp.err(
+                f"BUSY ({self.name} admission cap {self.admission_cap} "
+                "reached; this command class is backed up — retry)"
+            )
+            return
+        self._inflight += 1
+        try:
+            async with self._lock:
+                if self._shutdown:
+                    # shutdown won the lock race while we queued behind a
+                    # drain: the final flush already ran — accepting now
+                    # would acknowledge a write that never replicates
+                    resp.err(SHUTDOWN_ERR)
+                    return
+                may = getattr(self.repo, "may_drain", None)
+                if may is not None and may(cmd[1:]):
+                    replay = _ReplayResp()
+                    changed = await asyncio.to_thread(
+                        self._apply_core, replay, cmd
+                    )
+                    replay.replay(resp)
+                else:
+                    changed = self._apply_core(resp, cmd)
+                if changed:
+                    self._maybe_proactive_flush()
+        finally:
+            self._inflight -= 1
 
     # keys converged per event-loop slice: a multi-thousand-key batch (a
     # sync dump chunk, a post-load flush) converged in one go blocks the

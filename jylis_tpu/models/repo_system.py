@@ -53,6 +53,10 @@ class RepoSYSTEM:
         # CLUSTER section (peer states, dials/fails, evictions by
         # reason, sync served/deferred, held-delta drops)
         self.cluster_fn = None
+        # the Database wires this to its SessionIndex's counters for
+        # the SESSION section (tokens minted, STALE/BADTOKEN refusals,
+        # adoption events — docs/sessions.md)
+        self.session_fn = None
         # ... and this to its per-peer convergence-lag view (push→apply
         # EWMA per sender) for the SYSTEM LATENCY per-peer lines
         self.lag_fn = None
@@ -100,6 +104,7 @@ class RepoSYSTEM:
                 self.cluster_fn() if self.cluster_fn else None,
                 registry=self.metrics,
                 lane=self.lane_fn() if self.lane_fn else None,
+                session=self.session_fn() if self.session_fn else None,
             )
             resp.array_start(len(lines))
             for line in lines:

@@ -42,10 +42,26 @@ def render(database) -> str:
 
     out.append("# TYPE jylis_serving_total counter")
     serving = system.serving_fn() if system.serving_fn else {}
-    for key in ("native_cmds", "demoted_cmds", "demotions"):
+    for key in ("native_cmds", "demoted_cmds", "demotions", "busy_refusals"):
         out.append(
             f'jylis_serving_total{{kind="{key}"}} {serving.get(key, 0)}'
         )
+
+    session = system.session_fn() if system.session_fn else {}
+    if session:
+        # the section mixes monotone counters with two live gauges —
+        # split the exposition so rate()/increase() stay meaningful
+        _SESSION_GAUGES = ("origins", "parked_seqs")
+        out.append("# TYPE jylis_session_total counter")
+        for key, v in sorted(session.items()):
+            if key not in _SESSION_GAUGES:
+                out.append(f'jylis_session_total{{kind="{_esc(key)}"}} {v}')
+        out.append("# TYPE jylis_session gauge")
+        for key in _SESSION_GAUGES:
+            if key in session:
+                out.append(
+                    f'jylis_session{{key="{_esc(key)}"}} {session[key]}'
+                )
 
     out.append("# TYPE jylis_journal_total counter")
     for key, n in reg.journal_counters.items():

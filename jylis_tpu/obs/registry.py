@@ -51,7 +51,11 @@ class MetricsRegistry:
         # from boot instead of appearing at the first nonzero counter
         self.journal_enabled = False
         # serving-path: whole-connection demotions off the native engine
-        self.serving_counters: dict[str, int] = {"demotions": 0}
+        # + per-command-class admission-control refusals (manager.py)
+        self.serving_counters: dict[str, int] = {
+            "demotions": 0,
+            "busy_refusals": 0,
+        }
         self.hists: dict[str, Histogram] = {name: Histogram() for name in SEAMS}
         self.gauges: dict[str, float] = {name: 0.0 for name in GAUGES}
         self.trace = TraceRing(trace_cap)

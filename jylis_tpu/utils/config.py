@@ -49,6 +49,19 @@ class Config:
     # served per round, the rejoin pacing knob)
     delta_log_cap: int = 1024
     range_budget: int = 64
+    # extension: region-aware WAN peering (cluster.py, schema v10) —
+    # empty (default) keeps the classic full mesh; a named region joins
+    # its intra-region full mesh, with one deterministic bridge per
+    # region speaking WAN (docs/operations.md, "Regions")
+    region: str = ""
+    # extension: session guarantees (sessions.py, docs/sessions.md) —
+    # how long a SESSION READ may wait for its token to be covered
+    # before the typed STALE refusal
+    session_wait_ms: int = 500
+    # extension: per-command-class admission control (models/manager.py)
+    # — commands of one data type queued behind its repo lock past this
+    # cap get a typed BUSY refusal; 0 (default) disables
+    admission_cap: int = 0
     # extension: deterministic fault injection (faults.py); same syntax
     # as the JYLIS_FAILPOINTS env var, armed at startup
     failpoints: str = ""
@@ -176,6 +189,31 @@ def config_from_cli(argv: list[str] | None = None, log_out=None) -> Config:
         "cannot starve serving (docs/replication.md).",
     )
     parser.add_argument(
+        "--region", default="",
+        help="This node's region name for WAN-aware peering (schema "
+        "v10): nodes of one region keep a cheap full mesh; exactly one "
+        "deterministic bridge per region (the lexicographically "
+        "smallest advertised address) dials the other regions' "
+        "bridges and relays traffic with origin attribution preserved. "
+        "Empty (default) keeps the classic full mesh. All nodes of a "
+        "deployment should either set regions or not mix.",
+    )
+    parser.add_argument(
+        "--session-wait-ms", type=int, default=Config.session_wait_ms,
+        help="Bounded wait for SESSION READ: how long a read holding a "
+        "session token may wait for this replica's applied-interval "
+        "vector to cover it before the typed STALE refusal "
+        "(docs/sessions.md).",
+    )
+    parser.add_argument(
+        "--admission-cap", type=int, default=Config.admission_cap,
+        help="Per-command-class admission control: commands of one data "
+        "type queued behind its repo lock past this cap are refused "
+        "with a typed BUSY error, so a hot key's drain backlog "
+        "degrades its own command class instead of the node. 0 "
+        "(default) disables.",
+    )
+    parser.add_argument(
         "--failpoints", default="",
         help="Deterministic fault injection spec, e.g. "
         "'cluster.dial=error:3,journal.fsync=sleep:0.2' "
@@ -248,6 +286,9 @@ def config_from_cli(argv: list[str] | None = None, log_out=None) -> Config:
     config.dial_backoff_cap = args.dial_backoff_cap
     config.delta_log_cap = args.delta_log_cap
     config.range_budget = args.range_budget
+    config.region = args.region
+    config.session_wait_ms = args.session_wait_ms
+    config.admission_cap = args.admission_cap
     config.failpoints = args.failpoints
     config.metrics_port = args.metrics_port
     if args.lanes == "auto":

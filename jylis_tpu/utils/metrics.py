@@ -131,6 +131,7 @@ def metric_lines(
     cluster: dict[str, int] | None = None,
     registry: MetricsRegistry | None = None,
     lane: dict[str, int] | None = None,
+    session: dict[str, int] | None = None,
 ) -> list[str]:
     """Flat `type counter value` lines — the SYSTEM METRICS reply body.
     ``served`` is the serving node's per-type commands-served totals
@@ -158,12 +159,20 @@ def metric_lines(
         lines.insert(0, f"LANE count {lane.get('count', 0)}")
         lines.insert(0, f"LANE id {lane.get('id', 0)}")
     if serving and any(serving.values()):
-        for k in ("native_cmds", "demoted_cmds", "demotions"):
+        for k in ("native_cmds", "demoted_cmds", "demotions", "busy_refusals"):
             lines.append(f"SERVING {k} {serving.get(k, 0)}")
         total = serving.get("native_cmds", 0) + serving.get("demoted_cmds", 0)
         if total:
             frac = serving.get("demoted_cmds", 0) / total
             lines.append(f"SERVING fallback_frac {frac:.4f}")
+    if session is not None and any(session.values()):
+        # session-guarantee counters (sessions.py): tokens minted,
+        # reads served/waited, typed STALE/BADTOKEN refusals, adoption
+        # events and the vector's live size — glossary in
+        # docs/operations.md, contracts in docs/sessions.md
+        lines.extend(
+            f"SESSION {k} {v}" for k, v in sorted(session.items())
+        )
     if cluster is not None:
         # insertion order (states first, then counters) — a glossary
         # order, kept stable for dashboards

@@ -181,6 +181,23 @@ CLAIMS = [
      fmt_percent, "a {} refusal rate", "bcount doc refusal rate"),
     ("README.md", "bcount-contention", "local_grants_per_sec", fmt_millions,
      "escrow-checked spends at {} grants/sec", "README bcount rate"),
+    # sessions & regions round (schema v10): the session path's tax on
+    # plain serving latency (the <= 5% acceptance bar), and the
+    # multi-region convergence lag against injected WAN RTT — pinned
+    # in docs/sessions.md / operations.md and the README headline
+    ("docs/sessions.md", "workload-zipf", "serving_latency_overhead_frac",
+     lambda v: f"{v * 100:.2f}%", "measured at {} (bar: 5%)",
+     "sessions doc serving-latency overhead"),
+    ("README.md", "workload-zipf", "serving_latency_overhead_frac",
+     lambda v: f"{v * 100:.2f}%", "a {} serving-latency tax",
+     "README session overhead"),
+    ("docs/operations.md", "wan-converge", "value",
+     lambda v: f"{v:.1f} ms", "lag of {} at 80 ms injected RTT",
+     "operations doc wan lag at 80ms"),
+    ("docs/operations.md", "wan-converge", "base_lag_ms",
+     lambda v: f"{v:.1f} ms", "a {} relay-path base", "operations doc wan base lag"),
+    ("README.md", "wan-converge", "value",
+     lambda v: f"{v:.1f} ms", "converges in {} under 80 ms", "README wan lag"),
 ]
 
 

@@ -86,6 +86,10 @@ TAG_UNITS = {
     "_TAG_DIGEST_TREE": "DigestTree",
     "_TAG_RANGE_REQ": "RangeRequest",
     "_TAG_INTERVAL_RESET": "IntervalReset",
+    # schema v10 (sessions & regions): the origin-preserving relay and
+    # the region-membership gossip
+    "_TAG_RELAY_PUSH": "RelayPush",
+    "_TAG_REGION_GOSSIP": "RegionGossip",
 }
 
 DELTA_TYPES = (
@@ -838,6 +842,8 @@ def build_corpus() -> dict:
         MsgPong,
         MsgPushDeltas,
         MsgRangeRequest,
+        MsgRegionGossip,
+        MsgRelayPush,
         MsgSeqPush,
         MsgSyncDone,
         MsgSyncRequest,
@@ -875,23 +881,40 @@ def build_corpus() -> dict:
 
     messages = {
         "msg/Pong": MsgPong(),
-        "msg/SyncDone": MsgSyncDone(),
+        "msg/SyncDone": MsgSyncDone(
+            (("h1:6001:n1!7", 300),)  # v10 digest-match svec, non-empty
+        ),
         "msg/ExchangeAddrs": MsgExchangeAddrs(p2),
         "msg/AnnounceAddrs": MsgAnnounceAddrs(p2),
-        "msg/SyncRequest": MsgSyncRequest((b"\x01" * 32, b"\x02" * 32)),
+        # v10: the sync pair carries the session vector — pinned with
+        # epoch-bearing rids and a varint-edge seq
+        "msg/SyncRequest": MsgSyncRequest(
+            (b"\x01" * 32, b"\x02" * 32),
+            (("h1:6001:n1!7", 127), ("h2:6002:n2!1700000000000", 128)),
+        ),
         # schema v8 units, byte-pinned: cum/seq at varint edge values
         # (127/128 straddle the LEB128 continuation bit), a sparse tree
         # with first+last buckets, a budget-shaped range request, and
         # the reset at a two-byte varint
         "msg/DeltaAck": MsgDeltaAck(127),
         "msg/SeqPush": MsgSeqPush(
-            128, "GCOUNT", ((b"k1", {1: 10, 2: 20}),)
+            128, 127, "GCOUNT", ((b"k1", {1: 10, 2: 20}),)
         ),
         "msg/DigestTree": MsgDigestTree(
             "PNCOUNT", ((0, b"\x03" * 32), (255, b"\x04" * 32))
         ),
         "msg/RangeRequest": MsgRangeRequest("PNCOUNT", (0, 64, 255)),
         "msg/IntervalReset": MsgIntervalReset(300),
+        # v10: the origin-preserving relay (seq at a varint edge, the
+        # origin rid with its epoch suffix, batch = msg3's bytes) and
+        # the region gossip map
+        "msg/RelayPush": MsgRelayPush(
+            128, "h1:6001:n1!7", 127, "GCOUNT", ((b"k1", {1: 10, 2: 20}),)
+        ),
+        "msg/RegionGossip": MsgRegionGossip(
+            (("h1:6001:n1", "eu-west", 127),
+             ("h2:6002:n2", "us-east", 1700000000000))
+        ),
         "delta/TREG": MsgPushDeltas("TREG", ((b"k1", (b"v1", 7)),)),
         "delta/TLOG": MsgPushDeltas(
             "TLOG", ((b"k1", ([(b"e2", 9), (b"e1", 3)], 2)),)

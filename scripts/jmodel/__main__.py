@@ -38,13 +38,23 @@ BUDGET_PATH = os.path.join(
 )
 
 # committed smoke parameters (depth, quiesce-every): deep enough that
-# the three frontiers together clear the recorded model_min_states
-# floor (~56.8k distinct states on the recording host — the v9
-# composed-types actions (bdec/bxfer) grew the nodes2 frontier ~3x over
-# the v8-era 17.5k; budget.json), shallow enough for the per-commit
-# budget. The soak tier (tests/test_model.py -m soak) goes deeper on
-# every axis.
-SMOKE_PARAMS = {"nodes2": (6, 24), "nodes3": (4, 16), "lanes2": (4, 16)}
+# the four frontiers together clear the recorded model_min_states
+# floor (budget.json), shallow enough for the per-commit budget. The
+# v10 sessions/regions axes (a mint action per group, the regions3
+# config with its bridge relays and session invariants) grow the
+# frontier again on top of v9's bdec/bxfer growth; the soak tier
+# (tests/test_model.py -m soak) goes deeper on every axis.
+# nodes2 drops from depth 6 to 5 with the v10 mint axis: the sessions
+# action roughly doubled its per-depth branching, and depth 6 alone ran
+# 112k states / 305s — past the whole budget. Depth 5 keeps the config
+# at ~23k states while the three NEW-coverage configs (lane bus,
+# regions, plus nodes3's gossip discovery) spend the rest of the box.
+SMOKE_PARAMS = {
+    "nodes2": (5, 24),
+    "nodes3": (4, 16),
+    "lanes2": (4, 16),
+    "regions3": (4, 16),
+}
 
 COUNTEREXAMPLE_PATH = "jmodel_counterexample.json"
 

@@ -78,7 +78,9 @@ def _touched(action: tuple) -> frozenset | None:
         target = rest.split("#", 1)[0]
         recv = target if direction == "fwd" else dialer
         return frozenset((_group_of(recv),))
-    if kind in ("write", "bdec"):
+    if kind in ("write", "bdec", "mint"):
+        # mint flushes + snapshots at the one group, exactly a tick's
+        # footprint for the reduction's purposes
         return frozenset((action[1],))
     if kind == "bxfer":
         # mutates only the SENDER's lattice (the receiver learns of the
@@ -101,6 +103,7 @@ class Explorer:
         quiesce_every: int = 16,
         max_states: int | None = None,
         escrow_unsafe: bool = False,
+        session_unsafe: bool = False,
     ):
         self.config = config
         self.depth = depth
@@ -111,6 +114,9 @@ class Explorer:
         # the exploration is then EXPECTED to find an invariant
         # violation — the counterexample demonstration
         self.escrow_unsafe = escrow_unsafe
+        # ... and the broken session-watermark rule (sessions.py unsafe
+        # mode): the session_ryw counterexample demonstration
+        self.session_unsafe = session_unsafe
         self.visited: set[str] = set()
         self.leaves = 0
         self.quiesced = 0
@@ -118,7 +124,8 @@ class Explorer:
 
     def _replay(self, trace) -> World:
         world = World(self.config, self.budgets, runtime=self._runtime,
-                      escrow_unsafe=self.escrow_unsafe)
+                      escrow_unsafe=self.escrow_unsafe,
+                      session_unsafe=self.session_unsafe)
         try:
             for action in trace:
                 applied = world.apply(tuple(action))
@@ -144,10 +151,12 @@ class Explorer:
             minimized = minimize(
                 self.config, f.trace, f.violation.name, self.budgets,
                 runtime=self._runtime, escrow_unsafe=self.escrow_unsafe,
+                session_unsafe=self.session_unsafe,
             )
             result.schedule = schedule_dict(
                 self.config, minimized, expect=f.violation.name,
                 note=f.violation.detail, escrow_unsafe=self.escrow_unsafe,
+                session_unsafe=self.session_unsafe,
                 budgets=self.budgets,
             )
         except _Done:
@@ -227,7 +236,8 @@ class Explorer:
 
 def schedule_dict(
     config: str, actions, expect: str = "pass", note: str = "",
-    escrow_unsafe: bool = False, budgets: dict | None = None,
+    escrow_unsafe: bool = False, session_unsafe: bool = False,
+    budgets: dict | None = None,
 ) -> dict:
     out = {
         "schema": SCHEDULE_SCHEMA,
@@ -243,6 +253,9 @@ def schedule_dict(
         # the schedule only fails against the deliberately broken
         # escrow rule; the replayer must re-arm it
         out["escrow_unsafe"] = True
+    if session_unsafe:
+        # likewise for the broken session-watermark rule
+        out["session_unsafe"] = True
     if budgets:
         # non-default budgets are part of the counterexample: without
         # them a standalone replay silently skips now-disabled actions
@@ -262,7 +275,8 @@ def replay_schedule(
         raise ValueError(f"unknown schedule schema: {data.get('schema')!r}")
     world = World(data["config"], budgets or data.get("budgets"),
                   runtime=runtime,
-                  escrow_unsafe=bool(data.get("escrow_unsafe")))
+                  escrow_unsafe=bool(data.get("escrow_unsafe")),
+                  session_unsafe=bool(data.get("session_unsafe")))
     try:
         explicit_quiesce = False
         for raw in data["actions"]:
@@ -285,7 +299,7 @@ def replay_schedule(
 def minimize(
     config: str, trace: list, expect: str, budgets: dict | None = None,
     rounds: int = 4, runtime: Runtime | None = None,
-    escrow_unsafe: bool = False,
+    escrow_unsafe: bool = False, session_unsafe: bool = False,
 ) -> list:
     """ddmin-lite over the action trace: greedily drop actions while
     replaying still hits the SAME invariant. Replays are cheap at
@@ -300,6 +314,8 @@ def minimize(
         }
         if escrow_unsafe:
             data["escrow_unsafe"] = True
+        if session_unsafe:
+            data["session_unsafe"] = True
         v = replay_schedule(data, budgets, runtime=runtime)
         return v is not None and v.name == expect
 

@@ -39,6 +39,7 @@ import selectors
 import struct
 from concurrent.futures import ThreadPoolExecutor
 
+from jylis_tpu import sessions as sessions_mod
 from jylis_tpu.cluster import cluster as cluster_mod
 from jylis_tpu.cluster.cluster import Cluster
 from jylis_tpu.lanes import wire_bridge
@@ -52,7 +53,7 @@ from jylis_tpu.utils.log import Log
 
 from .net import Network, VirtualClock
 
-CONFIG_NAMES = ("nodes2", "nodes3", "lanes2")
+CONFIG_NAMES = ("nodes2", "nodes3", "lanes2", "regions3")
 
 TICK_MS = 100  # virtual ms per heartbeat action
 
@@ -70,6 +71,11 @@ DEFAULT_BUDGETS = {
     # schedules the `0 <= value <= bound` invariant must survive
     "bdecs": 1,
     "bxfers": 1,
+    # session tokens (schema v10): SESSION TOKEN mints per group — each
+    # snapshots the group's vector + its own-column floor, and the
+    # read-your-writes invariant then holds at EVERY later state: any
+    # replica whose vector dominates the token must show the floor
+    "mints": 1,
 }
 
 # the modelled bounded counter: one key, bound granted (and matching
@@ -116,10 +122,16 @@ class ModelDatabase:
     DATA_TYPES = ("GCOUNT", "TENSOR", "MAP", "BCOUNT")
 
     def __init__(self, name: str, rid: int, journal=None,
-                 escrow_unsafe: bool = False):
+                 escrow_unsafe: bool = False, session_unsafe: bool = False):
         self.name = name
         self.rid = rid
         self.escrow_unsafe = escrow_unsafe
+        # the node's applied-interval vector (jylis_tpu/sessions.py —
+        # the REAL object, bound by the real Cluster exactly like the
+        # product's Database). session_unsafe arms the deliberately
+        # broken watermark rule (first-observed jump) the explorer must
+        # refute with a minimized counterexample.
+        self.sessions = sessions_mod.SessionIndex(unsafe=session_unsafe)
         self.state: dict[bytes, dict[int, int]] = {}
         self.state_t: dict[bytes, Tensor] = {}
         # MAP (schema v9): real compose.MapCRDT objects, keyed per map
@@ -134,6 +146,16 @@ class ModelDatabase:
         # first action
         self.state_b: dict[bytes, BCount] = {BCOUNT_KEY: _seed_bcount()}
         self.pending: list[tuple[bytes, dict[int, int]]] = []
+        self.write_seq = 0  # own-write ordinal (drives WRITE_KEYS)
+        # own counter columns that have been FLUSHED under THIS
+        # incarnation (absolute values — state-based columns subsume
+        # earlier writes): exactly the cells a token minted now covers.
+        # Journal-replayed state is deliberately NOT here — a reboot
+        # forgets its shipped history, and a fresh token must not claim
+        # writes only the OLD incarnation's (possibly lost) stream or a
+        # digest sync can deliver (the product contract: clients retain
+        # their token across writes; docs/sessions.md).
+        self.own_shipped: dict[bytes, int] = {}
         self.pending_t: list[tuple[bytes, Tensor]] = []
         self.pending_m: list[tuple[bytes, tuple]] = []
         self.pending_b: list[tuple[bytes, tuple]] = []
@@ -186,7 +208,16 @@ class ModelDatabase:
         """Move one unit of dec-escrow to another replica."""
         return self.state_b[BCOUNT_KEY].transfer(self.rid, to_rid, 1, "DEC")
 
-    def local_write(self, key: bytes = b"x") -> None:
+    # write keys cycle per own-write ordinal: distinct keys are what
+    # makes a lost-frame gap OBSERVABLE (absolute counter columns
+    # subsume earlier writes to the SAME key, so a one-key model could
+    # never exhibit the session hole the unsafe watermark rule hides)
+    WRITE_KEYS = (b"x", b"y", b"z", b"w", b"v")
+
+    def local_write(self, key: bytes | None = None) -> None:
+        if key is None:
+            key = self.WRITE_KEYS[min(self.write_seq, 4)]
+        self.write_seq += 1
         rows = self.state.setdefault(key, {})
         n = rows.get(self.rid, 0) + 1
         rows[self.rid] = n
@@ -252,6 +283,10 @@ class ModelDatabase:
     async def flush_deltas_async(self, fn) -> None:
         if self.pending:
             batch, self.pending = self.pending, []
+            for key, delta in batch:
+                n = delta.get(self.rid, 0)
+                if n > self.own_shipped.get(key, 0):
+                    self.own_shipped[key] = n
             fn(("GCOUNT", tuple(batch)))
         if self.pending_t:
             batch_t, self.pending_t = self.pending_t, []
@@ -555,11 +590,12 @@ class Runtime:
         self.loop.close()
 
 
-def _mk_config(addr: Address, seeds) -> Config:
+def _mk_config(addr: Address, seeds, region: str = "") -> Config:
     cfg = Config()
     cfg.addr = addr
     cfg.seed_addrs = list(seeds)
     cfg.heartbeat_time = 999.0  # never started: the explorer IS the heart
+    cfg.region = region
     cfg.log = Log.create_none()
     return cfg
 
@@ -571,6 +607,7 @@ class World:
         budgets: dict | None = None,
         runtime: Runtime | None = None,
         escrow_unsafe: bool = False,
+        session_unsafe: bool = False,
     ):
         if config_name not in CONFIG_NAMES:
             raise ValueError(f"unknown config {config_name!r}")
@@ -583,6 +620,11 @@ class World:
         # exploration MUST then find a schedule violating the bcount
         # invariant — the counterexample demonstration in test_model.py
         self.escrow_unsafe = escrow_unsafe
+        # session_unsafe arms the broken session-watermark rule
+        # (sessions.SessionIndex unsafe mode): the exploration MUST
+        # then find a token-satisfied read observing a missing write —
+        # the session_ryw counterexample demonstration
+        self.session_unsafe = session_unsafe
         self._owns_runtime = runtime is None
         self._runtime = runtime or Runtime()
         self.loop = self._runtime.loop
@@ -598,6 +640,17 @@ class World:
         }
         self.writes_left: dict[str, int] = {}
         self.bdecs_left: dict[str, int] = {}
+        self.mints_left: dict[str, int] = {}
+        # minted session tokens: (group, vector, own-column floor,
+        # minting boot) — the read-your-writes invariant checks every
+        # one at every state; the quiescence LIVENESS law additionally
+        # requires universal domination, but only for tokens whose
+        # minting group never crashed afterward (a crash can destroy
+        # the only copy of the sequenced frames a token references —
+        # the data heals via anti-entropy, the token honestly stays
+        # STALE forever; docs/sessions.md documents the contract)
+        self.tokens: list[tuple[str, dict, dict, int]] = []
+        self.boot_count: dict[str, int] = {}
         self.group_rids: dict[str, int] = {}
         # invariant shadows: per-db lattice floor, per-(instance, addr)
         # last observed dial-backoff state
@@ -644,11 +697,11 @@ class World:
     # ---- construction ------------------------------------------------------
 
     def _spawn(self, key, group, addr, seeds, db, drive_flush=True,
-               register_system=True) -> Instance:
+               register_system=True, region="") -> Instance:
         inst = Instance(key, group, addr)
         inst.database = db
         inst.cluster = Cluster(
-            _mk_config(addr, seeds),
+            _mk_config(addr, seeds, region),
             db,
             drive_flush=drive_flush,
             register_system=register_system,
@@ -681,7 +734,8 @@ class World:
             self._node_group("foo", addrs["foo"], [], rid=1)
             self._node_group("bar", addrs["bar"], [addrs["foo"]], rid=2)
             self._node_group("baz", addrs["baz"], [addrs["foo"]], rid=3)
-        else:  # lanes2: external node E + a 2-lane node N (bus + bridge)
+        elif self.config_name == "lanes2":
+            # external node E + a 2-lane node N (bus + bridge)
             e_addr = Address("10.0.0.9", "7001", "E")
             n_addr = Address("10.0.0.1", "7001", "N")
             bus0 = Address("127.0.0.1", "7101", "N#lane0")
@@ -689,17 +743,40 @@ class World:
             self._node_group("E", e_addr, [n_addr], rid=9)
             self._lane_group("L0", 0, n_addr, bus0, [bus1], e_addr, rid=1)
             self._lane_group("L1", 1, n_addr, bus1, [bus0], None, rid=2)
+        else:  # regions3: two regions, one deterministic bridge each.
+            # foo+bar form region ra's intra mesh (foo, the smaller
+            # address, is its bridge); baz alone is region rb (its own
+            # bridge). The explored topology is therefore foo<->bar and
+            # the foo<->baz WAN link, with bar<->baz REACHABLE ONLY
+            # through foo's origin-preserving relays — exactly the path
+            # a session token minted on bar must survive to verify on
+            # baz (and the path the region-prune policy must carve out
+            # of the bootstrap full mesh without partitioning anyone).
+            addrs = {
+                "foo": Address("10.0.0.1", "7001", "foo"),
+                "bar": Address("10.0.0.2", "7001", "bar"),
+                "baz": Address("10.0.0.3", "7001", "baz"),
+            }
+            self._node_group("foo", addrs["foo"], [], rid=1, region="ra")
+            self._node_group(
+                "bar", addrs["bar"], [addrs["foo"]], rid=2, region="ra"
+            )
+            self._node_group(
+                "baz", addrs["baz"], [addrs["foo"]], rid=3, region="rb"
+            )
 
-    def _node_group(self, name, addr, seeds, rid) -> None:
+    def _node_group(self, name, addr, seeds, rid, region: str = "") -> None:
         def build(journal=None):
             db = ModelDatabase(name, rid, journal,
-                               escrow_unsafe=self.escrow_unsafe)
+                               escrow_unsafe=self.escrow_unsafe,
+                               session_unsafe=self.session_unsafe)
             self.dbs[name] = db
-            self._spawn(name, name, addr, seeds, db)
+            self._spawn(name, name, addr, seeds, db, region=region)
 
         self._group_builders[name] = build
         self.writes_left[name] = self.budgets["writes"]
         self.bdecs_left[name] = self.budgets["bdecs"]
+        self.mints_left[name] = self.budgets["mints"]
         self.group_rids[name] = rid
         build()
 
@@ -707,7 +784,8 @@ class World:
                     e_addr, rid) -> None:
         def build(journal=None):
             db = ModelDatabase(group, rid, journal,
-                               escrow_unsafe=self.escrow_unsafe)
+                               escrow_unsafe=self.escrow_unsafe,
+                               session_unsafe=self.session_unsafe)
             self.dbs[group] = db
             # main.py's exact wiring: every lane runs a bus instance
             # (lane 0's does not own the SYSTEM metrics section); lane 0
@@ -727,6 +805,7 @@ class World:
         self._group_builders[group] = build
         self.writes_left[group] = self.budgets["writes"]
         self.bdecs_left[group] = self.budgets["bdecs"]
+        self.mints_left[group] = self.budgets["mints"]
         self.group_rids[group] = rid
         build()
 
@@ -791,6 +870,8 @@ class World:
                 acts.append(("write", group))
             if self.bdecs_left.get(group, 0) > 0 and self._group_alive(group):
                 acts.append(("bdec", group))
+            if self.mints_left.get(group, 0) > 0 and self._group_alive(group):
+                acts.append(("mint", group))
             if (
                 self.used["crashes"] < self.budgets["crashes"]
                 and self._group_alive(group)
@@ -866,6 +947,12 @@ class World:
                 and action[1] in self._group_builders
                 and self._group_alive(action[1])
             )
+        if kind == "mint":
+            return (
+                self.mints_left.get(action[1], 0) > 0
+                and action[1] in self._group_builders
+                and self._group_alive(action[1])
+            )
         if kind == "bxfer":
             return (
                 self.used["bxfers"] < self.budgets["bxfers"]
@@ -922,6 +1009,9 @@ class World:
         elif kind == "bdec":
             self.bdecs_left[action[1]] -= 1
             self._run(self.dbs[action[1]].local_bdec)
+        elif kind == "mint":
+            self.mints_left[action[1]] -= 1
+            self._mint(action[1])
         elif kind == "bxfer":
             self.used["bxfers"] += 1
             to_rid = self.group_rids[action[2]]
@@ -944,7 +1034,39 @@ class World:
         self.net.gc_conns()
         return True
 
+    def _mint(self, group: str) -> None:
+        """SESSION TOKEN at ``group``: force its pending local deltas
+        through the driving cluster's flush path (the product's
+        Database._mint_token barrier), snapshot the vector, and record
+        the group's OWN counter columns as the token's floor — exactly
+        the writes the token's self entry covers. The session_ryw
+        invariant then holds the floor against every replica whose
+        vector ever dominates the token."""
+        inst = self.instances.get(group) or self.instances.get(
+            f"{group}.bus"
+        )
+        self._run(inst.cluster.flush_now)
+        db = self.dbs[group]
+        vec = dict(db.sessions.vector())
+        rid = self.group_rids[group]
+        # floor = own columns SHIPPED under this incarnation: what the
+        # vector's self entry provably covers. (Journal-replayed state
+        # a reboot never re-shipped is NOT claimable by a fresh token —
+        # the explorer found exactly that over-claim in an earlier cut.)
+        floor = {
+            (key.hex(), rid): n for key, n in db.own_shipped.items()
+        }
+        self.tokens.append(
+            (group, vec, floor, self.boot_count.get(group, 0))
+        )
+
     def _crash_reboot(self, group: str) -> None:
+        # a reboot is a new incarnation: advance the virtual clock so
+        # the rebuilt Cluster mints a fresh boot epoch (production wall
+        # time guarantees this; the model must too, or the new seq
+        # stream would alias the old one in every peer's session vector)
+        self.clock.advance(TICK_MS)
+        self.boot_count[group] = self.boot_count.get(group, 0) + 1
         journal = list(self.dbs[group].journal)
 
         def down():
@@ -1002,6 +1124,30 @@ class World:
                         "bcount_bound",
                         f"{group}: {key!r} value {value} > bound {bound}",
                     )
+        # session guarantee (schema v10): a token-satisfied read never
+        # observes a regression — any replica whose applied vector
+        # dominates a minted token must show the token's floor (the
+        # minting group's own counter columns at mint time). This is
+        # THE read-your-writes invariant, checked at every state; the
+        # deliberately broken watermark rule (session_unsafe) must
+        # surface here as a minimized counterexample schedule.
+        for g0, vec, floor, _boot in self.tokens:
+            for group, db in self.dbs.items():
+                if not self._group_alive(group):
+                    continue
+                svec = db.sessions.vector()
+                if not all(svec.get(r, 0) >= s for r, s in vec.items()):
+                    continue  # not dominated: STALE territory, no claim
+                for (key_hex, rid), v in floor.items():
+                    got = db.state.get(bytes.fromhex(key_hex), {}).get(
+                        rid, 0
+                    )
+                    if got < v:
+                        raise Violation(
+                            "session_ryw",
+                            f"{group}: dominates {g0}'s token but cell "
+                            f"({key_hex}, {rid}) shows {got} < floor {v}",
+                        )
         for key, inst in self.instances.items():
             if not inst.alive:
                 continue
@@ -1195,6 +1341,52 @@ class World:
                         "in_flight", f"{cid}/{direction} still carries "
                         "bytes after quiescence",
                     )
+        self._quiesce_sessions()
+
+    def _quiesce_sessions(self) -> None:
+        """Session liveness at quiescence: once everything healed and
+        every digest matches, every minted token must become dominated
+        on every alive replica — live contiguity covers the direct
+        paths, digest-match adoption covers reboots and region hops.
+        Adoption can need a couple more sync periods after the digests
+        first agree (it rides the periodic MsgSyncRequest exchange, and
+        a vector entry may have to hop bridge-wise), so tick a bounded
+        extra window before asserting."""
+        if not self.tokens:
+            return
+        period = cluster_mod.SYNC_PERIOD_TICKS
+
+        def all_dominated() -> bool:
+            for g0, vec, _floor, boot in self.tokens:
+                if self.boot_count.get(g0, 0) != boot:
+                    # the minting group crashed after the mint: the
+                    # token's frames may be unrecoverable — it honestly
+                    # stays STALE (safety still checked every state)
+                    continue
+                for group, db in self.dbs.items():
+                    if not self._group_alive(group):
+                        continue
+                    svec = db.sessions.vector()
+                    if not all(
+                        svec.get(r, 0) >= s for r, s in vec.items()
+                    ):
+                        return False
+            return True
+
+        for _ in range(8 * period):
+            if all_dominated():
+                return
+            for key in sorted(self.instances):
+                if self.instances[key].alive:
+                    self.clock.advance(TICK_MS)
+                    self._run(self.instances[key].cluster._heartbeat)
+            self._deliver_all()
+        if not all_dominated():
+            raise Violation(
+                "session_liveness",
+                "a minted token is still not dominated everywhere "
+                "after quiescence + adoption window",
+            )
 
     # ---- state hashing -----------------------------------------------------
 
@@ -1241,6 +1433,14 @@ class World:
                 ],
                 "refused": db.refused_decs,
                 "journal_len": len(db.journal),
+                # the applied-interval vector + parked seqs (v10): two
+                # states differing only here answer a SESSION READ
+                # differently, so they must not dedup-merge — and the
+                # shipped-floor feeds future mints' claims
+                "svec": db.sessions.canonical(),
+                "shipped": sorted(
+                    (k.hex(), n) for k, n in db.own_shipped.items()
+                ),
             }
             for g, db in sorted(self.dbs.items())
         }
@@ -1318,6 +1518,9 @@ class World:
                 "held": [
                     [rank[ts], self._sha(data)] for ts, data in c._held
                 ],
+                # region topology state (v10): the gossiped region map
+                # drives dial policy and relay roles
+                "regions": sorted(c._regions.items()),
                 "stats": sorted(c._stats.items()),
                 "drops": sorted(c._drop_counts.items()),
                 "msg_drops": sorted(c._msg_drops.items()),
@@ -1361,6 +1564,12 @@ class World:
             "used": sorted(self.used.items()),
             "writes_left": sorted(self.writes_left.items()),
             "bdecs_left": sorted(self.bdecs_left.items()),
+            "mints_left": sorted(self.mints_left.items()),
+            "boots": sorted(self.boot_count.items()),
+            "tokens": [
+                (g, sorted(vec.items()), sorted(floor.items()), boot)
+                for g, vec, floor, boot in self.tokens
+            ],
         }
 
     def state_hash(self) -> str:

@@ -185,6 +185,35 @@ def run_smoke(ports, addrs=None) -> None:
         lambda: len({bytes(once(p, "SYSTEM", "DIGEST")) for p in ports}) == 1,
         "SYSTEM DIGEST match across all three nodes",
     )
+
+    # session-guarantee gate (docs/sessions.md): a write WRAPped on
+    # node 0 mints a token; SESSION READ with that token on the OTHER
+    # nodes must serve the write (bounded wait riding --session-wait-ms;
+    # transient STALE replies poll like any convergence) and return a
+    # monotone reply token. Read-your-writes across real processes and
+    # real sockets, end to end.
+    reply = once(ports[0], "SESSION", "WRAP", "GCOUNT", "INC", "sess", 5)
+    assert isinstance(reply, list) and len(reply) == 2, reply
+    assert reply[0] == b"OK", reply
+    token = bytes(reply[1])
+
+    def session_read_ok(p: int) -> bool:
+        try:
+            out = once(p, "SESSION", "READ", token, "GCOUNT", "GET", "sess")
+        except ResponseError as e:
+            assert str(e).startswith("STALE"), e  # the only legal refusal
+            return False
+        assert isinstance(out, list) and len(out) == 2, out
+        assert out[1] == 5, out
+        from jylis_tpu import sessions as _sessions
+
+        vec = _sessions.decode_token(bytes(out[0]))
+        assert _sessions.dominates(vec, _sessions.decode_token(token))
+        return True
+
+    for p in ports[1:]:
+        until(deadline, lambda p=p: session_read_ok(p),
+              f"session read-your-writes on :{p}")
     print("SMOKE3-OK")
 
 

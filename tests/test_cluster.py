@@ -44,12 +44,14 @@ class Node:
     into the replicated SYSTEM log — failure diagnostics can then read
     each node's own account of its sync/cluster decisions."""
 
-    def __init__(self, name: str, cluster_port: int, seeds=(), log_level=None):
+    def __init__(self, name: str, cluster_port: int, seeds=(), log_level=None,
+                 region: str = ""):
         self.config = Config()
         self.config.port = "0"
         self.config.addr = Address("127.0.0.1", str(cluster_port), name)
         self.config.seed_addrs = list(seeds)
         self.config.heartbeat_time = TICK
+        self.config.region = region  # v10 region-aware peering tests
         if log_level is None:
             self.config.log = Log.create_none()
         else:

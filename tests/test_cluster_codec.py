@@ -118,9 +118,9 @@ V8_MESSAGES = [
     MsgDeltaAck(127),       # LEB128 single-byte ceiling
     MsgDeltaAck(128),       # first two-byte varint
     MsgDeltaAck(U64_MAX),   # full u64 range rides the varint
-    MsgSeqPush(1, "GCOUNT", ((b"k", {1: 5}),)),
-    MsgSeqPush(U64_MAX, "TREG", ((b"k", (b"v", 9)), (b"j", (b"", 0)))),
-    MsgSeqPush(7, "PNCOUNT", ()),  # empty batch is legal (flush quirk)
+    MsgSeqPush(1, 1, "GCOUNT", ((b"k", {1: 5}),)),
+    MsgSeqPush(U64_MAX, U64_MAX, "TREG", ((b"k", (b"v", 9)), (b"j", (b"", 0)))),
+    MsgSeqPush(7, 3, "PNCOUNT", ()),  # empty batch is legal (flush quirk)
     MsgDigestTree("GCOUNT", ()),   # empty tree: responder holds no keys
     MsgDigestTree("UJSON", ((0, b"\x05" * 32), (255, b"\x06" * 32))),
     MsgDigestTree("TREG", tuple((i, bytes([i]) * 32) for i in range(256))),
@@ -142,13 +142,14 @@ def test_v8_messages_roundtrip_both_paths():
 
 def test_v8_seq_push_matches_push_deltas_after_prefix():
     """The schema pins msg7's name+batch bytes to msg3's after the
-    tag+seq prefix — the property the native fast-path wrapper relies
-    on. Byte-check it directly."""
+    tag+seq+oseq prefix (v10 added the own-content ordinal) — the
+    property the native fast-path wrapper relies on. Byte-check it
+    directly."""
     batch = ((b"k1", {1: 10, 2: 20}), (b"k2", {7: 1}))
     push = codec.encode(MsgPushDeltas("GCOUNT", batch))
-    seq_push = codec.encode(MsgSeqPush(5, "GCOUNT", batch))
-    assert seq_push[0] == 7 and seq_push[1] == 5
-    assert seq_push[2:] == push[1:]
+    seq_push = codec.encode(MsgSeqPush(5, 3, "GCOUNT", batch))
+    assert seq_push[0] == 7 and seq_push[1] == 5 and seq_push[2] == 3
+    assert seq_push[3:] == push[1:]
 
 
 def test_v8_truncation_at_every_byte_is_codec_error():

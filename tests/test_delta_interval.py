@@ -290,21 +290,21 @@ def test_receiver_tracks_contiguity_and_acks_cumulative():
         cl._passives.add(conn)
         skey = str(conn.peer_addr)
         # first contact baselines at the observed seq
-        await cl._passive_msg(conn, MsgSeqPush(5, "GCOUNT", ()))
+        await cl._passive_msg(conn, MsgSeqPush(5, 5, "GCOUNT", ()))
         assert cl._recv_cum[skey] == 5
         # contiguous advance
-        await cl._passive_msg(conn, MsgSeqPush(6, "GCOUNT", ()))
+        await cl._passive_msg(conn, MsgSeqPush(6, 6, "GCOUNT", ()))
         assert cl._recv_cum[skey] == 6
         # a gap parks out of order; cum holds
-        await cl._passive_msg(conn, MsgSeqPush(8, "GCOUNT", ()))
+        await cl._passive_msg(conn, MsgSeqPush(8, 8, "GCOUNT", ()))
         assert cl._recv_cum[skey] == 6
         assert cl._recv_ooo[skey] == {8}
         # the retransmit fills the gap: park collapses
-        await cl._passive_msg(conn, MsgSeqPush(7, "GCOUNT", ()))
+        await cl._passive_msg(conn, MsgSeqPush(7, 7, "GCOUNT", ()))
         assert cl._recv_cum[skey] == 8
         assert skey not in cl._recv_ooo
         # a duplicate below cum re-states the ack, cursor unchanged
-        await cl._passive_msg(conn, MsgSeqPush(3, "GCOUNT", ()))
+        await cl._passive_msg(conn, MsgSeqPush(3, 3, "GCOUNT", ()))
         assert cl._recv_cum[skey] == 8
 
     asyncio.run(main())
@@ -319,8 +319,8 @@ def test_interval_reset_rebases_receiver_and_forces_repair():
         conn.peer_addr = Address("127.0.0.1", "9", "sender")
         cl._passives.add(conn)
         skey = str(conn.peer_addr)
-        await cl._passive_msg(conn, MsgSeqPush(5, "GCOUNT", ()))
-        await cl._passive_msg(conn, MsgSeqPush(9, "GCOUNT", ()))
+        await cl._passive_msg(conn, MsgSeqPush(5, 5, "GCOUNT", ()))
+        await cl._passive_msg(conn, MsgSeqPush(9, 9, "GCOUNT", ()))
         assert cl._recv_ooo[skey] == {9}
         cl._sync_req_tick[conn.peer_addr] = cl._tick  # cooldown armed
         await cl._passive_msg(conn, MsgIntervalReset(42))

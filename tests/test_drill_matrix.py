@@ -1396,3 +1396,115 @@ def test_spawned_env_crash_drill(tmp_path):
         assert int(client.execute_command("GCOUNT", "GET", "k")) == got + 5
     finally:
         stop_node(proc2)
+
+
+# ---- sessions & regions drills (schema v10) ---------------------------------
+
+
+@pytest.mark.chaos
+def test_chaos_inter_region_partition_then_heal_digest_matched():
+    """Region topology under an injected WAN partition: the cluster
+    prunes to the sparse policy mesh (intra full, one bridge pair),
+    writes made while the relay seam is dropping frames diverge the
+    remote region, and the heal (budget exhausted) ends with all three
+    nodes digest-matched — the region machinery degrades to the
+    periodic digest sync, never to silence."""
+
+    async def main():
+        ports = sorted(grab_ports(3))
+        # the smallest address string is the deterministic bridge;
+        # ephemeral ports are all 5 digits, so sorted ports sort as
+        # strings too — aye gets the smallest and IS region r1's bridge
+        p_a, p_b, p_c = ports
+        a = Node("aye", p_a, region="r1")
+        b = Node("bee", p_b, seeds=[a.config.addr], region="r1")
+        c = Node("sea", p_c, seeds=[a.config.addr], region="r2")
+        await a.start()
+        await b.start()
+        await c.start()
+        nodes = [a, b, c]
+        try:
+            # the policy topology: bee and sea never hold a direct conn
+            def sparse() -> bool:
+                return (
+                    len(a.cluster._actives) == 2
+                    and str(b.config.addr) not in {
+                        str(x) for x in c.cluster._actives
+                    }
+                    and str(c.config.addr) not in {
+                        str(x) for x in b.cluster._actives
+                    }
+                    and all(
+                        cn.established
+                        for n in nodes
+                        for cn in n.cluster._actives.values()
+                    )
+                )
+
+            assert await converge_wait(sparse, ticks=200)
+            assert a.cluster._is_bridge() and c.cluster._is_bridge()
+            assert not b.cluster._is_bridge()
+
+            # baseline: a bee write transits aye's relay into r2
+            await write_inc(b, b"wan", 2)
+            await wait_counts(nodes, b"wan", 2)
+            assert a.cluster._stats["relays_sent"] > 0
+
+            # inter-region partition: the relay seam drops every frame
+            # for a bounded window; writes made under it diverge sea
+            h0 = faults.hits("cluster.relay")
+            faults.arm("cluster.relay", "drop", budget=4)
+            try:
+                await write_inc(b, b"wan", 3)
+                await wait_counts([a, b], b"wan", 5)
+            finally:
+                faults.disarm("cluster.relay")
+            assert faults.hits("cluster.relay") > h0, "fault never fired"
+
+            # heal: the periodic digest sync (range tier) repairs r2 —
+            # every node digest-matched, zero legacy dumps anywhere
+            await wait_counts(nodes, b"wan", 5)
+            await wait_digests_match(nodes)
+            assert sum(
+                n.cluster._stats["sync_full_dumps"] for n in nodes
+            ) == 0
+        finally:
+            for n in nodes:
+                await n.stop()
+
+    asyncio.run(main())
+
+
+@pytest.mark.chaos
+def test_chaos_admission_cap_degrades_one_class_not_the_node():
+    """Admission control under a wedged drain: with --admission-cap
+    armed, commands of the backed-up class get the typed BUSY refusal
+    (counted in SYSTEM METRICS), other classes keep serving, and the
+    class recovers the moment the drain releases."""
+
+    async def main():
+        (port,) = grab_ports(1)
+        node = Node("solo", port)
+        node.database.set_admission_cap(1)
+        await node.start()
+        try:
+            mgr = node.database.manager("GCOUNT")
+            async with mgr._lock:  # the wedged-drain stand-in
+                q1 = asyncio.ensure_future(
+                    resp_call(node.server.port, b"GCOUNT INC h 1\r\n")
+                )
+                await asyncio.sleep(0.1)  # q1 queues: inflight = 1
+                out = await resp_call(node.server.port, b"GCOUNT INC h 1\r\n")
+                assert out.startswith(b"-BUSY"), out
+                # one hot class never takes the node down with it
+                ok = await resp_call(node.server.port, b"PNCOUNT GET ok\r\n")
+                assert ok.startswith(b":"), ok
+            assert (await q1).startswith(b"+OK"), "queued write must serve"
+            out = await resp_call(node.server.port, b"GCOUNT GET h\r\n")
+            assert out == b":1\r\n", out
+            metrics = await resp_call(node.server.port, b"SYSTEM METRICS\r\n")
+            assert b"SERVING busy_refusals 1" in metrics, metrics
+        finally:
+            await node.stop()
+
+    asyncio.run(main())

@@ -194,12 +194,134 @@ def test_crash_reboot_recovers_local_writes_and_reconverges():
         try:
             world.apply(("write", "A"))
             world.apply(("crash", "A"))
-            # the journaled local writes survived the reboot
-            assert world.dbs["A"].state[b"x"][1] == 2
+            # the journaled local writes survived the reboot (the seed
+            # write on x, the extra write on the next cycled key)
+            assert world.dbs["A"].state[b"x"][1] == 1
+            assert world.dbs["A"].state[b"y"][1] == 1
             world.quiesce()
             assert len(set(world._digests().values())) == 1
         finally:
             world.close()
+
+
+# ---- sessions & regions (schema v10) ---------------------------------------
+
+
+def test_regions_world_prunes_to_sparse_topology_and_relays():
+    """The regions3 config: after quiescence the topology is the policy
+    one (bar<->baz never peered — their traffic transits foo's
+    origin-preserving relays), every replica digest-matches, and every
+    minted token is dominated everywhere (the quiesce session law)."""
+    with model_periods():
+        world = World("regions3")
+        try:
+            world.apply(("write", "bar"))
+            world.apply(("mint", "bar"))
+            world.quiesce()
+            assert len(set(world._digests().values())) == 1
+            bar = world.instances["bar"].cluster
+            baz = world.instances["baz"].cluster
+            bar_addr = str(world.instances["bar"].addr)
+            baz_addr = str(world.instances["baz"].addr)
+            assert baz_addr not in {str(a) for a in bar._actives}
+            assert bar_addr not in {str(a) for a in baz._actives}
+            # the relay chain actually carried traffic
+            foo = world.instances["foo"].cluster
+            assert foo._stats["relays_sent"] > 0
+            # bar's token verifies on baz: the cross-region session path
+            _g, vec, floor, _b = world.tokens[0]
+            svec = world.dbs["baz"].sessions.vector()
+            assert all(svec.get(r, 0) >= s for r, s in vec.items())
+            assert world.dbs["baz"].state[b"y"][2] >= 1  # bar's write
+        finally:
+            world.close()
+
+
+def test_session_exploration_holds_ryw_in_every_config():
+    """Bounded exploration with a mint in every group: the session_ryw
+    invariant (a token-satisfied read never observes a regression) and
+    the quiescence domination law hold across every explored schedule
+    of the regions and lane-bus configs."""
+    for config in ("regions3", "lanes2"):
+        with model_periods():
+            result = Explorer(config, 3, quiesce_every=8).run()
+        assert result.violation is None, (config, result.violation)
+        assert result.states > 200, (config, result.states)
+
+
+def _drive_session_break(session_unsafe: bool):
+    """Directed schedule for the broken-watermark demonstration: A's
+    seed write reaches B, A mints, B crash-reboots (losing A's column —
+    remote state is not journaled), A writes again and only the NEW seq
+    reaches the rebooted B (its rejoin sync is held back). The unsafe
+    watermark rule jumps over the gap and B falsely satisfies A's
+    token; the safe rule parks the seq and stays honestly STALE."""
+    with model_periods():
+        w = World("nodes2", session_unsafe=session_unsafe)
+        trace: list = []
+
+        def do(a):
+            trace.append(tuple(a))
+            if w.apply(a):
+                w.check_invariants()
+
+        def pump():
+            # deliver ONLY the A-dialed conn's frames: B's own rejoin
+            # sync stays in flight, so the x column is still missing
+            # when the post-crash seq push arrives
+            for _ in range(4):
+                for a in list(w.enabled_actions()):
+                    if a[0] == "deliver" and a[1].startswith("A>"):
+                        do(a)
+
+        try:
+            do(("tick", "A"))
+            pump()
+            do(("tick", "A"))
+            pump()
+            do(("mint", "A"))
+            do(("crash", "B"))
+            do(("write", "A"))
+            for _ in range(6):
+                do(("tick", "A"))
+                pump()
+            return None, trace
+        except Violation as v:
+            return v, trace
+        finally:
+            w.close()
+
+
+def test_broken_session_watermark_yields_minimized_counterexample():
+    """Arm the DELIBERATELY broken session-watermark rule (first-
+    observed jump — sessions.SessionIndex unsafe mode) and the directed
+    schedule must produce a token-satisfied read missing the token's
+    write (session_ryw); ddmin shrinks it to a standalone-replayable
+    artifact, and the SAME schedule against the correct contiguity rule
+    holds every invariant — the strict watermark is exactly what
+    read-your-writes rests on."""
+    v, trace = _drive_session_break(session_unsafe=True)
+    assert v is not None and v.name == "session_ryw", v
+    with model_periods():
+        minimized = minimize(
+            "nodes2", trace, "session_ryw", session_unsafe=True
+        )
+        sched = schedule_dict(
+            "nodes2", minimized, expect="session_ryw",
+            note=v.detail, session_unsafe=True,
+        )
+        assert sched["session_unsafe"] is True
+        assert len(minimized) < len(trace)
+        replayed = replay_schedule(json.loads(json.dumps(sched)))
+        assert replayed is not None and replayed.name == "session_ryw"
+        # the correct rule survives the identical schedule
+        safe = {k: v2 for k, v2 in sched.items() if k != "session_unsafe"}
+        assert replay_schedule(safe) is None
+
+
+def test_safe_session_rule_survives_the_directed_schedule():
+    v, _trace = _drive_session_break(session_unsafe=False)
+    assert v is None, v
 
 
 def test_minimizer_shrinks_to_the_failing_core(monkeypatch):
@@ -264,7 +386,7 @@ def test_link_kill_discards_in_flight_frames():
 # is ~2x the v7 one, which pushed these cells well past the tier-1 box
 @pytest.mark.parametrize(
     "config,depth",
-    [("nodes2", 8), ("nodes3", 6), ("lanes2", 6)],
+    [("nodes2", 8), ("nodes3", 6), ("lanes2", 6), ("regions3", 6)],
 )
 def test_soak_deep_exploration(config, depth):
     """Bigger budgets (two kills / dups / crashes), deeper frontier,
@@ -280,3 +402,49 @@ def test_soak_deep_exploration(config, depth):
         ).run()
     assert result.violation is None, result.violation
     assert result.states > 1_000
+
+
+def test_bridge_tokens_verify_live_despite_interleaved_relays():
+    """Review-find regression: a bridge's stream interleaves its own
+    SeqPush with RelayPush frames; receivers must advance the bridge's
+    OWN watermark on BOTH (contiguous transport application covers
+    every own-write frame below), or one relayed frame parks the
+    bridge's next own seq forever and its tokens go STALE on the LIVE
+    path. Adoption masks the bug wherever a digest sync fires, so this
+    test runs at PRODUCTION periods (no model_periods shrink): the
+    whole window stays under one SYNC_PERIOD, the only adoption is the
+    establishment-time sync (before the minted seqs exist), and the
+    assertion exercises pure contiguous application."""
+    w = World("regions3")
+    try:
+        def pump(rounds: int):
+            for _ in range(rounds):
+                for key in sorted(w.instances):
+                    if w.instances[key].alive:
+                        w.apply(("tick", key))
+                for _ in range(4):
+                    for a in list(w.enabled_actions()):
+                        if a[0] == "deliver":
+                            w.apply(a)
+
+        pump(8)  # establish + seed writes + relays flowing
+        # bar's seed write has crossed foo's relay into baz by now;
+        # foo's stream therefore carries RelayPush frames. Mint at
+        # foo AFTER a fresh foo write: its token references foo
+        # seqs ABOVE the relay frames.
+        w.apply(("write", "foo"))
+        w.apply(("mint", "foo"))
+        g, vec, floor, _boot = w.tokens[-1]
+        assert g == "foo"
+        foo_srid = w.instances["foo"].cluster._srid
+        assert vec.get(foo_srid, 0) > 0, vec
+        # foo relayed at least one foreign batch below the minted seq
+        assert w.instances["foo"].cluster._stats["relays_sent"] > 0
+        pump(8)  # live delivery only — total ticks < SYNC_PERIOD_TICKS
+        for group in ("bar", "baz"):
+            svec = w.dbs[group].sessions.vector()
+            assert all(
+                svec.get(r, 0) >= s for r, s in vec.items()
+            ), (group, svec, vec)
+    finally:
+        w.close()
