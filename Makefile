@@ -109,8 +109,10 @@ model-smoke:
 # the SIGKILL-mid-traffic journal recovery soak, the 16-32 node churn
 # soak (tests/test_soak_churn_scale.py — kill/rejoin/partition/heal
 # under sustained writes, ends digest-matched with zero whole-state
-# dumps) and the full fault-injection drill matrix
-# (tests/test_drill_matrix.py)
+# dumps), the region-churn soak (tests/test_soak_region_churn.py —
+# bridge crash/reboot loops at 3 regions, deterministic succession and
+# zero dumps after every handover) and the full fault-injection drill
+# matrix (tests/test_drill_matrix.py)
 soak:
 	$(PY) -m pytest tests/ -q -m soak
 

@@ -2410,7 +2410,9 @@ _WAN_SPAWN = (
 )
 
 
-def _spawn_wan_node(port, cport, name, region, seed=None, failpoints=""):
+def _spawn_wan_node(
+    port, cport, name, region, seed=None, failpoints="", demote_ticks=None
+):
     import os
     import subprocess
     import sys
@@ -2424,6 +2426,8 @@ def _spawn_wan_node(port, cport, name, region, seed=None, failpoints=""):
         argv += ["--seed-addrs", seed]
     if failpoints:
         argv += ["--failpoints", failpoints]
+    if demote_ticks is not None:
+        argv += ["--bridge-demote-ticks", str(demote_ticks)]
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     return subprocess.Popen(
         argv,
@@ -2503,21 +2507,132 @@ def _wan_converge_lag(rtt_s: float, writes: int = 5) -> float:
                 pr.wait(timeout=10)
 
 
+# bridge failover phase (PR 15): demotion threshold the failover
+# measurement runs with, and the in-config bound the recorded gap is
+# asserted against. The gap's floor is demote_ticks x the 0.2 s
+# heartbeat (the demotion window itself); on top ride the successor's
+# dial + establishment sync + one relay hop (and the injected RTT),
+# plus generous scheduling slack for a loaded recording host.
+_WAN_FAILOVER_DEMOTE_TICKS = 8
+_WAN_FAILOVER_HEARTBEAT_S = 0.2
+
+
+def _wan_failover_bound_ms(rtt_ms: float) -> float:
+    return (
+        _WAN_FAILOVER_DEMOTE_TICKS * _WAN_FAILOVER_HEARTBEAT_S * 1e3
+        + rtt_ms
+        + 10_000.0
+    )
+
+
+def _wan_failover_gap(rtt_s: float) -> float:
+    """Convergence gap (ms) across a bridge SIGKILL: 2 regions over 3
+    real processes (r1 = {bridge a, member b}, r2 = {c}), traffic
+    warmed through a's relay, then a is SIGKILLed and the clock runs
+    from the kill until a fresh write on b becomes visible on c — the
+    whole demotion + succession + redial + relay pipeline as one
+    number, with ``rtt_s`` injected at the relay seam like the
+    converge sweep."""
+    import signal
+    import socket
+
+    def call(port, cmd: bytes) -> bytes:
+        s = socket.create_connection(("127.0.0.1", port), timeout=10)
+        try:
+            s.sendall(cmd)
+            s.settimeout(10)
+            return s.recv(1 << 16)
+        finally:
+            s.close()
+
+    ports = [_free_port() for _ in range(3)]
+    cports = sorted(_free_port() for _ in range(3))
+    seed = f"127.0.0.1:{cports[0]}:wan-a"
+    fp = f"cluster.relay=sleep:{rtt_s}" if rtt_s > 0 else ""
+    dt = _WAN_FAILOVER_DEMOTE_TICKS
+    procs = [
+        _spawn_wan_node(
+            ports[0], cports[0], "wan-a", "r1", failpoints=fp,
+            demote_ticks=dt,
+        ),
+        _spawn_wan_node(
+            ports[1], cports[1], "wan-b", "r1", seed=seed,
+            failpoints=fp, demote_ticks=dt,
+        ),
+        _spawn_wan_node(
+            ports[2], cports[2], "wan-c", "r2", seed=seed,
+            failpoints=fp, demote_ticks=dt,
+        ),
+    ]
+    try:
+        deadline = time.time() + 180
+        for p in ports:
+            while True:
+                if time.time() > deadline:
+                    raise RuntimeError("wan node never came up")
+                try:
+                    if call(p, b"GCOUNT GET boot\r\n").startswith(b":"):
+                        break
+                except OSError:
+                    time.sleep(0.3)
+        # warm: the incumbent's relay path works
+        call(ports[1], b"GCOUNT INC warm 1\r\n")
+        while call(ports[2], b"GCOUNT GET warm\r\n") != b":1\r\n":
+            if time.time() > deadline:
+                raise RuntimeError("relay path never converged")
+            time.sleep(0.05)
+        # SIGKILL the elected bridge; the clock runs from here
+        procs[0].send_signal(signal.SIGKILL)
+        procs[0].wait(timeout=30)
+        t0 = time.perf_counter()
+        assert call(ports[1], b"GCOUNT INC gap 1\r\n") == b"+OK\r\n"
+        while call(ports[2], b"GCOUNT GET gap\r\n") != b":1\r\n":
+            if time.perf_counter() - t0 > 120:
+                raise RuntimeError("failover convergence gap exceeded 120s")
+            time.sleep(0.01)
+        return (time.perf_counter() - t0) * 1e3
+    finally:
+        for pr in procs:
+            if pr.poll() is None:
+                pr.terminate()
+        for pr in procs:
+            try:
+                pr.wait(timeout=30)
+            except Exception:
+                pr.kill()
+                pr.wait(timeout=10)
+
+
 def config_wan_converge() -> dict:
     """Multi-region convergence lag vs injected WAN RTT (ROADMAP item
     5a): three real node processes in two regions (r1 = bridge + one
     member, r2 = one node), writes on the r1 MEMBER, visibility polled
     on the r2 node — the full member -> bridge -> relay -> remote-region
     path, with the WAN latency injected at the bridge's relay seam via
-    the failpoint machinery (cluster.relay=sleep:RTT)."""
+    the failpoint machinery (cluster.relay=sleep:RTT).
+
+    PR 15 adds the bridge-kill phase: at each RTT tier the elected
+    bridge is SIGKILLed and the convergence GAP — kill until a fresh
+    member write is visible in the remote region again, through the
+    demoted-and-succeeded bridge — is recorded and asserted against
+    the in-config bound (demotion window + RTT + slack)."""
     sweep = {}
+    failover = {}
     for rtt_ms in (0, 20, 80):
         sweep[str(rtt_ms)] = round(_wan_converge_lag(rtt_ms / 1e3), 1)
+        gap = round(_wan_failover_gap(rtt_ms / 1e3), 1)
+        bound = _wan_failover_bound_ms(rtt_ms)
+        assert gap < bound, (
+            f"failover gap {gap}ms at {rtt_ms}ms RTT breaches the "
+            f"{bound:.0f}ms bound"
+        )
+        failover[str(rtt_ms)] = gap
     base = max(sweep["0"], 1e-9)
     return {
         "metric": (
             "multi-region convergence lag vs injected inter-region RTT "
-            "(2 regions, 3 real nodes, bridge relay)"
+            "(2 regions, 3 real nodes, bridge relay) + bridge-kill "
+            "failover convergence gap"
         ),
         "value": sweep["80"],
         "unit": "ms median write->visible lag at 80ms injected RTT",
@@ -2525,10 +2640,23 @@ def config_wan_converge() -> dict:
         "vs_baseline": round(sweep["80"] / base, 2),
         "base_lag_ms": sweep["0"],
         "converge_lag_ms": sweep,
+        # bridge failover (PR 15): SIGKILL-to-reconverged gap per RTT
+        # tier, each asserted under the in-config bound above
+        "failover_gap_ms": failover,
+        "failover_gap_80_ms": failover["80"],
+        "failover_demote_ticks": _WAN_FAILOVER_DEMOTE_TICKS,
+        "failover_bound_ms": {
+            rtt: round(_wan_failover_bound_ms(float(rtt)), 1)
+            for rtt in ("0", "20", "80")
+        },
         "note": (
             "lag is measured client-side: write acked on the r1 member "
             "until first successful read on the r2 node; the relay seam "
-            "sleeps once per relayed batch, so lag ~ base + RTT"
+            "sleeps once per relayed batch, so lag ~ base + RTT. The "
+            "failover gap runs the same path across a bridge SIGKILL: "
+            "demotion (8 ticks x 0.2s heartbeat) + successor dial + "
+            "establishment sync + relay; zero whole-state dumps by "
+            "construction (the ladder heals the blip)"
         ),
     }
 
@@ -2643,6 +2771,11 @@ def smoke() -> None:
     # tiny wan-converge pass: 3 real regioned processes, one write,
     # the member -> bridge -> relay -> remote-region visibility path
     assert _wan_converge_lag(0.0, writes=1) > 0
+    # tiny failover pass (PR 15): SIGKILL the elected bridge, measure
+    # the demotion + succession + reconverge gap, hold the recorded
+    # bound — the harness behind the failover_gap_ms record
+    gap = _wan_failover_gap(0.0)
+    assert 0 < gap < _wan_failover_bound_ms(0.0), gap
     print(
         json.dumps(
             {

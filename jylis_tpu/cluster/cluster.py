@@ -114,6 +114,31 @@ RECV_OOO_CAP = 512
 # repair via MsgIntervalReset — bytes proportional to divergence is the
 # range tier's job, not the interval tier's.
 RETRANSMIT_BYTES_CAP = 4 << 20
+# ---- bridge failover (PR 15) ----------------------------------------------
+# liveness-aware bridge succession: an address that produced NO
+# received frame for this many heartbeat ticks is demoted from bridge
+# election by every observer independently — the next-smallest LIVE
+# address of the region takes over, with no election traffic (every
+# node computes the same succession from its own evidence; transient
+# disagreement costs a dual-bridge overlap window the origin-preserving
+# MsgRelayPush dedup absorbs). Overridable via --bridge-demote-ticks
+# (default on Config).
+BRIDGE_DEMOTE_TICKS = Config.bridge_demote_ticks
+# a candidate we have NEVER heard from is optimistic-live (bootstrap:
+# gossip teaches addresses before contact) — until the dial state
+# machine accumulates this many consecutive connect failures, which is
+# the only death evidence available for an address we hold no conn to
+BRIDGE_DEMOTE_FAILS = 3
+# cross-bridge repair relay queue: a region bridge re-exports the
+# sync/repair data it pulls across the WAN into its intra-region mesh
+# (so a rejoining REGION heals through its bridge instead of waiting
+# for each member's coincidental periodic sync), buffered in a
+# byte-capped queue drained by one backpressured task — the
+# RETRANSMIT_BYTES_CAP discipline applied to the WAN seam. Past the
+# cap frames DROP (counted in relay_dropped): the members' own
+# periodic digest syncs remain the correctness backstop, exactly as
+# for any lost sync frame.
+RELAY_QUEUE_BYTES_CAP = 4 << 20
 # dial state machine defaults (overridable via --dial-timeout /
 # --dial-backoff-cap; values live on Config): connect attempts are
 # bounded by DIAL_TIMEOUT seconds (a blackholed peer must not hold a
@@ -472,6 +497,26 @@ class Cluster:
         self._regions: dict[str, tuple[str, int]] = {
             str(self._addr): (self._region, self._epoch)
         }
+        # ---- bridge failover (PR 15) -----------------------------------
+        # per-address liveness evidence: the last tick a frame was
+        # RECEIVED from that advertised address (any conn, either role).
+        # Bridge election consults it (_addr_live): a bridge that
+        # misses its announce cadence past --bridge-demote-ticks is
+        # demoted by every observer and the next-smallest live address
+        # succeeds it deterministically.
+        self._seen_tick: dict[str, int] = {}
+        self._bridge_demote = getattr(
+            config, "bridge_demote_ticks", BRIDGE_DEMOTE_TICKS
+        )
+        # last elected bridge of OUR region ((), an impossible value,
+        # until the first heartbeat computes one — the first election
+        # is not a handover)
+        self._bridge_seen: object = ()
+        # cross-bridge repair relay queue: (name, batch, accounted
+        # bytes) entries, drained FIFO by one backpressured task
+        self._relay_queue: deque = deque()
+        self._relay_queue_bytes = 0
+        self._relay_inflight = False
         # the node's session index (sessions.SessionIndex) — owned by
         # the Database and SHARED by every cluster instance of the node
         # (bus + external on lane 0): applied-vector advances and
@@ -517,6 +562,13 @@ class Cluster:
             "relays_sent": 0,           # origin-preserving re-exports out
             "relays_recv": 0,           # relayed batches converged here
             "region_prunes": 0,         # conns dropped to topology policy
+            # bridge failover (PR 15): handovers this node OBSERVED
+            # (its computed bridge-of-own-region changed), cross-bridge
+            # repair batches re-exported into the intra mesh, and
+            # repair relay frames dropped at the queue's byte cap
+            "bridge_handovers": 0,
+            "repair_relays": 0,
+            "relay_dropped": 0,
         }
         self._drop_counts: dict[str, int] = {}
         # declared message-level drops (MsgDrop reasons): frame
@@ -694,23 +746,22 @@ class Cluster:
             # serve path; a requester that crashed mid-episode would
             # otherwise leave backlog_ms climbing forever)
             self._defer_since_ms = None
+        self._refresh_bridge_role()
         self._prune_region_conns()
         if self._tick % ANNOUNCE_EVERY == 0:
-            self._broadcast_msg(MsgAnnounceAddrs(self._known_addrs.copy()))
             if any(r for r, _ in self._regions.values()):
                 # region membership rides the announce cadence (v10):
                 # without it, an address learned through gossip could
                 # never be classified before a wasted dial. Region-less
                 # clusters skip the frame entirely — their wire traffic
-                # is unchanged from v9's shape.
+                # is unchanged from v9's shape. Gossip goes out BEFORE
+                # the announce: a receiver folds classifications before
+                # _converge_addrs can trigger policy dials on the new
+                # addresses (the reboot dial-storm fix, PR 15).
                 self._broadcast_msg(
-                    MsgRegionGossip(
-                        tuple(
-                            (a, r, e)
-                            for a, (r, e) in sorted(self._regions.items())
-                        )
-                    )
+                    MsgRegionGossip(self._region_entries())
                 )
+            self._broadcast_msg(MsgAnnounceAddrs(self._known_addrs.copy()))
         if self._tick % SYNC_PERIOD_TICKS == 0:
             # periodic anti-entropy digest exchange (see SYNC_PERIOD_TICKS).
             # Deferred while LOCAL writes are flowing: a write-hot node
@@ -790,9 +841,18 @@ class Cluster:
             "sync_bytes_sent", "sync_bytes_recv", "sync_trees_sent",
             "sync_full_dumps", "interval_resets_sent",
             "interval_resets_recv", "relays_sent", "relays_recv",
-            "region_prunes",
+            "region_prunes", "bridge_handovers", "repair_relays",
+            "relay_dropped",
         ):
             out[key] = self._stats[key]
+        # bridge failover (PR 15): whether THIS node is its region's
+        # elected bridge right now, and the repair-relay queue's live
+        # byte depth — both also registry gauges for the Prometheus
+        # scrape
+        out["bridge_is_self"] = (
+            1 if self._region and self._is_bridge() else 0
+        )
+        out["relay_queue_bytes"] = self._relay_queue_bytes
         for reason in sorted(self._drop_counts):
             out[f"drop_{reason}"] = self._drop_counts[reason]
         for reason in sorted(self._msg_drops):
@@ -867,19 +927,90 @@ class Cluster:
 
     # ---- region-aware peering (schema v10) ---------------------------------
 
+    def _note_seen(self, conn: _Conn) -> None:
+        """Record liveness evidence for a peer's advertised address: a
+        frame was RECEIVED from it this tick. Feeds bridge election
+        (_addr_live) — the only consumer — so an address that goes
+        silent ages out of the electorate within the demotion bound."""
+        key = self._peer_key(conn)
+        if key != "unknown":
+            self._seen_tick[key] = self._tick
+
+    def _addr_live(self, addr: Address) -> bool:
+        """Bridge-election liveness: an address is live while frames
+        from it are at most --bridge-demote-ticks old. Self is always
+        live; an address we NEVER heard from is optimistic-live
+        (bootstrap: gossip teaches addresses before contact) until the
+        dial machine accumulates BRIDGE_DEMOTE_FAILS consecutive
+        connect failures — the only death evidence available without a
+        conn."""
+        if addr == self._addr:
+            return True
+        seen = self._seen_tick.get(str(addr))
+        if seen is None:
+            st = self._peers.get(addr)
+            return st is None or st.fails < BRIDGE_DEMOTE_FAILS
+        return self._tick - seen <= self._bridge_demote
+
     def _bridge_of(self, region: str) -> str | None:
         """The deterministic bridge of ``region``: the lexicographically
-        smallest known address classified into it. Every node computes
-        this from the same gossiped region map, so the sparse topology
-        converges without election traffic (the lane-0 bridge pattern,
-        generalized: ONE member of each region speaks WAN)."""
-        return min(
-            (
-                str(a)
-                for a in self._known_addrs
-                if self._regions.get(str(a), ("", 0))[0] == region
-            ),
-            default=None,
+        smallest LIVE known address classified into it (liveness per
+        this observer's own evidence — _addr_live). Every node computes
+        the same succession from the same gossiped region map plus its
+        own observations, so a dead bridge is demoted within the
+        demotion bound and the next-smallest live address takes over
+        with NO election traffic; transient observer disagreement costs
+        a dual-bridge overlap window that the origin-preserving relay
+        dedup absorbs. When EVERY candidate looks dead the v10
+        deterministic choice (smallest address) stands — the topology
+        must stay computable, and a wrong-but-stable answer beats
+        none."""
+        cands = [
+            a
+            for a in self._known_addrs
+            if self._regions.get(str(a), ("", 0))[0] == region
+        ]
+        live = [str(a) for a in cands if self._addr_live(a)]
+        if live:
+            return min(live)
+        return min((str(a) for a in cands), default=None)
+
+    def _refresh_bridge_role(self) -> None:
+        """Heartbeat half of bridge failover: recompute our region's
+        elected bridge, count a handover when it CHANGED (the
+        bridge_handovers counter — the drill's successor-observed
+        signal), and publish the bridge_is_self gauge. Bootstrap
+        counts ONE reclassification (the initial self-only region map
+        elects self until gossip arrives), so consumers compare
+        against a baseline, never against zero. Succession needs no
+        further action here: _sync_actives dials the WAN peers
+        _should_peer now admits, and _prune_region_conns sheds the
+        ones it no longer does."""
+        if not self._region:
+            return
+        b = self._bridge_of(self._region)
+        if b != self._bridge_seen:
+            if self._bridge_seen != ():
+                self._stats["bridge_handovers"] += 1
+                self._reg.trace_event(
+                    "cluster", "bridge_handover", "",
+                    f"{self._bridge_seen} -> {b}",
+                )
+                self._log.info() and self._log.i(
+                    f"region {self._region}: bridge handover "
+                    f"{self._bridge_seen} -> {b}"
+                )
+            self._bridge_seen = b
+        if self._reg.enabled and self._obs_primary:
+            self._reg.gauge_set(
+                "cluster.bridge_is_self",
+                1.0 if b == str(self._addr) else 0.0,
+            )
+
+    def _region_entries(self) -> tuple:
+        """The gossiped region map as sorted wire triples."""
+        return tuple(
+            (a, r, e) for a, (r, e) in sorted(self._regions.items())
         )
 
     def _is_bridge(self) -> bool:
@@ -1083,6 +1214,7 @@ class Cluster:
                         frames.set_max_frame(1 << 30)  # authenticated peer
                         continue
                     self._mark_activity(conn)
+                    self._note_seen(conn)  # bridge-election liveness
                     try:
                         msg = codec.decode(body)
                     except codec.CodecError as e:
@@ -1151,6 +1283,7 @@ class Cluster:
                 self._inbound_contact(conn.peer_addr)
         conn.established = True
         self._mark_activity(conn)
+        self._note_seen(conn)  # the handshake frame is liveness evidence
         if active:
             if not self._should_peer(conn.active_addr):
                 # the echo just taught us this peer is out of the sparse
@@ -1159,11 +1292,18 @@ class Cluster:
                 self._stats["region_prunes"] += 1
                 self._drop(conn, Drop.REGION)
                 return False
-            # we initiated: announce our membership view, replay the
+            # we initiated: gossip our region map FIRST (the receiver
+            # must classify addresses BEFORE the exchange below makes
+            # it dial them — region gossip riding only the announce
+            # cadence left a window where a rebooting single-node
+            # region's bridge re-dialed the whole cluster, PR 15's
+            # dial-storm fix), announce our membership view, replay the
             # peer's unacked delta window (the blip-sized heal: exactly
             # the missed batches, schema v8), then ask for missed state
             # the other way (deltas pushed to us while we were down are
             # not replayable by anyone — the digest request covers them)
+            if any(r for r, _ in self._regions.values()):
+                self._send(conn, MsgRegionGossip(self._region_entries()))
             self._send(conn, MsgExchangeAddrs(self._known_addrs.copy()))
             self._retransmit_unacked(conn)
             self._maybe_request_sync(conn)
@@ -1273,6 +1413,13 @@ class Cluster:
             conn.range_inflight = False
             self._continue_ranges(conn)
             return
+        if isinstance(msg, MsgRegionGossip):
+            # the establishment-time gossip reply (PR 15): the passive
+            # side teaches the dialer its region map BEFORE the address
+            # exchange, so a rebooting node classifies every address
+            # it is about to learn — fold, same as the passive branch
+            self._fold_regions(msg.regions)
+            return
         if isinstance(msg, MsgExchangeAddrs):
             self._converge_addrs(msg.known_addrs)
             return
@@ -1291,6 +1438,20 @@ class Cluster:
             self._record_push_lag(conn, origin_ms)
             if self.on_push is not None:
                 self.on_push(None, 0, msg.name, list(msg.batch))
+            # cross-bridge repair relay (PR 15): a region bridge that
+            # just converged sync/repair data pulled ACROSS the WAN
+            # re-exports it into its intra-region mesh through the
+            # byte-capped relay queue — a rejoining region heals its
+            # members through its bridge instead of waiting for each
+            # member's coincidental periodic sync toward it
+            if self._region and self._is_bridge():
+                src = self._regions.get(
+                    str(conn.active_addr), ("", 0)
+                )[0]
+                if src and src != self._region:
+                    self._queue_repair_relay(
+                        msg.name, msg.batch, max(nbytes, 1)
+                    )
             return
         self._log.err() and self._log.e(
             f"unexpected active message: {type(msg).__name__}"
@@ -1311,8 +1472,13 @@ class Cluster:
             self._drop_msg(conn, MsgDrop.SYNC_DONE_UNSOLICITED)
             return
         if isinstance(msg, MsgExchangeAddrs):
-            # full sync: converge then reply with our own set
+            # full sync: converge then reply with our own set — region
+            # gossip FIRST, so the dialer classifies every address the
+            # exchange teaches it before its policy pass dials them
+            # (the establishment-time half of the dial-storm fix)
             self._converge_addrs(msg.known_addrs)
+            if any(r for r, _ in self._regions.values()):
+                self._send(conn, MsgRegionGossip(self._region_entries()))
             self._send(conn, MsgExchangeAddrs(self._known_addrs.copy()))
             return
         if isinstance(msg, MsgSeqPush):
@@ -2032,6 +2198,9 @@ class Cluster:
                 if not any(str(a) == skey for a in self._known_addrs):
                     self._recv_cum.pop(skey, None)
                     self._recv_ooo.pop(skey, None)
+            for skey in list(self._seen_tick):
+                if not any(str(a) == skey for a in self._known_addrs):
+                    del self._seen_tick[skey]  # dead weight like above
             self._sync_actives()
             self._broadcast_msg(MsgExchangeAddrs(self._known_addrs.copy()))
 
@@ -2118,6 +2287,90 @@ class Cluster:
         name, batch = deltas
         data = self._wire(codec.encode(MsgPushDeltas(name, tuple(batch))))
         self._send_to_actives(data, expect_pong=True)
+
+    def _queue_repair_relay(self, name: str, batch, nbytes: int) -> None:
+        """Enqueue one cross-WAN sync/repair batch for re-export into
+        the intra-region mesh. Byte-capped (RELAY_QUEUE_BYTES_CAP, the
+        retransmit-cap discipline applied to the WAN seam): past the
+        cap the frame DROPS, counted in relay_dropped — the members'
+        periodic digest syncs stay the correctness backstop, so the
+        drop costs latency, never convergence. One drain task at a
+        time, writer backpressure per frame — a slow member paces the
+        relay instead of the queue buffering without bound."""
+        if self._relay_queue_bytes + nbytes > RELAY_QUEUE_BYTES_CAP:
+            self._stats["relay_dropped"] += 1
+            self._reg.trace_event(
+                "cluster", "relay_drop", "",
+                f"{name} {nbytes}B over queue cap",
+            )
+            return
+        self._relay_queue.append((name, batch, nbytes))
+        self._relay_queue_bytes += nbytes
+        if self._reg.enabled and self._obs_primary:
+            self._reg.gauge_set(
+                "cluster.relay_queue_bytes", float(self._relay_queue_bytes)
+            )
+        if not self._relay_inflight:
+            self._relay_inflight = True
+            task = asyncio.get_running_loop().create_task(
+                self._drain_repair_relays()
+            )
+            self._flush_tasks.add(task)
+            task.add_done_callback(self._flush_task_done)
+
+    async def _drain_repair_relays(self) -> None:
+        """Drain the repair-relay queue: encode off the loop, write one
+        frame to every established INTRA-REGION active conn under
+        writer backpressure (drain between frames — the queue's cap
+        plus this pacing is what 'backpressure instead of unbounded
+        buffering' means at this seam). Frames ride as unsequenced
+        MsgPushDeltas exactly like the sync data they re-export:
+        re-originating them as our own sequenced stream would mint
+        own-content ordinals one side can never observe (the lane
+        bridge's push_unsequenced lesson). cluster.relay fires per
+        batch — the WAN seam's failpoint paces/drops here too."""
+        try:
+            while self._relay_queue:
+                name, batch, nbytes = self._relay_queue.popleft()
+                self._relay_queue_bytes -= nbytes
+                if self._reg.enabled and self._obs_primary:
+                    self._reg.gauge_set(
+                        "cluster.relay_queue_bytes",
+                        float(self._relay_queue_bytes),
+                    )
+                try:
+                    # drop/error -> this repair frame is lost (members
+                    # heal on their periodic sync); sleep paces like
+                    # WAN RTT — the same seam contract as _relay_fresh
+                    await faults.async_point("cluster.relay")
+                except faults.FaultError:
+                    continue
+                data = self._wire(
+                    await asyncio.to_thread(
+                        codec.encode, MsgPushDeltas(name, tuple(batch))
+                    )
+                )
+                self._stats["repair_relays"] += 1
+                for addr, conn in list(self._actives.items()):
+                    if not conn.established:
+                        continue
+                    if (
+                        self._regions.get(str(addr), ("", 0))[0]
+                        != self._region
+                    ):
+                        continue  # intra-region fan-out only
+                    if not conn.send_raw(data):
+                        self._drop(conn, Drop.WRITE_FAILED)
+                        continue
+                    if not conn.last_write_dropped:
+                        # a MsgPushDeltas solicits the receiver's Pong
+                        conn.pong_sent.append(self._clock.perf())
+                    try:
+                        await conn.writer.drain()
+                    except (ConnectionError, RuntimeError):
+                        self._drop(conn, Drop.WRITE_FAILED)
+        finally:
+            self._relay_inflight = False
 
     def _ship_sequenced(self, seq: int, data: bytes) -> None:
         """Common tail of the two sequenced send paths: log into the

@@ -73,4 +73,9 @@ GAUGES = (
     # are owed a range repair (schema v8 anti-entropy); pinned at 0 by
     # the churn soak once every heal completes
     "cluster.interval_dirty_peers",
+    # bridge failover (PR 15): 1 while this node is its region's
+    # elected bridge (0 otherwise, and always 0 region-less), and the
+    # live byte depth of the cross-bridge repair relay queue
+    "cluster.bridge_is_self",
+    "cluster.relay_queue_bytes",
 )

@@ -54,6 +54,12 @@ class Config:
     # its intra-region full mesh, with one deterministic bridge per
     # region speaking WAN (docs/operations.md, "Regions")
     region: str = ""
+    # bridge failover (PR 15): heartbeat ticks of received-frame silence
+    # after which an observer demotes an address from bridge election —
+    # the next-smallest live address takes over with no election
+    # traffic. With ANNOUNCE_EVERY=3 the default tolerates four missed
+    # announce rounds before a handover (docs/operations.md, "Regions")
+    bridge_demote_ticks: int = 12
     # extension: session guarantees (sessions.py, docs/sessions.md) —
     # how long a SESSION READ may wait for its token to be covered
     # before the typed STALE refusal
@@ -199,6 +205,17 @@ def config_from_cli(argv: list[str] | None = None, log_out=None) -> Config:
         "deployment should either set regions or not mix.",
     )
     parser.add_argument(
+        "--bridge-demote-ticks", type=int,
+        default=Config.bridge_demote_ticks,
+        help="Heartbeat ticks of received-frame silence after which a "
+        "node demotes an address from bridge election (regions only): "
+        "a dead bridge is succeeded by the next-smallest live address "
+        "within this bound, with no election traffic. The default "
+        "tolerates four missed announce rounds; lower it for faster "
+        "WAN failover at the cost of spurious handovers under load "
+        "(harmless — relay dedup absorbs dual-bridge overlap).",
+    )
+    parser.add_argument(
         "--session-wait-ms", type=int, default=Config.session_wait_ms,
         help="Bounded wait for SESSION READ: how long a read holding a "
         "session token may wait for this replica's applied-interval "
@@ -287,6 +304,7 @@ def config_from_cli(argv: list[str] | None = None, log_out=None) -> Config:
     config.delta_log_cap = args.delta_log_cap
     config.range_budget = args.range_budget
     config.region = args.region
+    config.bridge_demote_ticks = args.bridge_demote_ticks
     config.session_wait_ms = args.session_wait_ms
     config.admission_cap = args.admission_cap
     config.failpoints = args.failpoints

@@ -198,6 +198,18 @@ CLAIMS = [
      lambda v: f"{v:.1f} ms", "a {} relay-path base", "operations doc wan base lag"),
     ("README.md", "wan-converge", "value",
      lambda v: f"{v:.1f} ms", "converges in {} under 80 ms", "README wan lag"),
+    # bridge failover (PR 15): the recorded SIGKILL-to-reconverged gap
+    # and the demotion window it is asserted against, pinned wherever
+    # the prose claims the handover numbers
+    ("docs/operations.md", "wan-converge", "failover_gap_80_ms",
+     lambda v: f"{v:.1f} ms gap", "a {} at 80 ms injected RTT",
+     "operations doc failover gap"),
+    ("docs/operations.md", "wan-converge", "failover_demote_ticks",
+     lambda v: f"{v:.0f}-tick", "the recorded {} × 0.2 s demotion window",
+     "operations doc failover demotion window"),
+    ("README.md", "wan-converge", "failover_gap_80_ms",
+     lambda v: f"{v / 1e3:.1f} s",
+     "measures a {} SIGKILL-to-reconverged gap", "README failover gap"),
 ]
 
 

@@ -104,6 +104,7 @@ class Explorer:
         max_states: int | None = None,
         escrow_unsafe: bool = False,
         session_unsafe: bool = False,
+        bridge_unsafe: bool = False,
     ):
         self.config = config
         self.depth = depth
@@ -117,6 +118,10 @@ class Explorer:
         # ... and the broken session-watermark rule (sessions.py unsafe
         # mode): the session_ryw counterexample demonstration
         self.session_unsafe = session_unsafe
+        # ... and the broken bridge-demotion rule (never demote — the
+        # pre-failover v10 behavior): the bridge_demotion stale-bridge
+        # counterexample demonstration (PR 15)
+        self.bridge_unsafe = bridge_unsafe
         self.visited: set[str] = set()
         self.leaves = 0
         self.quiesced = 0
@@ -125,7 +130,8 @@ class Explorer:
     def _replay(self, trace) -> World:
         world = World(self.config, self.budgets, runtime=self._runtime,
                       escrow_unsafe=self.escrow_unsafe,
-                      session_unsafe=self.session_unsafe)
+                      session_unsafe=self.session_unsafe,
+                      bridge_unsafe=self.bridge_unsafe)
         try:
             for action in trace:
                 applied = world.apply(tuple(action))
@@ -152,11 +158,13 @@ class Explorer:
                 self.config, f.trace, f.violation.name, self.budgets,
                 runtime=self._runtime, escrow_unsafe=self.escrow_unsafe,
                 session_unsafe=self.session_unsafe,
+                bridge_unsafe=self.bridge_unsafe,
             )
             result.schedule = schedule_dict(
                 self.config, minimized, expect=f.violation.name,
                 note=f.violation.detail, escrow_unsafe=self.escrow_unsafe,
                 session_unsafe=self.session_unsafe,
+                bridge_unsafe=self.bridge_unsafe,
                 budgets=self.budgets,
             )
         except _Done:
@@ -237,7 +245,7 @@ class Explorer:
 def schedule_dict(
     config: str, actions, expect: str = "pass", note: str = "",
     escrow_unsafe: bool = False, session_unsafe: bool = False,
-    budgets: dict | None = None,
+    bridge_unsafe: bool = False, budgets: dict | None = None,
 ) -> dict:
     out = {
         "schema": SCHEDULE_SCHEMA,
@@ -256,6 +264,9 @@ def schedule_dict(
     if session_unsafe:
         # likewise for the broken session-watermark rule
         out["session_unsafe"] = True
+    if bridge_unsafe:
+        # likewise for the broken bridge-demotion rule
+        out["bridge_unsafe"] = True
     if budgets:
         # non-default budgets are part of the counterexample: without
         # them a standalone replay silently skips now-disabled actions
@@ -276,7 +287,8 @@ def replay_schedule(
     world = World(data["config"], budgets or data.get("budgets"),
                   runtime=runtime,
                   escrow_unsafe=bool(data.get("escrow_unsafe")),
-                  session_unsafe=bool(data.get("session_unsafe")))
+                  session_unsafe=bool(data.get("session_unsafe")),
+                  bridge_unsafe=bool(data.get("bridge_unsafe")))
     try:
         explicit_quiesce = False
         for raw in data["actions"]:
@@ -300,6 +312,7 @@ def minimize(
     config: str, trace: list, expect: str, budgets: dict | None = None,
     rounds: int = 4, runtime: Runtime | None = None,
     escrow_unsafe: bool = False, session_unsafe: bool = False,
+    bridge_unsafe: bool = False,
 ) -> list:
     """ddmin-lite over the action trace: greedily drop actions while
     replaying still hits the SAME invariant. Replays are cheap at
@@ -316,6 +329,8 @@ def minimize(
             data["escrow_unsafe"] = True
         if session_unsafe:
             data["session_unsafe"] = True
+        if bridge_unsafe:
+            data["bridge_unsafe"] = True
         v = replay_schedule(data, budgets, runtime=runtime)
         return v is not None and v.name == expect
 

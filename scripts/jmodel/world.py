@@ -76,7 +76,20 @@ DEFAULT_BUDGETS = {
     # read-your-writes invariant then holds at EVERY later state: any
     # replica whose vector dominates the token must show the floor
     "mints": 1,
+    # bridge failover (PR 15, regions3 only): bkill takes a group DOWN
+    # and LEAVES it down (unlike crash's immediate reboot) so the
+    # schedules between the kill and the matching breboot — exactly
+    # where liveness demotion, succession, and the dual-bridge overlap
+    # live — are explorable; quiesce reboots any still-down group
+    # before asserting convergence
+    "bkills": 1,
 }
+
+# the demotion threshold every model Cluster runs with (small enough
+# that directed schedules reach a handover within a few tick actions);
+# the bridge_demotion invariant checks observers against THIS value
+# even when bridge_unsafe arms the broken never-demote rule
+BRIDGE_DEMOTE_MODEL = 6
 
 # the modelled bounded counter: one key, bound granted (and matching
 # dec-escrow minted via incs) by the rid-1 replica's row — a CONVERGED
@@ -590,12 +603,22 @@ class Runtime:
         self.loop.close()
 
 
-def _mk_config(addr: Address, seeds, region: str = "") -> Config:
+def _mk_config(
+    addr: Address, seeds, region: str = "", bridge_unsafe: bool = False
+) -> Config:
     cfg = Config()
     cfg.addr = addr
     cfg.seed_addrs = list(seeds)
     cfg.heartbeat_time = 999.0  # never started: the explorer IS the heart
     cfg.region = region
+    # bridge_unsafe arms the DELIBERATELY broken demotion rule — the
+    # v10 status quo: a threshold no schedule can reach, so a dead
+    # bridge stays elected forever. The bridge_demotion invariant
+    # (checked against BRIDGE_DEMOTE_MODEL regardless) must then yield
+    # a minimized counterexample.
+    cfg.bridge_demote_ticks = (1 << 30) if bridge_unsafe else (
+        BRIDGE_DEMOTE_MODEL
+    )
     cfg.log = Log.create_none()
     return cfg
 
@@ -608,6 +631,7 @@ class World:
         runtime: Runtime | None = None,
         escrow_unsafe: bool = False,
         session_unsafe: bool = False,
+        bridge_unsafe: bool = False,
     ):
         if config_name not in CONFIG_NAMES:
             raise ValueError(f"unknown config {config_name!r}")
@@ -625,6 +649,11 @@ class World:
         # then find a token-satisfied read observing a missing write —
         # the session_ryw counterexample demonstration
         self.session_unsafe = session_unsafe
+        # bridge_unsafe arms the broken bridge-demotion rule (an
+        # unreachable threshold — the pre-failover v10 behavior): the
+        # bridge_demotion invariant must then yield a minimized
+        # stale-bridge counterexample (PR 15)
+        self.bridge_unsafe = bridge_unsafe
         self._owns_runtime = runtime is None
         self._runtime = runtime or Runtime()
         self.loop = self._runtime.loop
@@ -636,8 +665,12 @@ class World:
         self._group_builders: dict[str, callable] = {}
         self.used = {
             "dups": 0, "kills": 0, "crashes": 0, "partitions": 0,
-            "bxfers": 0,
+            "bxfers": 0, "bkills": 0,
         }
+        # groups taken down by bkill and not yet rebooted: no ticks, no
+        # writes, no deliveries land there; quiesce reboots them first
+        self.down_groups: set[str] = set()
+        self._down_journals: dict[str, list] = {}
         self.writes_left: dict[str, int] = {}
         self.bdecs_left: dict[str, int] = {}
         self.mints_left: dict[str, int] = {}
@@ -701,7 +734,7 @@ class World:
         inst = Instance(key, group, addr)
         inst.database = db
         inst.cluster = Cluster(
-            _mk_config(addr, seeds, region),
+            _mk_config(addr, seeds, region, self.bridge_unsafe),
             db,
             drive_flush=drive_flush,
             register_system=register_system,
@@ -877,6 +910,17 @@ class World:
                 and self._group_alive(group)
             ):
                 acts.append(("crash", group))
+            # bridge-kill/reboot axis (PR 15, regions3): unlike crash's
+            # immediate reboot, bkill leaves the group DOWN so the
+            # demotion/succession window is itself explorable
+            if self.config_name == "regions3":
+                if (
+                    self.used["bkills"] < self.budgets["bkills"]
+                    and self._group_alive(group)
+                ):
+                    acts.append(("bkill", group))
+                if group in self.down_groups:
+                    acts.append(("breboot", group))
         # escrow transfers OUT of the seed-escrow group (the only group
         # holding dec-rights before any transfer): the interplay the
         # bcount invariant must survive — a transfer racing the sender's
@@ -902,6 +946,8 @@ class World:
         return acts
 
     def _group_alive(self, group: str) -> bool:
+        if group in self.down_groups:
+            return False
         return all(
             i.alive for i in self.instances.values() if i.group == group
         )
@@ -968,6 +1014,18 @@ class World:
                 and self.used["crashes"] < self.budgets["crashes"]
                 and self._group_alive(action[1])
             )
+        if kind == "bkill":
+            return (
+                self.config_name == "regions3"
+                and action[1] in self._group_builders
+                and self.used["bkills"] < self.budgets["bkills"]
+                and self._group_alive(action[1])
+            )
+        if kind == "breboot":
+            return (
+                self.config_name == "regions3"
+                and action[1] in self.down_groups
+            )
         if kind == "part":
             return (
                 self.config_name != "lanes2"
@@ -1021,6 +1079,11 @@ class World:
         elif kind == "crash":
             self.used["crashes"] += 1
             self._crash_reboot(action[1])
+        elif kind == "bkill":
+            self.used["bkills"] += 1
+            self._kill_group(action[1])
+        elif kind == "breboot":
+            self._reboot_group(action[1])
         elif kind == "part":
             self.used["partitions"] += 1
             pair = frozenset((action[1], action[2]))
@@ -1061,13 +1124,18 @@ class World:
         )
 
     def _crash_reboot(self, group: str) -> None:
-        # a reboot is a new incarnation: advance the virtual clock so
-        # the rebuilt Cluster mints a fresh boot epoch (production wall
-        # time guarantees this; the model must too, or the new seq
-        # stream would alias the old one in every peer's session vector)
-        self.clock.advance(TICK_MS)
-        self.boot_count[group] = self.boot_count.get(group, 0) + 1
-        journal = list(self.dbs[group].journal)
+        self._kill_group(group)
+        self._reboot_group(group)
+
+    def _kill_group(self, group: str) -> None:
+        """Take a group down and LEAVE it down (the bkill half): its
+        journal is snapshotted for the eventual reboot, its instances
+        dispose, its conns die abortively. The explorable window
+        between this and the matching breboot is where bridge
+        demotion, deterministic succession and the dual-bridge overlap
+        live."""
+        self._down_journals[group] = list(self.dbs[group].journal)
+        self.down_groups.add(group)
 
         def down():
             for key in [
@@ -1080,6 +1148,16 @@ class World:
 
         self._run(down)
         self.net.gc_conns()
+
+    def _reboot_group(self, group: str) -> None:
+        # a reboot is a new incarnation: advance the virtual clock so
+        # the rebuilt Cluster mints a fresh boot epoch (production wall
+        # time guarantees this; the model must too, or the new seq
+        # stream would alias the old one in every peer's session vector)
+        self.clock.advance(TICK_MS)
+        self.boot_count[group] = self.boot_count.get(group, 0) + 1
+        journal = self._down_journals.pop(group)
+        self.down_groups.discard(group)
         # reboot from "disk": the journaled local writes survive,
         # converged remote state heals back over the rejoin sync
         self._group_builders[group](journal)
@@ -1152,6 +1230,48 @@ class World:
             if not inst.alive:
                 continue
             c = inst.cluster
+            # bounded handover (PR 15): a node never keeps electing a
+            # bridge its OWN evidence says has been silent past the
+            # demotion bound while a live successor exists. Checked
+            # against BRIDGE_DEMOTE_MODEL — NOT the instance's armed
+            # threshold — so the deliberately broken never-demote rule
+            # (bridge_unsafe) surfaces here as a minimized stale-bridge
+            # counterexample while the safe rule survives the identical
+            # schedule by construction.
+            if c._region:
+                b = c._bridge_of(c._region)
+                me = str(inst.addr)
+                seen = c._seen_tick.get(b) if b is not None else None
+                if (
+                    b is not None
+                    and b != me
+                    and seen is not None
+                    and c._tick - seen > BRIDGE_DEMOTE_MODEL
+                ):
+                    def _fresh(a) -> bool:
+                        if str(a) == me:
+                            return True
+                        t = c._seen_tick.get(str(a))
+                        return (
+                            t is not None
+                            and c._tick - t <= BRIDGE_DEMOTE_MODEL
+                        )
+
+                    alt = any(
+                        _fresh(a)
+                        for a in c._known_addrs
+                        if str(a) != b
+                        and c._regions.get(str(a), ("", 0))[0]
+                        == c._region
+                    )
+                    if alt:
+                        raise Violation(
+                            "bridge_demotion",
+                            f"{key}: elected bridge {b} silent "
+                            f"{c._tick - seen} ticks (bound "
+                            f"{BRIDGE_DEMOTE_MODEL}) with a live "
+                            "successor available",
+                        )
             # held queue: bounded and FIFO by hold time
             if len(c._held) > c._held_cap:
                 raise Violation(
@@ -1265,6 +1385,11 @@ class World:
         digest match on every replica, no in-flight or held frames, no
         stranded rtt stamps."""
         self.net.partitions.clear()
+        # groups still down from a bkill reboot first: quiescence is
+        # about the HEALED system, and a down group can neither
+        # converge nor serve its half of any invariant
+        for group in sorted(self.down_groups):
+            self._reboot_group(group)
         period = cluster_mod.SYNC_PERIOD_TICKS
         stable = 0
         for _ in range(40 * period):
@@ -1321,6 +1446,12 @@ class World:
                 raise Violation(
                     "range_queue_drained",
                     f"{key}: {len(c._range_queue)} range serves queued",
+                )
+            if c._relay_queue:
+                raise Violation(
+                    "relay_queue_drained",
+                    f"{key}: {len(c._relay_queue)} repair relays queued "
+                    "after quiescence",
                 )
             for addr, st in sorted(
                 c._peers.items(), key=lambda kv: str(kv[0])
@@ -1521,6 +1652,20 @@ class World:
                 # region topology state (v10): the gossiped region map
                 # drives dial policy and relay roles
                 "regions": sorted(c._regions.items()),
+                # bridge failover (PR 15): per-address liveness ages
+                # (capped at the demote bound + 1 — election only asks
+                # "over or under", so finer ages would defeat dedup for
+                # nothing), the elected bridge, and the repair relay
+                # queue. Region-less instances skip all three (the
+                # state exists but drives no behavior there).
+                "bridge": [
+                    sorted(
+                        (a, min(tick - t, c._bridge_demote + 1))
+                        for a, t in c._seen_tick.items()
+                    ),
+                    c._bridge_seen if c._bridge_seen != () else None,
+                    [len(c._relay_queue), c._relay_queue_bytes],
+                ] if c._region else None,
                 "stats": sorted(c._stats.items()),
                 "drops": sorted(c._drop_counts.items()),
                 "msg_drops": sorted(c._msg_drops.items()),
@@ -1561,6 +1706,7 @@ class World:
             "instances": insts,
             "conns": conns,
             "partitions": sorted(sorted(p) for p in self.net.partitions),
+            "down": sorted(self.down_groups),
             "used": sorted(self.used.items()),
             "writes_left": sorted(self.writes_left.items()),
             "bdecs_left": sorted(self.bdecs_left.items()),

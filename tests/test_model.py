@@ -448,3 +448,126 @@ def test_bridge_tokens_verify_live_despite_interleaved_relays():
             ), (group, svec, vec)
     finally:
         w.close()
+
+
+# ---- bridge failover (PR 15) ------------------------------------------------
+
+
+def _drive_bridge_break(bridge_unsafe: bool):
+    """Directed schedule for the broken-demotion demonstration: foo is
+    region ra's bridge (foo+bar; baz is rb). Mesh up so bar holds
+    received-frame evidence of foo, bkill foo (down and STAYS down —
+    the new axis), then keep ticking bar: its evidence of foo ages
+    past the model demotion bound while bar itself is a live
+    successor. The broken rule (never demote — the pre-failover v10
+    behavior) keeps electing the dead bridge, which the
+    bridge_demotion invariant flags; the safe rule hands over to bar
+    on the same schedule."""
+    from scripts.jmodel.world import BRIDGE_DEMOTE_MODEL
+
+    with model_periods():
+        w = World("regions3", bridge_unsafe=bridge_unsafe)
+        trace: list = []
+
+        def do(a):
+            trace.append(tuple(a))
+            if w.apply(a):
+                w.check_invariants()
+
+        def pump():
+            for _ in range(4):
+                for a in list(w.enabled_actions()):
+                    if a[0] == "deliver":
+                        do(a)
+
+        try:
+            for _ in range(3):
+                for key in ("foo", "bar", "baz"):
+                    do(("tick", key))
+                pump()
+            # bar must hold direct evidence of foo before the kill, or
+            # demotion has nothing to age out
+            assert (
+                str(w.instances["foo"].addr)
+                in w.instances["bar"].cluster._seen_tick
+            )
+            do(("bkill", "foo"))
+            for _ in range(BRIDGE_DEMOTE_MODEL + 3):
+                do(("tick", "bar"))
+            return None, trace
+        except Violation as v:
+            return v, trace
+        finally:
+            w.close()
+
+
+def test_broken_demotion_rule_yields_minimized_counterexample():
+    """Arm the DELIBERATELY broken bridge-demotion rule (an
+    unreachable threshold — exactly the v10 single-WAN-path status
+    quo) and the directed schedule must keep a provably-dead bridge
+    elected past the bound with a live successor available
+    (bridge_demotion); ddmin shrinks it to a standalone-replayable
+    artifact, and the SAME schedule under the real liveness rule holds
+    every invariant — bounded handover is exactly what the demotion
+    threshold buys."""
+    v, trace = _drive_bridge_break(bridge_unsafe=True)
+    assert v is not None and v.name == "bridge_demotion", v
+    with model_periods():
+        minimized = minimize(
+            "regions3", trace, "bridge_demotion", bridge_unsafe=True
+        )
+        sched = schedule_dict(
+            "regions3", minimized, expect="bridge_demotion",
+            note=v.detail, bridge_unsafe=True,
+        )
+        assert sched["bridge_unsafe"] is True
+        assert len(minimized) < len(trace)
+        replayed = replay_schedule(json.loads(json.dumps(sched)))
+        assert replayed is not None and replayed.name == "bridge_demotion"
+        # the liveness rule survives the identical schedule (and its
+        # final auto-quiesce reboots the killed bridge and converges)
+        safe = {k: v2 for k, v2 in sched.items() if k != "bridge_unsafe"}
+        assert replay_schedule(safe) is None
+
+
+def test_safe_demotion_rule_survives_the_directed_schedule():
+    v, _trace = _drive_bridge_break(bridge_unsafe=False)
+    assert v is None, v
+
+
+def test_bkill_window_explores_and_quiesce_reboots():
+    """The bkill/breboot axis end to end: kill the bridge, let the
+    survivors churn through the succession window, reboot, and the
+    world still quiesces to a digest match with every ladder law
+    holding (zero whole-state dumps is the real cluster's gate; here
+    the model's convergence + drain laws are the proof)."""
+    with model_periods():
+        w = World("regions3")
+        try:
+            def pump(rounds: int):
+                for _ in range(rounds):
+                    for key in sorted(w.instances):
+                        if w.instances[key].alive:
+                            w.apply(("tick", key))
+                    for _ in range(4):
+                        for a in list(w.enabled_actions()):
+                            if a[0] == "deliver":
+                                w.apply(a)
+                    w.check_invariants()
+
+            pump(4)
+            # baseline BEFORE the kill: bootstrap already counted the
+            # self -> foo reclassification on bar
+            h0 = w.instances["bar"].cluster._stats["bridge_handovers"]
+            assert w.apply(("bkill", "foo"))
+            assert not w._group_alive("foo")
+            w.check_invariants()
+            pump(8)  # the succession window: bar takes over ra
+            bar = w.instances["bar"].cluster
+            assert bar._bridge_of("ra") == str(w.instances["bar"].addr)
+            assert bar._stats["bridge_handovers"] > h0
+            assert w.apply(("breboot", "foo"))
+            pump(4)
+            w.quiesce()  # digest match + drained ladders everywhere
+        finally:
+            w.close()

@@ -395,3 +395,96 @@ async def _admission_cap():
     await db.apply_async(served, [b"GCOUNT", b"GET", b"h"])
     assert served.parts and served.parts[0][0] != "err"
     db.clean_shutdown()
+
+
+def test_session_token_through_dead_bridge_stale_then_satisfied():
+    """Bridge failover x sessions (PR 15): a token minted on a region
+    member whose only WAN path was the now-dead bridge goes typed
+    STALE on the remote region within --session-wait-ms — never a
+    stale serve — and SATISFIES after the deterministic handover,
+    once the successor's digest sync carries the adoption proof
+    across."""
+    asyncio.run(_token_through_dead_bridge())
+
+
+async def _token_through_dead_bridge():
+    from jylis_tpu import faults
+
+    p_a, p_b, p_c = sorted(grab_ports(3))
+    a = Node("aye", p_a, region="r1")
+    b = Node("bee", p_b, seeds=[a.config.addr], region="r1")
+    c = Node("sea", p_c, seeds=[a.config.addr], region="r2")
+    c.database.session_wait_ms = 150
+    for n in (a, b, c):
+        n.cluster._bridge_demote = 8
+        await n.start()
+    a_stopped = False
+    try:
+        def sparse() -> bool:
+            return (
+                len(a.cluster._actives) == 2
+                and a.cluster._is_bridge()
+                and c.cluster._is_bridge()
+                and all(
+                    cn.established
+                    for n in (a, b, c)
+                    for cn in n.cluster._actives.values()
+                )
+            )
+
+        assert await converge_wait(sparse, ticks=200)
+
+        # the WAN relay is severed BEFORE the write: the token's
+        # frames reach the bridge and die there — exactly the gap a
+        # dead bridge leaves
+        faults.arm("cluster.relay", "drop", budget=10_000)
+        try:
+            tok = await _wrap_write(
+                b.server.port, b"GCOUNT", b"INC", b"fk", b"3"
+            )
+            vec = sessions.decode_token(tok)
+            # sea must not have been healed through a periodic sync
+            # before the kill — the STALE assertion below needs the
+            # gap to be real
+            assert not c.database.sessions.dominated(vec)
+            await a.stop()  # the bridge dies with the relay unflushed
+            a_stopped = True
+        finally:
+            faults.disarm("cluster.relay")
+
+        # pre-handover: typed STALE within the bounded wait
+        loop = asyncio.get_event_loop()
+        t0 = loop.time()
+        out = await _session_read(
+            c.server.port, tok, b"GCOUNT", b"GET", b"fk"
+        )
+        waited = loop.time() - t0
+        assert out.startswith(b"-STALE"), out
+        assert waited < 2.0, waited  # 150 ms bound + socket slack
+
+        # handover: bee succeeds, dials sea, range repair + the
+        # digest-match adoption carry the watermark across
+        assert await converge_wait(
+            lambda: b.cluster._is_bridge(), ticks=600
+        )
+        out = b""
+        for _ in range(400):
+            out = await _session_read(
+                c.server.port, tok, b"GCOUNT", b"GET", b"fk"
+            )
+            if out.startswith(b"*2\r\n$"):
+                break
+            assert out.startswith(b"-STALE"), out
+            await asyncio.sleep(TICK)
+        assert out.startswith(b"*2\r\n$"), out
+        assert out.endswith(b":3\r\n"), out
+        # monotonic reads survive the failover: reply token dominates
+        _, _, rest = out.partition(b"$")
+        n_, _, tail = rest.partition(b"\r\n")
+        reply_vec = sessions.decode_token(tail[: int(n_)])
+        assert sessions.dominates(reply_vec, vec), (reply_vec, vec)
+        assert b.cluster._stats["sync_full_dumps"] == 0
+        assert c.cluster._stats["sync_full_dumps"] == 0
+    finally:
+        for n in ((b, c) if a_stopped else (a, b, c)):
+            await n.stop()
