@@ -5,7 +5,8 @@ PY ?= python
 
 .PHONY: test soak bench bench-all bench-full bench-smoke native run clean \
         check-graft ci check-prose image compose-smoke smoke3 release \
-        lint lint-native sanitize chaos metrics-smoke model-smoke
+        lint lint-native sanitize sanitize-threads chaos metrics-smoke \
+        model-smoke
 
 # what CI runs per commit (.github/workflows/ci.yml + .circleci/config.yml):
 # hermetic on any host. `test` includes the journal suite
@@ -14,19 +15,22 @@ PY ?= python
 # (scripts/jlint — async/thread safety, JAX trace discipline, native/Python
 # RESP surface parity, failpoint manifest parity); `sanitize` rebuilds the
 # native engine under ASAN+UBSAN with -Werror and re-runs the jax-free
-# native test subset; `chaos` is the tiny fault-injection drill smoke.
+# native test subset; `sanitize-threads` rebuilds it under TSAN and runs
+# the multi-threaded engine drive; `chaos` is the tiny fault-injection
+# drill smoke.
 ci: native lint lint-native test chaos model-smoke check-graft check-prose \
-    bench-smoke metrics-smoke sanitize
+    bench-smoke metrics-smoke sanitize sanitize-threads
 
-# the ten jlint passes + the hygiene rules (broad-except, suppression
+# the eleven jlint passes + the hygiene rules (broad-except, suppression
 # reasons/staleness), against the committed baseline
 # (scripts/jlint/baseline.json — every entry justified in-line, stale
 # entries fail). The manifest checks (RESP parity, failpoints, metrics,
 # lane shared-state, codec symmetry, lattice discipline, protocol
-# atlas) re-extract
+# atlas, cross-language RESP semantics) re-extract
 # their surfaces on every run and fail on uncommitted drift; regenerate
 # with `$(PY) -m scripts.jlint --write-manifest` (then `--write-corpus`
-# if the codec manifest changed) and commit the diff. `--budget` fails
+# if the codec or semantics manifest changed) and commit the diff.
+# `--budget` fails
 # the run past the recorded wall-time bound (scripts/jlint/budget.json);
 # lint_findings.json is the machine-readable CI artifact.
 lint:
@@ -53,6 +57,31 @@ sanitize:
 	  UBSAN_OPTIONS=print_stacktrace=1,halt_on_error=1 \
 	  $(PY) -m pytest tests/test_native_resp.py tests/test_native_drive.py \
 	  -q -p no:cacheprovider
+
+# TSAN build of the native engine + the multi-threaded ServeEngine
+# drive (tests/test_native_tsan.py): per-thread engine isolation
+# (concurrent full-surface bursts — ctypes drops the GIL, so the C++
+# genuinely runs in parallel) and the external-mutex discipline for a
+# shared engine (memo install/invalidate, interner compaction under
+# ingest). Skips loudly (exit 0) when the toolchain has no libtsan —
+# same policy as clang-tidy in lint-native; the same module still runs
+# GIL-only in tier-1 either way. jax stays un-imported (JYLIS_SANITIZE),
+# as in `sanitize`.
+sanitize-threads:
+	@tsan=$$(g++ -print-file-name=libtsan.so); \
+	if [ "$$tsan" = "libtsan.so" ] || [ ! -e "$$tsan" ]; then \
+	  echo "sanitize-threads: libtsan not found on this toolchain — TSAN step skipped"; \
+	  echo "(tests/test_native_tsan.py still runs un-instrumented in tier-1)"; \
+	  exit 0; \
+	fi; \
+	set -e; \
+	g++ -O1 -g -std=c++17 -shared -fPIC -fsanitize=thread \
+	  -Wall -Wextra -Werror \
+	  -o native/libjylis_native_tsan.so native/*.cpp; \
+	JYLIS_SANITIZE=1 JYLIS_NATIVE_SO=$(abspath native/libjylis_native_tsan.so) \
+	  LD_PRELOAD=$$tsan \
+	  TSAN_OPTIONS=halt_on_error=1,second_deadlock_stack=1 \
+	  $(PY) -m pytest tests/test_native_tsan.py -q -p no:cacheprovider
 
 # every README headline number must match the committed BENCH_full.json
 check-prose:
@@ -173,6 +202,6 @@ smoke3:
 
 clean:
 	rm -f native/libjylis_native.so jylis_tpu/native/libjylis_native.so \
-	  native/libjylis_native_san.so
+	  native/libjylis_native_san.so native/libjylis_native_tsan.so
 	rm -rf build dist
 	find . -name __pycache__ -type d -exec rm -rf {} +

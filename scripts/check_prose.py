@@ -242,6 +242,20 @@ REPO_CLAIMS = [
     ("docs/development.md", "scripts/jlint/budget.json",
      lambda d: d["model_min_states"], lambda v: f"{v / 1000:.0f}k-state floor",
      "below the {}", "development doc jmodel state floor"),
+    # jlint v3 round: the native-surface burn-down number (ROADMAP
+    # item 1) is the parity manifest's python_only count — surfaced in
+    # lint_findings.json as counts.python_only and pinned here so the
+    # prose tracks the record as commands move native; and the
+    # semantics manifest's command count, which pass 11 requires to
+    # cover the full native surface
+    ("docs/development.md", "scripts/jlint/parity_manifest.json",
+     lambda d: sum(len(v) for v in d["python_only"].values()), str,
+     "declares {} commands still Python-only",
+     "development doc python-only burn-down count"),
+    ("docs/development.md", "scripts/jlint/semantics_manifest.json",
+     lambda d: len(d["commands"]), str,
+     "across all {} natively-served commands",
+     "development doc semantics command count"),
 ]
 
 

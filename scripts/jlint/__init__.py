@@ -71,6 +71,23 @@ calls through the call graph and powers three semantic passes:
   silent fall-throughs, and manifest drift fail; jmodel
   (``scripts/jmodel``) explores the same protocol dynamically.
 
+jlint v3 adds the cross-language seam:
+
+* **Pass 11 — RESP semantic parity** (`pass_semantics`, JL110x): a
+  purpose-built C++ front-end (``cpp_ast.py`` — tokenizer + recursive
+  descent over the disciplined subset ``native/`` is written in, no
+  libclang) symbolically extracts every natively-served command's
+  argument grammar, numeric bounds, validators, reply shape and error
+  mode from ``native/serve_engine.cpp``/``resp_parser.cpp``/
+  ``engine.h``, diffs them against the Python oracle's dispatch ASTs
+  into the committed ``semantics_manifest.json`` (JL1101 grammar/
+  bounds/transport/threshold divergence, JL1102 reply-shape/error
+  divergence, JL1103 drift/stale/placeholder/coverage/stale-harness),
+  and GENERATES the differential fuzz harness
+  (``tests/test_semantic_fuzz.py`` via ``scripts/gen_semfuzz.py``:
+  seeded valid/boundary/mutated-invalid command streams byte-compared
+  through both server paths, corpus sha-pinned in ``tests/golden/``).
+
 Plus the hygiene rules: JL001 (``except Exception`` / bare ``except``
 without justification), JL002 (an inline suppression carrying no
 reason), JL003 (a stale inline suppression whose rule no longer fires
@@ -91,8 +108,9 @@ Suppression works at two levels, both requiring a human-readable reason:
 
 Run ``python -m scripts.jlint`` from the repo root (what ``make lint``
 does, plus ``--budget --out lint_findings.json``); ``--write-manifest``
-regenerates every committed manifest and the generated lattice harness,
-``--write-corpus`` re-records the golden codec corpus.
+regenerates every committed manifest and the generated lattice +
+semantic-fuzz harnesses, ``--write-corpus`` re-records the golden
+codec and semantic-fuzz corpora.
 """
 
 from __future__ import annotations
@@ -145,6 +163,9 @@ RULES = {
     "JL1001": (None, "cluster protocol handler effect outside the committed atlas (protocol_manifest.json)"),
     "JL1002": (None, "undeclared (role, state, msg) fall-through or silent ignore in a cluster protocol handler"),
     "JL1003": (None, "protocol manifest drift, missing, or undescribed (--write-manifest regenerates)"),
+    "JL1101": (None, "native command grammar/bounds diverge from the Python oracle (arity, u64 args, transport limits, thresholds)"),
+    "JL1102": (None, "native RESP reply shape or error taxonomy diverges from the Python oracle"),
+    "JL1103": (None, "semantics manifest drift/stale/placeholder, uncovered native command, or stale generated fuzz harness"),
 }
 
 # slug -> every rule that honors it (JL104/JL903 share lockio-ok; the

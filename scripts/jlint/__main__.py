@@ -3,22 +3,23 @@
 Exit 0 only when every pass is clean: no unsuppressed finding, no stale
 baseline entry or inline suppression, no manifest drift. One semantic
 core (scripts/jlint/core.py) is built per run — content-hash-cached
-ASTs, call graph, per-function summaries — and all ten passes consume
-it.
+ASTs, call graph, per-function summaries — and all eleven passes
+consume it.
 
 * ``--write-manifest`` regenerates every committed manifest (parity,
   failpoints, metrics, lanes, codec, lattice + the generated lattice
-  property harness, protocol atlas) in place and exits: commit the
-  diff.
+  property harness, protocol atlas, semantics + the generated
+  differential fuzz harness) in place and exits: commit the diff.
 * ``--write-corpus`` regenerates the golden codec corpus
-  (tests/golden/codec_corpus.json) from the current codec manifest
-  (imports the product; run after any --write-manifest that changed
-  codec_manifest.json).
+  (tests/golden/codec_corpus.json) from the current codec manifest and
+  the golden semantic-fuzz corpus (tests/golden/semfuzz_corpus.json)
+  from the current semantics manifest (imports the product; run after
+  any --write-manifest that changed either manifest).
 * ``--out PATH`` writes machine-readable findings JSON (rule, path,
   line, message, suppressed) plus per-pass wall times — the CI artifact
   finding-count drift is diffed across.
 * ``--budget`` enforces the recorded wall-time bound in
-  scripts/jlint/budget.json: ten passes must not erode the commit
+  scripts/jlint/budget.json: eleven passes must not erode the commit
   loop, so `make lint` fails if the run blows the budget.
 """
 
@@ -48,6 +49,7 @@ from . import (
     pass_metrics,
     pass_parity,
     pass_protocol,
+    pass_semantics,
 )
 from .core import Project
 
@@ -59,7 +61,7 @@ JAX_SCOPE = ("jylis_tpu/ops",)
 
 BUDGET_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "budget.json")
 
-N_PASSES = 10
+N_PASSES = 11
 
 
 def run_all(
@@ -114,6 +116,7 @@ def run_all(
     findings += timed("5:metrics", pass_metrics.check)
     findings += timed("7:codec", pass_codec.check)
     findings += timed("10:protocol", pass_protocol.check)
+    findings += timed("11:semantics", pass_semantics.check)
     findings += timed("8:lattice", pass_lattice.check_manifest, project)
     findings += problems
     findings += hygiene
@@ -147,7 +150,7 @@ def run_all(
         if bound is not None and total > bound:
             print(
                 f"jlint: BUDGET EXCEEDED — {total:.2f}s > {bound:.1f}s "
-                "(scripts/jlint/budget.json). Ten passes must not erode "
+                "(scripts/jlint/budget.json). Eleven passes must not erode "
                 "the commit loop: profile with -v, fix the slow pass, or "
                 "re-record the bound with a justification.",
                 file=sys.stderr,
@@ -170,6 +173,16 @@ def run_all(
                 "suppressed": n_sup,
                 "files": len(async_sources),
                 "passes": N_PASSES,
+                # ROADMAP item 1's native-surface gap as a tracked number:
+                # commands only the Python oracle serves (MAP/BCOUNT/
+                # SESSION/…) — moving it means re-recording the parity
+                # manifest, and check_prose pins the documented figure
+                "python_only": sum(
+                    len(v)
+                    for v in pass_parity.build_manifest()[
+                        "python_only"
+                    ].values()
+                ),
             },
             "pass_seconds": {k: round(v, 4) for k, v in sorted(times.items())},
             "total_seconds": round(total, 4),
@@ -232,6 +245,22 @@ def write_manifests(project: Project | None = None) -> None:
         f"{len(proto['sections'])} sections"
         + (f" ({todo} need notes)" if todo else "")
     )
+    sem = pass_semantics.write_manifest()
+    todo = sum(
+        1
+        for e in sem["commands"].values()
+        if e["note"] == pass_semantics.PLACEHOLDER
+    )
+    diverged = sum(
+        1 for e in sem["commands"].values() if e["divergences"]
+    )
+    print(
+        f"semantics manifest written: {len(sem['commands'])} commands, "
+        f"{diverged} with divergences (tests/test_semantic_fuzz.py "
+        "regenerated)"
+        + (f" ({todo} need notes)" if todo else "")
+        + " — if it changed, re-record the corpus with --write-corpus"
+    )
 
 
 def main(argv=None) -> int:
@@ -239,8 +268,8 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--write-manifest", action="store_true",
         help="regenerate every committed manifest (parity, failpoints, "
-        "metrics, lanes, codec, lattice + property harness; descriptions "
-        "preserved) and exit",
+        "metrics, lanes, codec, lattice + property harness, protocol, "
+        "semantics + fuzz harness; descriptions preserved) and exit",
     )
     ap.add_argument(
         "--write-corpus", action="store_true",
@@ -269,6 +298,14 @@ def main(argv=None) -> int:
         print(
             f"codec corpus written: {len(corpus['entries'])} entries "
             f"pinned to manifest {corpus['manifest_sha256'][:12]}"
+        )
+        from .. import gen_semfuzz
+
+        sem = pass_semantics._load_committed()
+        fuzz = gen_semfuzz.write_corpus(sem, pass_semantics.manifest_sha())
+        print(
+            f"semfuzz corpus written: {len(fuzz['streams'])} streams "
+            f"pinned to manifest {fuzz['manifest_sha256'][:12]}"
         )
         return 0
     return run_all(verbose=args.verbose, out_path=args.out, budget=args.budget)

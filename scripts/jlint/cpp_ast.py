@@ -1,0 +1,561 @@
+"""A lightweight C++ front-end for the disciplined subset native/ uses.
+
+jlint pass 11 (``pass_semantics``) needs to reason about the native
+serving dispatch SYMBOLICALLY — per-command argument grammar,
+validation predicates, reply shapes — which the regex extraction of
+pass 3 cannot see.  Rather than grow a libclang dependency (not in the
+image, and overkill for five small translation units), this module
+implements a tokenizer + recursive-descent parser over the subset the
+native tree actually exercises.  The contract (enforced by
+tests/test_jlint.py parse-fidelity tests and documented in
+docs/development.md) is:
+
+* preprocessor: ``#include`` / ``#pragma once`` lines only — skipped
+  wholesale; no conditional compilation, no macro definitions;
+* declarations: free functions (incl. ``inline`` / ``static``),
+  ``extern "C" { ... }`` blocks, (anonymous) ``namespace { ... }``,
+  ``struct``/``class`` definitions with fields, methods, ``operator``
+  overloads and default member initializers; ``using`` aliases;
+* NO template *declarations* (template-id *uses* like
+  ``std::vector<TlogEnt>`` tokenize fine), no raw strings, no
+  ``switch``/``goto``, no multiple inheritance, no exceptions;
+* statements: ``if``/``else``, ``while``, ``for`` (incl. range-for),
+  ``do``/``while``, ``return``, ``break``/``continue``, blocks, and
+  generic expression/declaration statements (lambdas and initializer
+  braces parse as opaque, brace-matched token groups).
+
+The result is a ``Unit``: every function (struct methods qualified as
+``Struct::name``) with its body parsed to a statement tree whose
+conditions and expressions stay token lists — exactly the level the
+semantic extractor needs, with no pretence of full C++ fidelity.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+# ---- tokens ----------------------------------------------------------------
+
+# longest-match punctuator table (subset-relevant operators only)
+_PUNCTS = [
+    "<<=", ">>=", "...", "->*",
+    "::", "->", "<<", ">>", "<=", ">=", "==", "!=", "&&", "||",
+    "+=", "-=", "*=", "/=", "%=", "&=", "|=", "^=", "++", "--",
+    "{", "}", "(", ")", "[", "]", ";", ",", ".", "?", ":",
+    "+", "-", "*", "/", "%", "&", "|", "^", "~", "!", "<", ">", "=",
+]
+
+
+@dataclass(frozen=True)
+class Token:
+    kind: str  # 'ident' | 'num' | 'str' | 'char' | 'punct'
+    text: str
+    line: int
+
+
+class CppParseError(Exception):
+    """Raised when the source leaves the disciplined subset."""
+
+    def __init__(self, msg: str, line: int):
+        super().__init__(f"line {line}: {msg}")
+        self.line = line
+
+
+def tokenize(text: str) -> list[Token]:
+    toks: list[Token] = []
+    i, n, line = 0, len(text), 1
+    while i < n:
+        c = text[i]
+        if c == "\n":
+            line += 1
+            i += 1
+            continue
+        if c in " \t\r\f\v":
+            i += 1
+            continue
+        if c == "/" and i + 1 < n and text[i + 1] == "/":
+            while i < n and text[i] != "\n":
+                i += 1
+            continue
+        if c == "/" and i + 1 < n and text[i + 1] == "*":
+            j = text.find("*/", i + 2)
+            if j < 0:
+                raise CppParseError("unterminated block comment", line)
+            line += text.count("\n", i, j)
+            i = j + 2
+            continue
+        if c == "#" and (not toks or toks[-1].line != line):
+            # preprocessor directive: skip the whole line (the subset
+            # has no continuations and no conditional compilation)
+            while i < n and text[i] != "\n":
+                i += 1
+            continue
+        if c in "\"'":
+            quote, j = c, i + 1
+            while j < n:
+                if text[j] == "\\":
+                    j += 2
+                    continue
+                if text[j] == quote:
+                    break
+                if text[j] == "\n":
+                    raise CppParseError("newline in literal", line)
+                j += 1
+            if j >= n:
+                raise CppParseError("unterminated literal", line)
+            toks.append(
+                Token("str" if quote == '"' else "char", text[i : j + 1], line)
+            )
+            i = j + 1
+            continue
+        if c.isdigit() or (c == "." and i + 1 < n and text[i + 1].isdigit()):
+            j = i + 1
+            while j < n and (text[j].isalnum() or text[j] in "._'"):
+                # 1e-5 / 0x1p-3 exponent signs ride the number token
+                if text[j] in "eEpP" and j + 1 < n and text[j + 1] in "+-":
+                    j += 1
+                j += 1
+            toks.append(Token("num", text[i:j], line))
+            i = j
+            continue
+        if c.isalpha() or c == "_":
+            j = i + 1
+            while j < n and (text[j].isalnum() or text[j] == "_"):
+                j += 1
+            toks.append(Token("ident", text[i:j], line))
+            i = j
+            continue
+        for p in _PUNCTS:
+            if text.startswith(p, i):
+                toks.append(Token("punct", p, line))
+                i += len(p)
+                break
+        else:
+            raise CppParseError(f"unexpected character {c!r}", line)
+    return toks
+
+
+# ---- group tree (brace/paren/bracket matching) -----------------------------
+
+_OPEN = {"{": "}", "(": ")", "[": "]"}
+
+
+@dataclass
+class Group:
+    open: str  # '{' | '(' | '['
+    items: list  # Token | Group
+    line: int
+
+    def tokens(self) -> list[Token]:
+        """Flattened token stream including the delimiters."""
+        out = [Token("punct", self.open, self.line)]
+        for it in self.items:
+            out.extend(it.tokens() if isinstance(it, Group) else [it])
+        out.append(Token("punct", _OPEN[self.open], self.line))
+        return out
+
+
+def _group(toks: list[Token], i: int, closer: str | None) -> tuple[list, int]:
+    items: list = []
+    while i < len(toks):
+        t = toks[i]
+        if t.kind == "punct" and t.text in _OPEN:
+            inner, i = _group(toks, i + 1, _OPEN[t.text])
+            items.append(Group(t.text, inner, t.line))
+            continue
+        if t.kind == "punct" and t.text in ")}]":
+            if t.text != closer:
+                raise CppParseError(f"mismatched {t.text!r}", t.line)
+            return items, i + 1
+        items.append(t)
+        i += 1
+    if closer is not None:
+        raise CppParseError(f"missing closing {closer!r}", toks[-1].line)
+    return items, i
+
+
+def group_tree(toks: list[Token]) -> list:
+    items, _ = _group(toks, 0, None)
+    return items
+
+
+# ---- statements ------------------------------------------------------------
+
+
+@dataclass
+class Block:
+    stmts: list = field(default_factory=list)
+
+
+@dataclass
+class If:
+    cond: list  # Token | Group
+    then: Block
+    orelse: Block | None
+    line: int
+
+
+@dataclass
+class Loop:
+    kind: str  # 'for' | 'while' | 'do'
+    header: list  # Token | Group (the paren group's items)
+    body: Block
+    line: int
+
+
+@dataclass
+class Return:
+    value: list  # Token | Group (may be empty)
+    line: int
+
+
+@dataclass
+class Jump:
+    kind: str  # 'break' | 'continue'
+    line: int
+
+
+@dataclass
+class ExprStmt:
+    """An expression or declaration statement, kept as matched tokens
+    (covers assignments, calls, declarations, lambdas, structured
+    bindings — anything the extractor reads but never executes)."""
+
+    items: list  # Token | Group
+    line: int
+
+
+def _is_tok(it, text: str) -> bool:
+    return isinstance(it, Token) and it.text == text
+
+
+def parse_block(items: list) -> Block:
+    block = Block()
+    i = 0
+    while i < len(items):
+        stmt, i = _parse_stmt(items, i)
+        if stmt is not None:
+            block.stmts.append(stmt)
+    return block
+
+
+def _parse_stmt(items: list, i: int):
+    it = items[i]
+    if isinstance(it, Group) and it.open == "{":
+        return parse_block(it.items), i + 1
+    if _is_tok(it, ";"):
+        return None, i + 1
+    if _is_tok(it, "if"):
+        if i + 1 >= len(items) or not (
+            isinstance(items[i + 1], Group) and items[i + 1].open == "("
+        ):
+            raise CppParseError("if without condition", it.line)
+        cond = items[i + 1].items
+        then, j = _parse_stmt_as_block(items, i + 2)
+        orelse = None
+        if j < len(items) and _is_tok(items[j], "else"):
+            orelse, j = _parse_stmt_as_block(items, j + 1)
+        return If(cond, then, orelse, it.line), j
+    if _is_tok(it, "while") or _is_tok(it, "for"):
+        if i + 1 >= len(items) or not (
+            isinstance(items[i + 1], Group) and items[i + 1].open == "("
+        ):
+            raise CppParseError(f"{it.text} without header", it.line)
+        header = items[i + 1].items
+        body, j = _parse_stmt_as_block(items, i + 2)
+        return Loop(it.text, header, body, it.line), j
+    if _is_tok(it, "do"):
+        body, j = _parse_stmt_as_block(items, i + 1)
+        if not (j + 1 < len(items) and _is_tok(items[j], "while")):
+            raise CppParseError("do without while", it.line)
+        header = items[j + 1].items
+        j += 2
+        if j < len(items) and _is_tok(items[j], ";"):
+            j += 1
+        return Loop("do", header, body, it.line), j
+    if _is_tok(it, "return"):
+        value = []
+        j = i + 1
+        while j < len(items) and not _is_tok(items[j], ";"):
+            value.append(items[j])
+            j += 1
+        return Return(value, it.line), j + 1
+    if _is_tok(it, "break") or _is_tok(it, "continue"):
+        j = i + 1
+        if j < len(items) and _is_tok(items[j], ";"):
+            j += 1
+        return Jump(it.text, it.line), j
+    # expression / declaration statement: everything up to the next
+    # top-level ';' (groups are atomic, so lambda bodies and init
+    # braces never leak a spurious terminator)
+    expr = []
+    j = i
+    while j < len(items) and not _is_tok(items[j], ";"):
+        expr.append(items[j])
+        j += 1
+    return ExprStmt(expr, it.line if isinstance(it, Token) else it.line), j + 1
+
+
+def _parse_stmt_as_block(items: list, i: int) -> tuple[Block, int]:
+    stmt, j = _parse_stmt(items, i)
+    if isinstance(stmt, Block):
+        return stmt, j
+    b = Block()
+    if stmt is not None:
+        b.stmts.append(stmt)
+    return b, j
+
+
+# ---- declarations ----------------------------------------------------------
+
+
+@dataclass
+class Function:
+    name: str  # 'resp_scan', 'TlogTable::intern', 'TlogEnt::operator=='
+    params: Group
+    body: Block
+    line: int
+    ret: list = field(default_factory=list)  # return-type tokens
+
+
+@dataclass
+class Struct:
+    name: str
+    line: int
+    methods: list = field(default_factory=list)  # Function, qualified names
+
+
+@dataclass
+class Unit:
+    path: str
+    functions: dict = field(default_factory=dict)  # name -> Function
+    structs: dict = field(default_factory=dict)  # name -> Struct
+    constants: dict = field(default_factory=dict)  # name -> literal text
+
+
+# trailers legal between a function's parameter list and its body
+_TRAILER_WORDS = {"const", "noexcept", "override", "final"}
+
+
+def _function_from_pending(pending: list, body: Group, owner: str | None):
+    """Recognize ``... name ( params ) trailers* { body }`` in the
+    declaration tokens accumulated since the last ';'/'}' — or return
+    None (an initializer like ``uint64_t served[5] = {0};``)."""
+    # a top-level '=' means brace-initializer, never a function body
+    if any(_is_tok(t, "=") for t in pending):
+        return None
+    # locate the parameter list: the last '(' group that is followed
+    # only by trailers or a constructor init-list
+    for k in range(len(pending) - 1, -1, -1):
+        it = pending[k]
+        if not (isinstance(it, Group) and it.open == "("):
+            continue
+        rest = pending[k + 1 :]
+        ok = True
+        in_ctor_init = False
+        for r in rest:
+            if isinstance(r, Token) and r.text in _TRAILER_WORDS:
+                continue
+            if _is_tok(r, ":"):
+                in_ctor_init = True
+                continue
+            if in_ctor_init:
+                continue  # member(init), commas — all legal
+            if isinstance(r, Token) and r.text == "->":
+                in_ctor_init = True  # trailing return type: same skip
+                continue
+            ok = False
+            break
+        if not ok:
+            continue
+        # the name precedes the parameter group
+        name = None
+        if k >= 1 and isinstance(pending[k - 1], Token):
+            prev = pending[k - 1]
+            if prev.kind == "ident" and prev.text != "operator":
+                name = prev.text
+            elif prev.kind == "punct" and k >= 2 and _is_tok(
+                pending[k - 2], "operator"
+            ):
+                name = "operator" + prev.text
+        elif (
+            k >= 2
+            and isinstance(pending[k - 1], Group)
+            and pending[k - 1].open == "("
+            and not pending[k - 1].items
+            and _is_tok(pending[k - 2], "operator")
+        ):
+            name = "operator()"
+        if name is None:
+            continue
+        if name in ("if", "while", "for", "switch", "return"):
+            return None
+        qual = f"{owner}::{name}" if owner else name
+        ret = [t for t in pending[: k - 1]]
+        return Function(qual, it, parse_block(body.items), body.line, ret)
+    return None
+
+
+def _scan_constants(pending: list, constants: dict) -> None:
+    """Record ``constexpr <type> NAME = <literal...>;`` declarations —
+    the dispatch thresholds pass 11 folds into the manifest."""
+    if not any(_is_tok(t, "constexpr") for t in pending):
+        return
+    for k, it in enumerate(pending):
+        if _is_tok(it, "="):
+            if k >= 1 and isinstance(pending[k - 1], Token) and pending[
+                k - 1
+            ].kind == "ident":
+                value = " ".join(
+                    t.text
+                    for t in pending[k + 1 :]
+                    if isinstance(t, Token)
+                )
+                constants[pending[k - 1].text] = value
+            return
+
+
+def _parse_scope(items: list, unit: Unit, owner: str | None) -> None:
+    pending: list = []
+    i = 0
+    while i < len(items):
+        it = items[i]
+        if _is_tok(it, "extern") and i + 2 < len(items) and isinstance(
+            items[i + 1], Token
+        ) and items[i + 1].kind == "str" and isinstance(
+            items[i + 2], Group
+        ) and items[i + 2].open == "{":
+            _parse_scope(items[i + 2].items, unit, owner)
+            pending = []
+            i += 3
+            continue
+        if _is_tok(it, "namespace") and not (
+            pending and _is_tok(pending[-1], "using")
+        ):
+            j = i + 1
+            if j < len(items) and isinstance(items[j], Token) and items[
+                j
+            ].kind == "ident":
+                j += 1
+            if j < len(items) and isinstance(items[j], Group) and items[
+                j
+            ].open == "{":
+                _parse_scope(items[j].items, unit, owner)
+                pending = []
+                i = j + 1
+                continue
+            raise CppParseError("unsupported namespace form", it.line)
+        if (
+            (_is_tok(it, "struct") or _is_tok(it, "class"))
+            and not pending
+            and i + 2 < len(items)
+            and isinstance(items[i + 1], Token)
+            and isinstance(items[i + 2], Group)
+            and items[i + 2].open == "{"
+        ):
+            name = items[i + 1].text
+            st = Struct(name, it.line)
+            _parse_scope(items[i + 2].items, unit, name)
+            st.methods = [
+                f for f in unit.functions.values()
+                if f.name.startswith(name + "::")
+            ]
+            unit.structs[name] = st
+            i += 3
+            if i < len(items) and _is_tok(items[i], ";"):
+                i += 1
+            continue
+        if isinstance(it, Group) and it.open == "{":
+            fn = _function_from_pending(pending, it, owner)
+            if fn is not None:
+                unit.functions[fn.name] = fn
+                pending = []
+                i += 1
+                continue
+            # brace initializer inside a declaration: keep accumulating
+            pending.append(it)
+            i += 1
+            continue
+        if _is_tok(it, ";"):
+            _scan_constants(pending, unit.constants)
+            pending = []
+            i += 1
+            continue
+        pending.append(it)
+        i += 1
+    if pending and any(
+        isinstance(p, Group) and p.open == "(" for p in pending
+    ) and any(isinstance(p, Group) and p.open == "{" for p in pending):
+        raise CppParseError(
+            "trailing unparsed declaration", pending[0].line
+        )
+
+
+def parse(text: str, path: str = "<string>") -> Unit:
+    unit = Unit(path)
+    _parse_scope(group_tree(tokenize(text)), unit, None)
+    return unit
+
+
+def parse_file(path: str) -> Unit:
+    with open(path, encoding="utf-8") as f:
+        return parse(f.read(), path)
+
+
+# ---- walk / render helpers -------------------------------------------------
+
+
+def walk(block: Block):
+    """Yield every statement in the tree, depth-first, pre-order."""
+    for s in block.stmts:
+        yield s
+        if isinstance(s, If):
+            yield from walk(s.then)
+            if s.orelse is not None:
+                yield from walk(s.orelse)
+        elif isinstance(s, Loop):
+            yield from walk(s.body)
+        elif isinstance(s, Block):
+            yield from walk(s)
+
+
+def flat_tokens(items: list) -> list[Token]:
+    out: list[Token] = []
+    for it in items:
+        if isinstance(it, Group):
+            out.extend(it.tokens())
+        else:
+            out.append(it)
+    return out
+
+
+def render(items: list) -> str:
+    """Canonical one-space-separated text of a token/group list — the
+    form extraction predicates and manifest strings are written in."""
+    return " ".join(t.text for t in flat_tokens(items))
+
+
+def find_calls(items: list, name: str):
+    """Yield the argument Group of every ``name ( ... )`` call found
+    anywhere (recursively) in a token/group list."""
+    for idx, it in enumerate(items):
+        if isinstance(it, Group):
+            if (
+                it.open == "("
+                and idx > 0
+                and isinstance(items[idx - 1], Token)
+                and items[idx - 1].text == name
+            ):
+                yield it
+            yield from find_calls(it.items, name)
+
+
+def split_args(group: Group) -> list[list]:
+    """Split a paren Group's items on top-level commas."""
+    args: list[list] = [[]]
+    for it in group.items:
+        if _is_tok(it, ","):
+            args.append([])
+        else:
+            args[-1].append(it)
+    return args if args != [[]] else []

@@ -1584,3 +1584,216 @@ def test_real_protocol_atlas_is_complete_and_committed():
             ), (role, msg)
     for section in ("handshake", "sync", "dial", "send", "recv"):
         assert manifest["sections"][section], section
+
+
+# ---- pass 11: cross-language RESP semantics (JL1101/JL1102/JL1103) ----------
+
+import copy  # noqa: E402
+
+from scripts.jlint import cpp_ast, pass_semantics  # noqa: E402
+
+
+def _sem_rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+def _write_sem(tmp_path, manifest):
+    """Commit a manifest + matching harness into tmp and return paths."""
+    from scripts import gen_semfuzz
+
+    mpath = tmp_path / "semantics.json"
+    hpath = tmp_path / "harness.py"
+    mpath.write_text(json.dumps(manifest, indent=2, sort_keys=True) + "\n")
+    hpath.write_text(gen_semfuzz.render_harness(manifest))
+    return str(mpath), str(hpath)
+
+
+def test_semantics_native_extraction_grammar_facts():
+    """cpp_ast-driven extraction recovers the real dispatch grammar:
+    arity, strict-u64 positions, the one optional count, reply shapes,
+    and the defer-everything error mode."""
+    native = pass_semantics.extract_native()
+    inc = native["GCOUNT INC"]
+    assert inc["min_argc"] == 4 and inc["u64_args"] == [3]
+    assert inc["replies"] == ["+OK"] and inc["error_mode"] == "defer"
+    get = native["TLOG GET"]
+    assert get["opt_u64_args"] == [3]
+    assert "*n[*2[$bulk,:u64]]" in get["replies"]
+    treg = native["TREG GET"]
+    assert sorted(treg["replies"]) == ["$-1", "*2[$bulk,:u64]"]
+    assert all(rec["error_mode"] == "defer" for rec in native.values())
+
+
+def test_semantics_python_extraction_matches_oracle_dispatch():
+    """The AST side recovers the oracle's grammar for every natively-
+    served command (and more — the Python-only surface is pass 3's
+    concern, not a divergence)."""
+    python = pass_semantics.extract_python()
+    assert python["PNCOUNT DEC"]["min_argc"] == 4
+    assert python["PNCOUNT DEC"]["u64_args"] == [3]
+    assert python["TLOG GET"]["opt_u64_args"] == [3]
+    assert python["UJSON GET"]["replies"]  # $bulk via the render path
+    assert "MAP GET" in python  # python-only commands extract too
+
+
+def test_semantics_missing_manifest_fires_jl1103(tmp_path):
+    findings = pass_semantics.check(str(tmp_path / "nope.json"))
+    assert _sem_rules(findings) == ["JL1103"]
+    assert "missing" in findings[0].msg
+
+
+def test_semantics_drift_fires_jl1103_both_directions(tmp_path):
+    manifest = pass_semantics.build_manifest(old={})
+    for rec in manifest["commands"].values():
+        rec["note"] = "pinned"
+    # forward drift: a committed fact no longer matches the extraction
+    tampered = copy.deepcopy(manifest)
+    tampered["commands"]["GCOUNT INC"]["native"]["min_argc"] = 99
+    mpath, hpath = _write_sem(tmp_path, tampered)
+    findings = pass_semantics.check(mpath, hpath)
+    assert _sem_rules(findings) == ["JL1103"]
+    assert any("GCOUNT INC" in f.msg and "drift" in f.msg for f in findings)
+    # reverse drift: a committed entry no native command backs anymore
+    tampered = copy.deepcopy(manifest)
+    tampered["commands"]["FAKE CMD"] = tampered["commands"]["GCOUNT INC"]
+    mpath, hpath = _write_sem(tmp_path, tampered)
+    findings = pass_semantics.check(mpath, hpath)
+    assert any("FAKE CMD" in f.msg and "no longer" in f.msg for f in findings)
+    # and a served command missing from the manifest entirely
+    tampered = copy.deepcopy(manifest)
+    del tampered["commands"]["TREG SET"]
+    mpath, hpath = _write_sem(tmp_path, tampered)
+    findings = pass_semantics.check(mpath, hpath)
+    assert any(
+        "TREG SET" in f.msg and "absent" in f.msg for f in findings
+    )
+
+
+def test_semantics_placeholder_and_stale_justification_fire_jl1103(tmp_path):
+    manifest = pass_semantics.build_manifest(old={})
+    for rec in manifest["commands"].values():
+        rec["note"] = "pinned"
+    manifest["commands"]["GCOUNT GET"]["note"] = pass_semantics.PLACEHOLDER
+    manifest["commands"]["TLOG INS"]["justified"] = ["bogus divergence"]
+    mpath, hpath = _write_sem(tmp_path, manifest)
+    findings = pass_semantics.check(mpath, hpath)
+    assert _sem_rules(findings) == ["JL1103"]
+    assert any("GCOUNT GET" in f.msg and "note" in f.msg for f in findings)
+    assert any(
+        "TLOG INS" in f.msg and "stale justification" in f.msg
+        for f in findings
+    )
+
+
+def test_semantics_divergence_fires_jl1101_and_jl1102(tmp_path, monkeypatch):
+    """A grammar gap is JL1101, a reply-shape gap is JL1102; adding the
+    exact divergence string to `justified` silences exactly that one."""
+    real = pass_semantics.extract_python()
+    mutated = copy.deepcopy(real)
+    mutated["GCOUNT INC"]["min_argc"] = 5  # arity gap -> JL1101
+    mutated["GCOUNT GET"]["replies"] = ["$bulk"]  # shape gap -> JL1102
+    monkeypatch.setattr(pass_semantics, "extract_python", lambda: mutated)
+    manifest = pass_semantics.build_manifest(old={})
+    for rec in manifest["commands"].values():
+        rec["note"] = "pinned"
+    mpath, hpath = _write_sem(tmp_path, manifest)
+    findings = pass_semantics.check(mpath, hpath)
+    assert _sem_rules(findings) == ["JL1101", "JL1102"]
+    by_rule = {f.rule: f for f in findings}
+    assert "GCOUNT INC" in by_rule["JL1101"].msg
+    assert "GCOUNT GET" in by_rule["JL1102"].msg
+    # justify both with the exact strings -> clean
+    for key in ("GCOUNT INC", "GCOUNT GET"):
+        rec = manifest["commands"][key]
+        rec["justified"] = list(rec["divergences"])
+    mpath, hpath = _write_sem(tmp_path, manifest)
+    assert pass_semantics.check(mpath, hpath) == []
+
+
+def test_semantics_transport_divergence_fires_jl1101(tmp_path, monkeypatch):
+    real = pass_semantics.extract_transport()
+    mutated = copy.deepcopy(real)
+    mutated["divergences"] = [
+        "transport: native MAX_BULK 1 != oracle 536870912"
+    ]
+    monkeypatch.setattr(
+        pass_semantics, "extract_transport", lambda: mutated
+    )
+    manifest = pass_semantics.build_manifest(old={})
+    for rec in manifest["commands"].values():
+        rec["note"] = "pinned"
+    mpath, hpath = _write_sem(tmp_path, manifest)
+    findings = pass_semantics.check(mpath, hpath)
+    assert "JL1101" in _sem_rules(findings)
+    assert any("MAX_BULK" in f.msg for f in findings)
+
+
+def test_semantics_stale_harness_fires_jl1103(tmp_path):
+    manifest = pass_semantics.build_manifest(old={})
+    for rec in manifest["commands"].values():
+        rec["note"] = "pinned"
+    mpath, hpath = _write_sem(tmp_path, manifest)
+    assert pass_semantics.check(mpath, hpath) == []  # fresh render: clean
+    with open(hpath, "a", encoding="utf-8") as f:
+        f.write("\n# hand edit\n")
+    findings = pass_semantics.check(mpath, hpath)
+    assert _sem_rules(findings) == ["JL1103"]
+    assert any("harness" in f.msg for f in findings)
+
+
+def test_semantics_write_manifest_preserves_notes(tmp_path):
+    manifest = pass_semantics.build_manifest(old={})
+    key = "GCOUNT INC"
+    assert manifest["commands"][key]["note"] == pass_semantics.PLACEHOLDER
+    manifest["commands"][key]["note"] = "kept across regeneration"
+    again = pass_semantics.build_manifest(old=manifest)
+    assert again["commands"][key]["note"] == "kept across regeneration"
+    other = "PNCOUNT GET"
+    assert again["commands"][other]["note"] == pass_semantics.PLACEHOLDER
+
+
+def test_cpp_ast_parses_every_native_file():
+    """Parse fidelity: the recursive-descent front-end must consume the
+    entire disciplined C++ subset native/ is written in — a parse error
+    on ANY file means extraction silently loses commands."""
+    native_dir = os.path.join(REPO, "native")
+    files = sorted(
+        f for f in os.listdir(native_dir)
+        if f.endswith((".cpp", ".h"))
+    )
+    assert files, "native/ sources must exist"
+    for fname in files:
+        unit = cpp_ast.parse_file(os.path.join(native_dir, fname))
+        assert unit.functions or unit.structs or unit.constants, fname
+    serve = cpp_ast.parse_file(os.path.join(native_dir, "serve_engine.cpp"))
+    assert "jy_eng_scan_apply2" in serve.functions
+
+
+def test_semantics_inventory_matches_pass3_dispatch():
+    """The symbolic extractor and pass 3's word_is dispatch scan must
+    agree on WHICH commands the native front-end serves — a gap either
+    way means one of the two extractions went blind."""
+    sem = set(pass_semantics.extract_native())
+    parity = {
+        f"{t} {sub}"
+        for t, subs in pass_parity.extract_native().items()
+        for sub in subs
+    }
+    assert sem == parity
+
+
+def test_real_semantics_manifest_clean_and_committed():
+    """`make lint` is clean on pass 11: the committed manifest covers
+    the full native surface with zero unexplained divergences, every
+    note written, transport limits and defer thresholds equal across
+    the seam, and the generated fuzz harness current."""
+    assert pass_semantics.check() == []
+    manifest = pass_semantics._load_committed()
+    cmds = manifest["commands"]
+    assert len(cmds) == 16
+    for key, rec in cmds.items():
+        assert rec["divergences"] == rec["justified"] == [], key
+        assert rec["note"] and rec["note"] != pass_semantics.PLACEHOLDER
+    assert manifest["transport"]["divergences"] == []
+    for rec in manifest["thresholds"].values():
+        assert rec["divergences"] == []

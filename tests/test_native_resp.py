@@ -1,5 +1,7 @@
 """Native RESP scanner: build check + differential tests vs the Python
-parser (the semantic oracle).
+parser (the semantic oracle), plus the jlint pass-11 semantic-parity
+pins (full-Server byte differentials over the grammar edge cases the
+symbolic extraction verified).
 
 The native library is built lazily by jylis_tpu.native.lib() with g++ (in
 this environment the toolchain is baked in); if a build is genuinely
@@ -7,12 +9,19 @@ impossible the suite must still reveal that, so the build test is a hard
 assertion, not a skip.
 """
 
+import os
+import sys
+
 import numpy as np
 import pytest
 
 from jylis_tpu.native import lib
 from jylis_tpu.native.resp import NativeRespParser, make_parser
 from jylis_tpu.server.resp import RespError, RespParser
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
 
 def test_native_lib_builds_and_loads():
@@ -131,3 +140,110 @@ def test_protocol_error_messages_match_oracle(data):
     with pytest.raises(RespError) as got:
         drain(make_native(), data)
     assert str(got.value) == str(want.value)
+
+
+# ---- jlint pass 11 semantic-parity pins ------------------------------
+#
+# Pass 11 (scripts/jlint/pass_semantics.py) symbolically extracts every
+# natively-served command's grammar from the C++ and diffs it against
+# the Python oracle's; the sweep found ZERO divergences, and each pin
+# below freezes one equivalence the extraction leans on hardest — the
+# edge of a numeric bound, an optionality rule, a validator gate. Each
+# runs one stream through the REAL Server twice (native vs forced-
+# Python) and byte-compares the replies, so a regression on either side
+# of the seam fails with the exact diverging bytes.
+
+# these boot the full Server/Database (jax-importing); the ASAN/TSAN
+# runs of this module cover the scanner only
+_sanitize = pytest.mark.skipif(
+    os.environ.get("JYLIS_SANITIZE") == "1",
+    reason="server drive imports jax; sanitize runs are jax-free",
+)
+
+
+def _pin(stream):
+    from scripts.gen_semfuzz import run_stream_differential
+
+    run_stream_differential(stream)
+
+
+@_sanitize
+def test_pin_u64_bounds_and_leading_zeros():
+    """parse_u64 edge parity: leading zeros are decimal (007 == 7),
+    U64_MAX is accepted, one past it (and any sign/junk) rejects —
+    native strict_u64 and the oracle's parse_u64 must agree on every
+    boundary, byte for byte."""
+    _pin([
+        [b"GCOUNT", b"INC", b"k", b"007"],
+        [b"GCOUNT", b"GET", b"k"],
+        [b"GCOUNT", b"INC", b"max", b"18446744073709551615"],
+        [b"GCOUNT", b"GET", b"max"],
+        [b"GCOUNT", b"INC", b"over", b"18446744073709551616"],
+        [b"GCOUNT", b"INC", b"neg", b"-1"],
+        [b"GCOUNT", b"INC", b"plus", b"+2"],
+        [b"GCOUNT", b"INC", b"sp", b" 1"],
+        [b"GCOUNT", b"GET", b"over"],
+    ])
+
+
+@_sanitize
+def test_pin_empty_key_and_binary_key():
+    """Keys are raw bytes on both sides: empty and CR/NUL-bearing keys
+    round-trip identically through counters and TREG."""
+    _pin([
+        [b"GCOUNT", b"INC", b"", b"1"],
+        [b"GCOUNT", b"GET", b""],
+        [b"TREG", b"SET", b"\x00\xff", b"v", b"3"],
+        [b"TREG", b"GET", b"\x00\xff"],
+        [b"TREG", b"GET", b""],
+    ])
+
+
+@_sanitize
+def test_pin_arity_and_unknown_subcommand_defer():
+    """Wrong arity and unknown subcommands are NOT native errors — the
+    native front-end defers them and the oracle renders the help text,
+    so both server paths emit identical bytes (the manifest's
+    error_mode: defer contract)."""
+    _pin([
+        [b"GCOUNT", b"GET", b"k", b"extra"],
+        [b"GCOUNT", b"INC", b"k"],
+        [b"GCOUNT", b"DEC", b"k", b"1"],  # polarity: DEC is PNCOUNT-only
+        [b"PNCOUNT", b"NOPE", b"k"],
+        [b"TREG", b"SET", b"k", b"v"],  # missing ts
+        [b"UJSON"],
+    ])
+
+
+@_sanitize
+def test_pin_tlog_optional_count():
+    """TLOG GET's arg 3 is parse_opt_count on both sides: absent OR
+    unparseable means 'all entries', a parseable value truncates — the
+    native optional-u64 extraction pins exactly this."""
+    _pin([
+        [b"TLOG", b"INS", b"l", b"e1", b"10"],
+        [b"TLOG", b"INS", b"l", b"e2", b"20"],
+        [b"TLOG", b"GET", b"l"],
+        [b"TLOG", b"GET", b"l", b"1"],
+        [b"TLOG", b"GET", b"l", b"zz"],  # unparseable -> all
+        [b"TLOG", b"GET", b"l", b"0"],
+        [b"TLOG", b"GET", b"l", b"18446744073709551615"],
+    ])
+
+
+@_sanitize
+def test_pin_ujson_validator_gates():
+    """The UJSON native validators (prim/doc JSON shape, UTF-8 paths)
+    must split accept/defer exactly where the oracle splits ok/error:
+    valid writes bank natively, invalid ones defer and the oracle's
+    error bytes come back identical on both paths."""
+    _pin([
+        [b"UJSON", b"SET", b"d", b"n", b"1"],
+        [b"UJSON", b"GET", b"d"],
+        [b"UJSON", b"INS", b"d", b"bad", b"{not json}"],
+        [b"UJSON", b"SET", b"d", b"\xff\xfe", b"1"],  # invalid-UTF-8 path
+        [b"UJSON", b"SET", b"d", "café".encode(), b"2"],
+        [b"UJSON", b"GET", b"d", b"n"],
+        [b"UJSON", b"CLR", b"d"],
+        [b"UJSON", b"GET", b"d"],
+    ])

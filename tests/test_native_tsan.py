@@ -1,0 +1,287 @@
+"""Deliberately jax-free multi-threaded drive of the native serving
+engine — the `make sanitize-threads` vehicle.
+
+The TSAN build (`make sanitize-threads`) runs this module with
+libtsan LD_PRELOADed. Two invariants are certified:
+
+* **Engine isolation** — distinct ``ServeEngine`` instances carry no
+  hidden shared C++ state (statics, shared buffers, a shared
+  interner). ctypes releases the GIL around every FFI call, so the
+  per-thread bursts below genuinely run concurrently inside the
+  library; any cross-engine write TSAN sees is a product bug, because
+  the lane supervisor runs one engine per process and the single-node
+  server runs one per asyncio loop.
+* **External-mutex discipline** — a single engine shared across
+  threads is race-free when every call is serialized by one lock
+  (the product's implicit contract: the owning event loop is that
+  lock). TSAN proves no engine call path touches state that escapes
+  the critical section (e.g. an unsynchronized static scratch buffer
+  would race even under the mutex between release/acquire pairs).
+
+In the regular suite this doubles as a plain concurrency smoke (the
+invariants hold under the GIL too — assertion failures here mean
+cross-engine state leaked regardless of the data-race question).
+
+Keep this module importable without jax: no jylis_tpu.models /
+jylis_tpu.ops imports (JYLIS_SANITIZE gates the jax import in
+tests/conftest.py).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from jylis_tpu.native import lib
+from jylis_tpu.native.engine import ServeEngine
+
+N_THREADS = 6
+N_ROUNDS = 40
+
+
+@pytest.fixture
+def cdll():
+    c = lib()
+    assert c is not None, "native library must build in this environment"
+    return c
+
+
+def resp(*args: bytes) -> bytes:
+    out = b"*%d\r\n" % len(args)
+    for a in args:
+        out += b"$%d\r\n%s\r\n" % (len(a), a)
+    return out
+
+
+def drain_native(eng, burst: bytes):
+    """Same drain loop as test_native_drive (tests/ is not a package,
+    so the helper is restated rather than imported)."""
+    buf = bytearray(burst)
+    replies = b""
+    deferred = []
+    while True:
+        rc, consumed, out, unhandled, _changed = eng.scan_apply(buf)
+        replies += out
+        del buf[:consumed]
+        if rc == 1:
+            deferred.append(unhandled)
+            continue
+        if rc == 2:
+            continue
+        return rc, replies, deferred, bytes(buf)
+
+
+def _full_surface_burst(tag: bytes, i: int) -> bytes:
+    """One burst over all five natively-served types, keys salted by
+    thread tag so per-engine results are predictable."""
+    k = tag + b"-%d" % (i % 4)
+    return (
+        resp(b"GCOUNT", b"INC", k, b"3")
+        + resp(b"GCOUNT", b"GET", k)
+        + resp(b"PNCOUNT", b"INC", k, b"2")
+        + resp(b"PNCOUNT", b"DEC", k, b"1")
+        + resp(b"TREG", b"SET", k, tag + b"-v%d" % i, b"%d" % (i + 1))
+        + resp(b"TREG", b"GET", k)
+        + resp(b"TLOG", b"INS", k, b"e%d" % i, b"%d" % (i + 1))
+        + resp(b"TLOG", b"SIZE", k)
+        + resp(b"UJSON", b"SET", k, b"n", b"%d" % i)
+        + resp(b"UJSON", b"CLR", k)
+    )
+
+
+def _run_threads(workers):
+    errors: list[BaseException] = []
+
+    def wrap(fn):
+        def run():
+            try:
+                fn()
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                errors.append(e)
+
+        return run
+
+    threads = [threading.Thread(target=wrap(w)) for w in workers]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+
+
+def test_concurrent_engines_are_isolated(cdll):
+    """One engine per thread, full-surface bursts in parallel: every
+    thread's replies and drain counts must be exactly what a solo run
+    produces — and TSAN must see no cross-engine access."""
+    results: dict[bytes, tuple] = {}
+    lock = threading.Lock()
+
+    def worker(tag: bytes):
+        eng = ServeEngine(cdll)
+        replies = b""
+        for i in range(N_ROUNDS):
+            rc, out, deferred, rest = drain_native(
+                eng, _full_surface_burst(tag, i)
+            )
+            assert (rc, rest) == (0, b"") and not deferred
+            replies += out
+        summary = (
+            replies,
+            eng.served_counts(),
+            sorted(eng.treg_flush_deltas()),
+            len(eng.uq_drain()),
+        )
+        with lock:
+            results[tag] = summary
+
+    _run_threads(
+        [lambda t=b"t%d" % n: worker(t) for n in range(N_THREADS)]
+    )
+    assert len(results) == N_THREADS
+    # every engine saw only its own traffic: identical shapes, keys
+    # salted by tag, and the reply streams are the solo-run streams
+    solo = ServeEngine(cdll)
+    expect = b""
+    for i in range(N_ROUNDS):
+        rc, out, deferred, _ = drain_native(
+            solo, _full_surface_burst(b"t0", i)
+        )
+        assert rc == 0 and not deferred
+        expect += out
+    assert results[b"t0"][0] == expect
+    for tag, (_, served, deltas, uq) in results.items():
+        assert served["GCOUNT"] == 2 * N_ROUNDS
+        assert {k for k, _ in deltas} == {
+            tag + b"-%d" % j for j in range(4)
+        }
+        assert uq == 2 * N_ROUNDS
+
+
+def test_shared_engine_under_external_mutex(cdll):
+    """One engine, many threads, one lock around every call — the
+    product's serialization contract. The final counter state must be
+    the arithmetic sum, and TSAN must be silent (no engine code path
+    may touch state outside the critical section)."""
+    eng = ServeEngine(cdll)
+    mu = threading.Lock()
+
+    def worker(n: int):
+        for i in range(N_ROUNDS):
+            with mu:
+                rc, out, deferred, rest = drain_native(
+                    eng,
+                    resp(b"GCOUNT", b"INC", b"shared", b"1")
+                    + resp(b"PNCOUNT", b"INC", b"shared", b"2")
+                    + resp(b"PNCOUNT", b"DEC", b"shared", b"1")
+                    + resp(b"TLOG", b"INS", b"shared", b"e%d-%d" % (n, i),
+                           b"%d" % (n * N_ROUNDS + i + 1)),
+                )
+                assert (rc, rest) == (0, b"") and not deferred
+                assert out.count(b"+OK\r\n") == 4
+
+    _run_threads([lambda n=n: worker(n) for n in range(N_THREADS)])
+    with mu:
+        rc, out, _, _ = drain_native(
+            eng,
+            resp(b"GCOUNT", b"GET", b"shared")
+            + resp(b"PNCOUNT", b"GET", b"shared")
+            + resp(b"TLOG", b"SIZE", b"shared"),
+        )
+    total = N_THREADS * N_ROUNDS
+    assert out == b":%d\r\n:%d\r\n:%d\r\n" % (total, total, total)
+
+
+def test_memo_install_invalidate_under_mutex(cdll):
+    """The UJSON render-memo lifecycle under contention: installer
+    threads publish renders (the oracle's job), writer threads bank
+    writes that invalidate prefixes, reader threads serve GETs. All
+    serialized by the mutex; the memo must end coherent and every
+    served render must be one the installers published."""
+    eng = ServeEngine(cdll)
+    mu = threading.Lock()
+    render = b"$7\r\n{\"n\":1}\r\n"
+
+    def installer():
+        for _ in range(N_ROUNDS):
+            with mu:
+                eng.uj_memo_put(b"doc", [], render)
+                eng.uj_memo_put(b"doc", [b"n"], b"$1\r\n1\r\n")
+
+    def writer():
+        for i in range(N_ROUNDS):
+            with mu:
+                rc, out, deferred, _ = drain_native(
+                    eng, resp(b"UJSON", b"SET", b"doc", b"n", b"%d" % i)
+                )
+                assert rc == 0 and not deferred
+                assert out == b"+OK\r\n"
+                assert eng.uj_memo_len(b"doc") == 0  # prefix invalidated
+
+    def reader():
+        for _ in range(N_ROUNDS):
+            with mu:
+                rc, out, deferred, _ = drain_native(
+                    eng, resp(b"UJSON", b"GET", b"doc")
+                )
+                assert rc == 0
+                # either a miss (deferred to the oracle) or the
+                # installed render, never a torn/stale byte string
+                if deferred:
+                    assert deferred == [[b"UJSON", b"GET", b"doc"]]
+                    assert out == b""
+                else:
+                    assert out == render
+
+    _run_threads([installer, installer, writer, reader, reader])
+    with mu:
+        assert eng.uj_memo_len(b"doc") in (0, 2)
+        assert eng.uq_count() == 0 or eng.uq_drain() is not None
+
+
+def test_interner_compaction_under_load(cdll):
+    """TLOG value-interner compaction racing (under the mutex) with
+    fresh INS traffic on other rows: compaction remaps vids while the
+    ingest path interns new values. Every merged entry must still
+    resolve to its original bytes afterwards."""
+    eng = ServeEngine(cdll)
+    mu = threading.Lock()
+    with mu:
+        row = eng.tlog_upsert(b"hot")
+        eng.tlog_ins(row, 1, b"keep-0")
+        assert eng.tlog_size(row) == 1  # build the merged-view memo
+        for i in range(1, 4000):
+            eng.tlog_ins(row, 1 + i, b"garbage-%d" % i)
+        eng.tlog_flush_deltas()
+
+    def compactor():
+        with mu:
+            # drain trims to the top 2 entries -> most vids garbage
+            eng.tlog_finish_row(row, 2, 3999)
+            eng.tlog_finish_end()
+        for _ in range(N_ROUNDS):
+            with mu:
+                eng.tlog_compact()
+
+    def ingester(n: int):
+        for i in range(N_ROUNDS):
+            with mu:
+                rc, out, deferred, _ = drain_native(
+                    eng,
+                    resp(b"TLOG", b"INS", b"cold-%d" % n,
+                         b"live-%d-%d" % (n, i), b"%d" % (i + 1)),
+                )
+                assert rc == 0 and not deferred and out == b"+OK\r\n"
+
+    _run_threads([compactor] + [lambda n=n: ingester(n) for n in range(3)])
+    with mu:
+        size = eng.tlog_size(row)
+        assert size == eng.tlog_len_cache(row)
+        ents = eng.tlog_merged_entries(row)
+        assert ents is not None and len(ents) == size
+        for _, val in ents:
+            assert val.startswith((b"keep-", b"garbage-"))
+        for n in range(3):
+            r = eng.tlog_find(b"cold-%d" % n)
+            assert eng.tlog_size(r) == N_ROUNDS
