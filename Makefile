@@ -6,7 +6,7 @@ PY ?= python
 .PHONY: test soak bench bench-all bench-full bench-smoke native run clean \
         check-graft ci check-prose image compose-smoke smoke3 release \
         lint lint-native sanitize sanitize-threads chaos metrics-smoke \
-        model-smoke
+        model-smoke loadgen-smoke
 
 # what CI runs per commit (.github/workflows/ci.yml + .circleci/config.yml):
 # hermetic on any host. `test` includes the journal suite
@@ -19,7 +19,7 @@ PY ?= python
 # the multi-threaded engine drive; `chaos` is the tiny fault-injection
 # drill smoke.
 ci: native lint lint-native test chaos model-smoke check-graft check-prose \
-    bench-smoke metrics-smoke sanitize sanitize-threads
+    bench-smoke metrics-smoke loadgen-smoke sanitize sanitize-threads
 
 # the eleven jlint passes + the hygiene rules (broad-except, suppression
 # reasons/staleness), against the committed baseline
@@ -101,6 +101,13 @@ bench-smoke:
 # lane-less counter sums) — neither surface can rot
 metrics-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/metrics_smoke.py
+
+# tiny in-process pass over the open-loop load harness (scripts/loadgen.py
+# — the worker protocol, Zipfian key draw, phase ladder, reservoir
+# percentiles, BUSY/shed accounting against a real armed node) so the
+# plumbing behind the overload-shed numbers can't rot between re-records
+loadgen-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/loadgen.py --smoke
 
 test:
 	$(PY) -m pytest tests/ -x -q

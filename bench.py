@@ -2411,7 +2411,8 @@ _WAN_SPAWN = (
 
 
 def _spawn_wan_node(
-    port, cport, name, region, seed=None, failpoints="", demote_ticks=None
+    port, cport, name, region, seed=None, failpoints="", demote_ticks=None,
+    extra=(),
 ):
     import os
     import subprocess
@@ -2428,6 +2429,7 @@ def _spawn_wan_node(
         argv += ["--failpoints", failpoints]
     if demote_ticks is not None:
         argv += ["--bridge-demote-ticks", str(demote_ticks)]
+    argv += list(extra)
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     return subprocess.Popen(
         argv,
@@ -2661,6 +2663,281 @@ def config_wan_converge() -> dict:
     }
 
 
+# overload-shed drill (this PR): the sustained-overload regime the
+# admission layer is bench-pinned against. The protected class's p99.9
+# at 4x offered load must stay within this factor of its 1x value —
+# the "armor holds" contract docs/operations.md quotes.
+_OVERLOAD_POLICY = "control>read>write>bulk"
+_OVERLOAD_P999_FACTOR = 2.0
+# client-observed MTTR bound: SIGKILL of the routed node until the
+# ClusterClient's next read returns through a survivor.
+_CLIENT_MTTR_BOUND_S = 3.0
+
+
+def _overload_shed_run(
+    procs, phase_s, mults, read_frac, warmup_s,
+    base_rate=0.0, failpoints="", keys=256,
+):
+    """Boot one armed node (--admission-policy) and drive it with the
+    open-loop loadgen harness (scripts/loadgen.py) through the
+    sustained-overload phase ladder; returns loadgen's recorded JSON."""
+    import json as _json
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    port, cport = _free_port(), _free_port()
+    node = _spawn_wan_node(
+        port, cport, "ov-a", "r1", failpoints=failpoints,
+        extra=("--admission-policy", _OVERLOAD_POLICY),
+    )
+    try:
+        deadline = time.time() + 180
+        while True:
+            if node.poll() is not None or time.time() > deadline:
+                raise RuntimeError("overload node never came up")
+            try:
+                s = socket.create_connection(("127.0.0.1", port), timeout=5)
+                s.close()
+                break
+            except OSError:
+                time.sleep(0.3)
+        here = os.path.dirname(os.path.abspath(__file__))
+        argv = [
+            sys.executable, os.path.join(here, "scripts", "loadgen.py"),
+            "--port", str(port), "--procs", str(procs),
+            "--phase-s", str(phase_s), "--mults", mults,
+            "--keys", str(keys), "--read-frac", str(read_frac),
+            "--warmup-s", str(warmup_s),
+        ]
+        if base_rate:
+            argv += ["--base-rate", str(base_rate)]
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            argv, capture_output=True, text=True, cwd=here, env=env,
+            timeout=60.0 + len(mults.split(",")) * (phase_s + 25.0) + 60.0,
+        )
+        if r.returncode != 0:
+            raise RuntimeError(f"loadgen failed: {r.stderr[-500:]}")
+        return _json.loads(r.stdout)
+    finally:
+        if node.poll() is None:
+            node.terminate()
+        try:
+            node.wait(timeout=30)
+        except Exception:
+            node.kill()
+            node.wait(timeout=10)
+
+
+def config_overload_shed() -> dict:
+    """The sustained-overload drill regime (this PR's tentpole bench):
+    one armed node, open-loop Zipfian load at a fixed 900 ops/s base,
+    then held at 1x -> 2x -> 4x offered load. The base is pinned (not
+    probe-calibrated) because on the 1-core reference host the probe
+    ladder's run-to-run variance swings the 4x rate across the
+    capacity boundary — some runs would never overload at all; 900
+    sits comfortably under capacity at 1x and decisively over it at
+    4x (loadgen's --base-rate recalibrates for other hosts). Reads are the protected class (rank 1, inside the
+    protect floor); writes ride SESSION WRAP so the classifier's
+    unwrapping — not first-word syntax — is what sheds them. In-config
+    asserts: the protected class is NEVER shed, overload is declared
+    (enter transitions recorded), the 4x phase sheds most writes and
+    stays in the declared state, and protected p99.9 at 4x holds
+    within _OVERLOAD_P999_FACTOR of its 1x value — the armor contract.
+    Latency excludes a 2s per-phase warmup (the hysteresis entry
+    transient, by design not steady state; counters cover the whole
+    phase)."""
+    out = _overload_shed_run(
+        procs=2, phase_s=8.0, mults="1,2,4", read_frac=0.2, warmup_s=2.0,
+        base_rate=900.0,
+    )
+    ph = {p["mult"]: p for p in out["phases"]}
+    p1, p4 = ph[1.0], ph[4.0]
+    assert all(
+        p["shed_frac"]["read"] == 0.0 for p in out["phases"]
+    ), f"protected class was shed: {out}"
+    enters = sum(p["overload_delta"]["enters"] for p in out["phases"])
+    assert enters >= 1, f"overload never declared: {out}"
+    assert p4["shed_frac"]["write"] > 0.5, (
+        f"4x shed fraction too low: {p4['shed_frac']}"
+    )
+    assert p4["overload_delta"]["state_after"] == 1, (
+        f"4x phase should end in declared overload: {p4}"
+    )
+    p999_1 = p1["lat_ms"]["read"]["p999"]
+    p999_4 = p4["lat_ms"]["read"]["p999"]
+    assert p999_4 <= _OVERLOAD_P999_FACTOR * p999_1, (
+        f"protected p99.9 {p999_4}ms at 4x breaches "
+        f"{_OVERLOAD_P999_FACTOR}x its 1x value {p999_1}ms"
+    )
+    return {
+        "metric": (
+            "protected-class (read) p99.9 under sustained 4x overload "
+            "(open-loop Zipfian, priority admission shedding writes)"
+        ),
+        "value": p999_4,
+        "unit": "ms read p99.9 at 4x offered load (steady state)",
+        # the armor contract: 4x tail over 1x tail, bound 2.0
+        "vs_baseline": round(p999_4 / max(p999_1, 1e-9), 2),
+        "policy": _OVERLOAD_POLICY,
+        "base_rate_ops_s": out["base_rate"],
+        "read_frac": out["read_frac"],
+        "p999_bound_factor": _OVERLOAD_P999_FACTOR,
+        # flat copies of the headline phase numbers (check_prose
+        # claims read top-level fields only)
+        "p999_1x_ms": p999_1,
+        "shed_frac_write_4x": p4["shed_frac"]["write"],
+        "phases": [
+            {
+                "mult": p["mult"],
+                "read_p50_ms": p["lat_ms"]["read"]["p50"],
+                "read_p99_ms": p["lat_ms"]["read"]["p99"],
+                "read_p999_ms": p["lat_ms"]["read"]["p999"],
+                "shed_frac": p["shed_frac"],
+                "overload": p["overload_delta"],
+            }
+            for p in out["phases"]
+        ],
+        "note": (
+            "writes are SESSION WRAP GCOUNT INC — shed by the "
+            "classifier's unwrapping, not first-word syntax; the 2x "
+            "phase rides the capacity edge (severe-shed flapping) and "
+            "is recorded but not bounded; 4x pins severe shedding and "
+            "the protected tail returns to its 1x shape"
+        ),
+    }
+
+
+def config_client_failover() -> dict:
+    """Client-observed MTTR across a SIGKILL of the routed node: the
+    cluster-aware ClusterClient (jylis_tpu/client.py) discovers the
+    3-node/2-region topology via SYSTEM TOPOLOGY, routes to its home
+    region, and carries a session token. Each trial writes through the
+    routed node, waits for the delta to replicate, SIGKILLs that node,
+    and clocks kill -> the next successful routed read (token intact:
+    read-your-writes holds through the failover). Two trials (the
+    second fails over from the first's survivor), each bounded by
+    _CLIENT_MTTR_BOUND_S in-config."""
+    import signal
+    import socket
+
+    from jylis_tpu.client import ClusterClient
+
+    def call(port, cmd: bytes) -> bytes:
+        s = socket.create_connection(("127.0.0.1", port), timeout=10)
+        try:
+            s.sendall(cmd)
+            s.settimeout(10)
+            return s.recv(1 << 16)
+        finally:
+            s.close()
+
+    ports = [_free_port() for _ in range(3)]
+    cports = sorted(_free_port() for _ in range(3))
+    seed = f"127.0.0.1:{cports[0]}:cf-a"
+    dt = _WAN_FAILOVER_DEMOTE_TICKS
+    procs = [
+        _spawn_wan_node(
+            ports[0], cports[0], "cf-a", "r1", demote_ticks=dt,
+        ),
+        _spawn_wan_node(
+            ports[1], cports[1], "cf-b", "r1", seed=seed, demote_ticks=dt,
+        ),
+        _spawn_wan_node(
+            ports[2], cports[2], "cf-c", "r2", seed=seed, demote_ticks=dt,
+        ),
+    ]
+    cc = None
+    try:
+        deadline = time.time() + 180
+        for p in ports:
+            while True:
+                if time.time() > deadline:
+                    raise RuntimeError("failover node never came up")
+                try:
+                    if call(p, b"GCOUNT GET boot\r\n").startswith(b":"):
+                        break
+                except OSError:
+                    time.sleep(0.3)
+        # warm the mesh: a write on each node visible on every other
+        call(ports[0], b"GCOUNT INC warm 1\r\n")
+        while call(ports[2], b"GCOUNT GET warm\r\n") != b":1\r\n":
+            if time.time() > deadline:
+                raise RuntimeError("mesh never converged")
+            time.sleep(0.05)
+        cc = ClusterClient(
+            [("127.0.0.1", p) for p in ports], region="r1", timeout=10,
+        )
+        trials = []
+        for i in range(2):
+            key = f"cf{i}"
+            assert cc.write("GCOUNT", "INC", key, "5") == b"OK"
+            victim_port = cc._ep[1]
+            victim = procs[ports.index(victim_port)]
+            want = b":5\r\n"
+            for sp in ports:
+                if sp == victim_port or procs[ports.index(sp)].poll() is not None:
+                    continue
+                while call(sp, b"GCOUNT GET %s\r\n" % key.encode()) != want:
+                    if time.time() > deadline:
+                        raise RuntimeError("delta never replicated")
+                    time.sleep(0.05)
+            victim.send_signal(signal.SIGKILL)
+            victim.wait(timeout=30)
+            t0 = time.perf_counter()
+            assert cc.read("GCOUNT", "GET", key) == 5
+            wall = time.perf_counter() - t0
+            assert wall < _CLIENT_MTTR_BOUND_S, (
+                f"trial {i}: client MTTR {wall:.3f}s breaches the "
+                f"{_CLIENT_MTTR_BOUND_S}s bound"
+            )
+            trials.append(
+                {
+                    "mttr_wall_s": round(wall, 4),
+                    "mttr_client_s": round(cc.stats["last_mttr_s"], 4),
+                }
+            )
+        assert cc.stats["failovers"] >= 2, cc.stats
+        worst = max(t["mttr_wall_s"] for t in trials)
+        return {
+            "metric": (
+                "client-observed MTTR: SIGKILL of the routed node until "
+                "the ClusterClient's next successful read (3 nodes, 2 "
+                "regions, session token carried through failover)"
+            ),
+            "value": worst,
+            "unit": "s worst-trial kill->read wall clock",
+            "vs_baseline": round(worst / _CLIENT_MTTR_BOUND_S, 3),
+            "mttr_bound_s": _CLIENT_MTTR_BOUND_S,
+            "trials": trials,
+            "client_stats": {
+                k: (round(v, 4) if isinstance(v, float) else v)
+                for k, v in cc.stats.items()
+            },
+            "note": (
+                "mttr_client_s is the client's own first-failure-to-"
+                "success clock (stats.last_mttr_s); the wall number "
+                "additionally covers failure detection from the kill "
+                "instant. Read-your-writes holds across the failover: "
+                "the session token rides SESSION READ on the survivor"
+            ),
+        }
+    finally:
+        if cc is not None:
+            cc.close()
+        for pr in procs:
+            if pr.poll() is None:
+                pr.terminate()
+        for pr in procs:
+            try:
+                pr.wait(timeout=30)
+            except Exception:
+                pr.kill()
+                pr.wait(timeout=10)
+
+
 CONFIGS = {
     "gcount-smoke": config_gcount_smoke,
     "concurrent": config_concurrent,
@@ -2681,6 +2958,8 @@ CONFIGS = {
     "bcount-contention": config_bcount_contention,
     "workload-zipf": config_workload_zipf,
     "wan-converge": config_wan_converge,
+    "overload-shed": config_overload_shed,
+    "client-failover": config_client_failover,
 }
 
 
@@ -2776,6 +3055,25 @@ def smoke() -> None:
     # bound — the harness behind the failover_gap_ms record
     gap = _wan_failover_gap(0.0)
     assert 0 < gap < _wan_failover_bound_ms(0.0), gap
+    # tiny overload-shed pass (this PR): the armed node + open-loop
+    # loadgen pipeline behind the overload-shed record, with the
+    # forced-shed failpoint standing in for real overload so the BUSY
+    # accounting (shed, not error) is exercised deterministically at
+    # 1s phases — the recorded regime only means anything at full scale
+    ov = _overload_shed_run(
+        procs=2, phase_s=1.0, mults="1,4", read_frac=0.7, warmup_s=0.0,
+        base_rate=300.0, failpoints="admission.shed=error:40", keys=32,
+    )
+    ov_ok = sum(
+        p["ok"][c] for p in ov["phases"] for c in ("read", "write")
+    )
+    ov_busy = sum(
+        p["busy"][c] for p in ov["phases"] for c in ("read", "write")
+    )
+    assert ov_ok > 100 and ov_busy > 0, (ov_ok, ov_busy)
+    assert all(
+        p["err"][c] == 0 for p in ov["phases"] for c in ("read", "write")
+    ), ov
     print(
         json.dumps(
             {

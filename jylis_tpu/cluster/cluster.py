@@ -665,6 +665,12 @@ class Cluster:
         if system is not None and register_system:
             system.cluster_fn = self.metrics_totals
             system.lag_fn = self.lag_snapshot
+            system.topology_fn = self.topology_lines
+        # SYSTEM TOPOLOGY carries the node's client-facing RESP port so
+        # a cluster-aware client (client.py) can map its seed endpoint
+        # onto this cluster identity; main.py pushes the bound port in
+        # after the server starts listening (0 until then)
+        self.resp_port = 0
 
     # ---- lifecycle --------------------------------------------------------
 
@@ -1006,6 +1012,31 @@ class Cluster:
                 "cluster.bridge_is_self",
                 1.0 if b == str(self._addr) else 0.0,
             )
+
+    def topology_lines(self) -> list[str]:
+        """The SYSTEM TOPOLOGY reply body: this node first (advertised
+        address, region, bridge role, RESP port), then one line per
+        OTHER known address with its gossiped region and this
+        observer's own liveness evidence (_addr_live — the same
+        evidence bridge election runs on, so a client and the
+        electorate age a dead node out on the same clock). Flat
+        greppable lines, not structured data, matching the METRICS
+        house style; client.py's ClusterClient parses them for
+        nearest-replica routing and leave detection."""
+        region = self._region or "-"
+        lines = [
+            f"self {self._addr} region {region} bridge "
+            f"{1 if self._is_bridge() else 0} resp_port {self.resp_port}"
+        ]
+        for a in sorted(self._known_addrs, key=str):
+            if a == self._addr:
+                continue
+            r = self._regions.get(str(a), ("", 0))[0] or "-"
+            lines.append(
+                f"node {a} region {r} live "
+                f"{1 if self._addr_live(a) else 0}"
+            )
+        return lines
 
     def _region_entries(self) -> tuple:
         """The gossiped region map as sorted wire triples."""

@@ -189,6 +189,11 @@ async def run(argv: list[str] | None = None) -> None:
     # session-guarantee + admission-control knobs (docs/sessions.md)
     database.session_wait_ms = config.session_wait_ms
     database.set_admission_cap(config.admission_cap)
+    # overload armor (admission.py, docs/operations.md "Overload"):
+    # node-wide per-class shedding + the queued-bytes hard bound
+    database.set_admission(
+        config.admission_policy, config.admission_queue_bytes
+    )
     log = config.log
     if lane_id is not None:
         # SYSTEM METRICS' LANE section: which lane this connection
@@ -302,6 +307,13 @@ async def run(argv: list[str] | None = None) -> None:
 
         lane_tick_task = asyncio.create_task(_lane_tick())
     await server.start()
+    # SYSTEM TOPOLOGY advertises the node's RESP port (cluster-aware
+    # client discovery, client.py) — known only after listen, pushed
+    # onto whichever cluster object registered the system hooks (the
+    # single-node Cluster, or the lane bus + lane 0's external identity)
+    for sub in getattr(cluster, "clusters", [cluster]):
+        if hasattr(sub, "resp_port"):
+            sub.resp_port = int(server.port)
     await cluster.start()
     metrics_http = None
     if config.metrics_port:

@@ -162,6 +162,15 @@ class Database:
         self.system.digest_types_fn = self._sync_digest_types_blocking
         # SYSTEM METRICS' SESSION section (token/read/refusal counters)
         self.system.session_fn = self.sessions.metrics_totals
+        # overload armor (admission.py): node-wide per-class admission,
+        # consulted by the Server at every Python-path dispatch. The
+        # default controller is unarmed (no policy, no byte bound) —
+        # set_admission replaces it with the configured one and keeps
+        # the OVERLOAD section of SYSTEM METRICS pointed at it.
+        from ..admission import AdmissionController
+
+        self.admission = AdmissionController(registry=self.metrics)
+        self.system.overload_fn = self.admission.metrics_totals
 
     def _served_totals(self) -> dict[str, int]:
         """Commands served per type on BOTH paths (SYSTEM METRICS)."""
@@ -300,6 +309,18 @@ class Database:
         for name in self.DATA_TYPES:
             self._sync_update_repo(name, self._map[name.encode()].repo)
         return [(n, self._sync_xor[n]) for n in self.DATA_TYPES]
+
+    def set_admission(self, policy: str, queue_bytes: int) -> None:
+        """Arm the node-wide overload armor (--admission-policy /
+        --admission-queue-bytes, admission.py): per-class priority
+        shedding under the declared OVERLOAD state plus the hard
+        queued-bytes bound. Replaces the unarmed default controller."""
+        from ..admission import AdmissionController
+
+        self.admission = AdmissionController(
+            policy, queue_bytes, registry=self.metrics
+        )
+        self.system.overload_fn = self.admission.metrics_totals
 
     def set_admission_cap(self, cap: int) -> None:
         """Per-command-class admission control (--admission-cap): each

@@ -27,6 +27,7 @@ SYSTEM_HELP = LeafHelp(
     "  SYSTEM LATENCY\n"
     "  SYSTEM TRACE [count]\n"
     "  SYSTEM DIGEST [TYPES]\n"
+    "  SYSTEM TOPOLOGY\n"
     "  SYSTEM VERSION"
 )
 
@@ -77,6 +78,16 @@ class RepoSYSTEM:
         # [(name, 32-byte digest)] so operators localize divergence to a
         # type before walking its digest-tree ranges
         self.digest_types_fn = None
+        # the Database wires this to its AdmissionController's totals
+        # for the OVERLOAD section of SYSTEM METRICS (declared overload
+        # state, enter/exit transitions, per-class shed counters —
+        # docs/operations.md, "Overload")
+        self.overload_fn = None
+        # the Cluster wires this to its topology view (self + every
+        # known address with region/liveness/bridge attribution): the
+        # SYSTEM TOPOLOGY reply cluster-aware clients (client.py
+        # ClusterClient) discover routing from
+        self.topology_fn = None
 
     def apply(self, resp, args: list[bytes]) -> bool:
         op = need(args, 0)
@@ -105,6 +116,7 @@ class RepoSYSTEM:
                 registry=self.metrics,
                 lane=self.lane_fn() if self.lane_fn else None,
                 session=self.session_fn() if self.session_fn else None,
+                overload=self.overload_fn() if self.overload_fn else None,
             )
             resp.array_start(len(lines))
             for line in lines:
@@ -155,6 +167,22 @@ class RepoSYSTEM:
             if self.digest_fn is None:
                 raise ParseError()
             resp.string(self.digest_fn().hex().encode())
+            return False
+        if op == b"TOPOLOGY":
+            # the cluster-aware client's discovery surface: one line for
+            # this node (advertised addr, region, bridge role, RESP
+            # port) then one per known peer address with the observer's
+            # own liveness evidence — enough to route to the nearest
+            # replica and to notice a node leaving. Region-less /
+            # cluster-less nodes report just themselves.
+            if self.topology_fn is None:
+                resp.array_start(1)
+                resp.string(b"self - region - bridge 0 resp_port 0")
+                return False
+            lines = self.topology_fn()
+            resp.array_start(len(lines))
+            for line in lines:
+                resp.string(line)
             return False
         if op == b"VERSION":
             from .. import __version__

@@ -344,9 +344,9 @@ class RepoUJSON:
         queue is large): a small host-only flush runs inline, so the one
         deferred command that flushes it never opens a lock window that
         routes every OTHER connection's burst off the native path
-        (server/server.py _native_busy — the round-5 shape threaded
-        every flush and turned each UJSON defer into a whole-node
-        demotion storm under concurrency)."""
+        (server/server.py read-loop busy check — the round-5 shape
+        threaded every flush and turned each UJSON defer into a
+        whole-node demotion storm under concurrency)."""
         if self.engine is not None and self.engine.uq_count():
             if (
                 self._res is not None

@@ -45,6 +45,18 @@ class NativeRespParser:
         stream's head belongs to this parser, not the native engine."""
         return bool(self._buf)
 
+    def take_tail(self) -> bytes | None:
+        """Hand the held bytes back to the caller (and forget them), so
+        the stream's head can return to the native engine. Only legal
+        when every parsed command has been iterated out and the stream
+        is well-formed — returns None otherwise (the caller must then
+        keep routing through this parser)."""
+        if self._ready or self._bad:
+            return None
+        out = bytes(self._buf)
+        del self._buf[:]
+        return out
+
     def __iter__(self):
         return self
 

@@ -78,4 +78,10 @@ GAUGES = (
     # live byte depth of the cross-bridge repair relay queue
     "cluster.bridge_is_self",
     "cluster.relay_queue_bytes",
+    # overload armor (admission.py): the declared overload state (1
+    # while shedding by class, 0 otherwise — hysteresis contract in
+    # docs/operations.md) and the live total of un-drained reply bytes
+    # the --admission-queue-bytes hard bound is enforced against
+    "serving.overload",
+    "serving.queued_bytes",
 )

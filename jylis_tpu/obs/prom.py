@@ -47,6 +47,21 @@ def render(database) -> str:
             f'jylis_serving_total{{kind="{key}"}} {serving.get(key, 0)}'
         )
 
+    overload = system.overload_fn() if system.overload_fn else {}
+    if overload.get("armed"):
+        # overload armor (admission.py): same split discipline as the
+        # SESSION section — monotone transition/shed counters vs the
+        # live state/pressure gauges — so rate() stays meaningful
+        _OVERLOAD_GAUGES = ("state", "ewma_us", "inflight", "queued_bytes")
+        out.append("# TYPE jylis_overload_total counter")
+        for key, v in overload.items():
+            if key not in _OVERLOAD_GAUGES and key != "armed":
+                out.append(f'jylis_overload_total{{kind="{_esc(key)}"}} {v}')
+        out.append("# TYPE jylis_overload gauge")
+        for key in _OVERLOAD_GAUGES:
+            if key in overload:
+                out.append(f'jylis_overload{{key="{key}"}} {overload[key]}')
+
     session = system.session_fn() if system.session_fn else {}
     if session:
         # the section mixes monotone counters with two live gauges —

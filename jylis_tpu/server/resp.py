@@ -90,6 +90,15 @@ class RespParser:
         stream's head belongs to this parser, not the native engine."""
         return bool(self._buf)
 
+    def take_tail(self) -> bytes | None:
+        """Hand the held bytes back to the caller (and forget them), so
+        the stream's head can return to the native engine. Only legal
+        once every complete command has been iterated out — for this
+        parser, any time (``_buf`` then holds exactly the split tail)."""
+        out = bytes(self._buf)
+        self._buf.clear()
+        return out
+
     def __iter__(self):
         return self
 

@@ -19,6 +19,7 @@ from __future__ import annotations
 import asyncio
 import time
 
+from .. import admission as admission_mod
 from .. import faults
 from ..models.database import Database
 from ..native.resp import make_parser
@@ -109,22 +110,45 @@ class Server:
         buf = bytearray()
         self._conns.add(writer)
         try:
+            adm_armed = self._database.admission.armed
             while True:
                 data = await reader.read(1 << 16)
                 if not data:
                     break
+                # the overload signal's arrival stamp: queue time for
+                # every command in this chunk runs from this read
+                t_arr = time.perf_counter() if adm_armed else 0.0
                 if use_native:
-                    if self._native_busy(parser):
-                        # a drain holds a counter lock (or the parser holds
-                        # a partial command): route THIS burst through the
-                        # per-repo Python path so unrelated repos never
-                        # wait on the engine's two-lock boundary
+                    go_native = not any(
+                        m.busy() for m in self._engine_managers()
+                    )
+                    if go_native and parser.has_pending():
+                        # a previous burst was routed through the Python
+                        # parser and left a split command's head behind:
+                        # reclaim it so the stream returns to the engine.
+                        # Without this, one mid-command chunk boundary
+                        # (near-certain once a saturated connection fills
+                        # 64 KiB reads) exiles the connection to the
+                        # per-command Python path for as long as the
+                        # backlog lasts — the engine abandoned exactly
+                        # when its throughput matters most.
+                        tail = parser.take_tail()
+                        if tail is None:
+                            go_native = False  # malformed/unserved: stay
+                        else:
+                            buf += tail
+                    if not go_native:
+                        # a drain holds a counter lock: route THIS burst
+                        # through the per-repo Python path so unrelated
+                        # repos never wait on the engine's two-lock
+                        # boundary
                         parser.append(bytes(buf))
                         buf.clear()
                     else:
                         buf += data
                         use_native = await self._apply_native(
-                            engine, buf, parser, resp, flush, writer
+                            engine, buf, parser, resp, flush, writer, out,
+                            t_arr,
                         )
                         if use_native:
                             flush()
@@ -134,12 +158,7 @@ class Server:
                 parser.append(data)
                 try:
                     for cmd in parser:
-                        t0 = (
-                            time.perf_counter() if self._reg.enabled else 0.0
-                        )
-                        await self._database.apply_async(resp, cmd)
-                        if t0:
-                            self._h_py.record(time.perf_counter() - t0)
+                        await self._dispatch_py(resp, cmd, writer, out, t_arr)
                         flush(1 << 16)  # bound the reply buffer mid-burst
                 except RespError as e:
                     resp.err(str(e))
@@ -150,6 +169,7 @@ class Server:
         except (ConnectionResetError, BrokenPipeError, asyncio.CancelledError):
             pass
         finally:
+            self._database.admission.drop_conn(id(writer))
             self._conns.discard(writer)
             writer.close()
             try:
@@ -157,18 +177,68 @@ class Server:
             except (ConnectionResetError, BrokenPipeError):
                 pass
 
+    async def _dispatch_py(self, resp, cmd, writer, out, t_arr=0.0) -> None:
+        """ONE Python-path dispatch (demoted loop and the native path's
+        deferred commands share it): the overload-armor admission gate
+        (admission.py) in front of Database.apply_async. When armed it
+        classifies the command (SESSION WRAP/READ inherit their inner
+        command's class), refreshes this connection's queued-reply-bytes
+        accounting, and either refuses up front with a typed BUSY
+        (retry-after hint included, before any session flush / repo
+        lock / drain is paid for) or dispatches and feeds the overload
+        state machine. Unarmed costs two attribute reads.
+
+        ``t_arr`` is the perf_counter stamp of the socket read that
+        delivered this command's chunk. The latency fed to the state
+        machine runs from THERE, not from dispatch start: under an open
+        loop the queueing delay lives in the connection's parsed-burst
+        backlog (a 64 KiB chunk is thousands of commands drained
+        sequentially), and a service-time-only EWMA sits flat at
+        sub-millisecond while clients wait seconds — the signal must
+        see time-in-our-own-queue or the node never declares overload."""
+        adm = self._database.admission
+        if adm.armed:
+            adm.note_conn_queued(
+                id(writer),
+                writer.transport.get_write_buffer_size() + len(out),
+            )
+            cls = admission_mod.classify(cmd)
+            hint = await admission_mod.gate(adm, cls)
+            if hint is not None:
+                resp.err(
+                    admission_mod.busy_reply(
+                        cls, hint, "node is shedding this class"
+                    )
+                )
+                # the refusal path's ONLY await: without it a
+                # backlogged chunk of thousands of shed commands runs
+                # as one synchronous slab, and every OTHER connection's
+                # (protected, admitted) commands stall behind it —
+                # measured as ~300ms protected-read tails at 4x offered
+                # load while the shed itself took microseconds
+                await asyncio.sleep(0)
+                return
+            t0 = time.perf_counter()
+            await self._database.apply_async(resp, cmd)
+            t1 = time.perf_counter()
+            adm.done(cls, t1 - (t_arr or t0))
+            if self._reg.enabled:
+                self._h_py.record(t1 - t0)
+            return
+        t0 = time.perf_counter() if self._reg.enabled else 0.0
+        await self._database.apply_async(resp, cmd)
+        if t0:
+            self._h_py.record(time.perf_counter() - t0)
+
     # the engine's changed-counter order (serve_engine.cpp scan_apply2)
     _ENGINE_TYPES = ("GCOUNT", "PNCOUNT", "TREG", "TLOG", "UJSON")
 
     def _engine_managers(self):
         return [self._database.manager(n) for n in self._ENGINE_TYPES]
 
-    def _native_busy(self, parser) -> bool:
-        return parser.has_pending() or any(
-            m.busy() for m in self._engine_managers()
-        )
-
-    async def _apply_native(self, engine, buf, parser, resp, flush, writer):
+    async def _apply_native(
+        self, engine, buf, parser, resp, flush, writer, out, t_arr=0.0
+    ):
         """Drain `buf` through the native serving engine; commands it
         can't settle route through the normal per-repo async path in
         order (`resp` buffers those replies; `flush` pushes them to the
@@ -229,11 +299,26 @@ class Server:
                     if ch:
                         mgr._maybe_proactive_flush()
             del buf[:consumed]
+            # slow-consumer hard bound (--admission-queue-bytes): engine
+            # replies land straight in the transport buffer; once the
+            # node-wide queued total is past the cap, drain() here is
+            # real per-connection backpressure — it parks only THIS
+            # connection until its consumer catches up, outside the
+            # repo locks, so the loop's memory stays bounded without
+            # slowing healthy consumers
+            adm = self._database.admission
+            if adm.queue_bytes_cap:
+                adm.note_conn_queued(
+                    id(writer), writer.transport.get_write_buffer_size()
+                )
+                if adm.queued_bytes > adm.queue_bytes_cap:
+                    await writer.drain()
+                    adm.note_conn_queued(
+                        id(writer),
+                        writer.transport.get_write_buffer_size(),
+                    )
             if rc == 1:  # one command for the Python path, in order
-                t0 = time.perf_counter() if self._reg.enabled else 0.0
-                await self._database.apply_async(resp, unhandled)
-                if t0:
-                    self._h_py.record(time.perf_counter() - t0)
+                await self._dispatch_py(resp, unhandled, writer, out, t_arr)
                 # a burst of repeatedly deferring reads (e.g. renders
                 # too big for the engine's reply buffer) produces no
                 # engine write to piggyback on: bound the buffer here

@@ -68,6 +68,14 @@ class Config:
     # — commands of one data type queued behind its repo lock past this
     # cap get a typed BUSY refusal; 0 (default) disables
     admission_cap: int = 0
+    # extension: overload armor (admission.py) — priority order + the
+    # pressure thresholds for node-wide shedding; empty (default)
+    # disables shedding (the queued-bytes bound below still applies)
+    admission_policy: str = ""
+    # hard bound on total un-drained reply bytes across connections: a
+    # slow-consumer burst past it gets BUSY on EVERY class so the loop
+    # can never OOM on parked replies; 0 disables
+    admission_queue_bytes: int = 256 << 20
     # extension: deterministic fault injection (faults.py); same syntax
     # as the JYLIS_FAILPOINTS env var, armed at startup
     failpoints: str = ""
@@ -231,6 +239,27 @@ def config_from_cli(argv: list[str] | None = None, log_out=None) -> Config:
         "(default) disables.",
     )
     parser.add_argument(
+        "--admission-policy", default=Config.admission_policy,
+        help="Overload armor (docs/operations.md, 'Overload'): the "
+        "priority order for node-wide shedding plus optional pressure "
+        "thresholds, e.g. 'control>read>write>bulk,lat=25,depth=128,"
+        "protect=2'. While the node's declared OVERLOAD state is on "
+        "(dispatch-latency EWMA past 'lat' ms or in-flight depth past "
+        "'depth', with hysteresis), classes below the top 'protect' "
+        "ranks are refused with a typed BUSY carrying a retry-after "
+        "hint. SESSION WRAP/READ classify as their inner command. "
+        "Empty (default) disables shedding.",
+    )
+    parser.add_argument(
+        "--admission-queue-bytes", type=int,
+        default=Config.admission_queue_bytes,
+        help="Hard bound on total un-drained reply bytes across client "
+        "connections (transport buffers + reply staging): past it every "
+        "command class is refused BUSY until consumers drain, so a "
+        "slow-consumer burst can never OOM the serving loop. 0 "
+        "disables.",
+    )
+    parser.add_argument(
         "--failpoints", default="",
         help="Deterministic fault injection spec, e.g. "
         "'cluster.dial=error:3,journal.fsync=sleep:0.2' "
@@ -307,6 +336,15 @@ def config_from_cli(argv: list[str] | None = None, log_out=None) -> Config:
     config.bridge_demote_ticks = args.bridge_demote_ticks
     config.session_wait_ms = args.session_wait_ms
     config.admission_cap = args.admission_cap
+    config.admission_policy = args.admission_policy
+    if config.admission_policy:
+        from ..admission import PolicySpecError, parse_policy
+
+        try:
+            parse_policy(config.admission_policy)
+        except PolicySpecError as e:
+            parser.error(f"--admission-policy: {e}")
+    config.admission_queue_bytes = args.admission_queue_bytes
     config.failpoints = args.failpoints
     config.metrics_port = args.metrics_port
     if args.lanes == "auto":

@@ -132,6 +132,7 @@ def metric_lines(
     registry: MetricsRegistry | None = None,
     lane: dict[str, int] | None = None,
     session: dict[str, int] | None = None,
+    overload: dict[str, int] | None = None,
 ) -> list[str]:
     """Flat `type counter value` lines — the SYSTEM METRICS reply body.
     ``served`` is the serving node's per-type commands-served totals
@@ -172,6 +173,17 @@ def metric_lines(
         # docs/operations.md, contracts in docs/sessions.md
         lines.extend(
             f"SESSION {k} {v}" for k, v in sorted(session.items())
+        )
+    if overload is not None and overload.get("armed"):
+        # overload armor (admission.py, docs/operations.md "Overload"):
+        # the declared shed state, its transitions, per-class shed
+        # counters and the live pressure signals — the section appears
+        # whenever admission is armed (policy set or byte bound on),
+        # explicit zeros included, so dashboards see it from boot
+        lines.extend(
+            f"OVERLOAD {k} {v}"
+            for k, v in overload.items()
+            if k != "armed"
         )
     if cluster is not None:
         # insertion order (states first, then counters) — a glossary

@@ -42,6 +42,11 @@ def fmt_ms(v: float) -> str:
     return f"{v / 1e3:.1f} ms"
 
 
+def fmt_ms_plain(v: float) -> str:
+    """A value already in ms (fmt_ms divides from µs)."""
+    return f"{v:.1f} ms"
+
+
 def fmt_thousands(v: float) -> str:
     return f"{v / 1e3:.0f}k"
 
@@ -210,6 +215,24 @@ CLAIMS = [
     ("README.md", "wan-converge", "failover_gap_80_ms",
      lambda v: f"{v / 1e3:.1f} s",
      "measures a {} SIGKILL-to-reconverged gap", "README failover gap"),
+    # overload-armor round: the sustained-overload drill's headline
+    # numbers (the protected read tail at 4x offered load vs its 1x
+    # value, the 4x write shed fraction) and the client-observed
+    # failover MTTR, pinned wherever the prose claims them
+    ("docs/operations.md", "overload-shed", "shed_frac_write_4x", fmt_frac,
+     "sheds a {} write fraction", "operations doc 4x shed fraction"),
+    ("docs/operations.md", "overload-shed", "value", fmt_ms_plain,
+     "read p99.9 of {} at 4×", "operations doc 4x protected tail"),
+    ("docs/operations.md", "overload-shed", "p999_1x_ms", fmt_ms_plain,
+     "against {} at 1×", "operations doc 1x protected tail"),
+    ("docs/client.md", "client-failover", "value",
+     lambda v: f"{v * 1e3:.1f} ms",
+     "read at {} worst-trial", "client doc failover MTTR"),
+    ("README.md", "overload-shed", "shed_frac_write_4x", fmt_frac,
+     "shedding a {} write fraction", "README 4x shed fraction"),
+    ("README.md", "client-failover", "value",
+     lambda v: f"{v * 1e3:.1f} ms",
+     "fails over in {}", "README failover MTTR"),
 ]
 
 

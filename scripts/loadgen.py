@@ -1,0 +1,562 @@
+"""Open-loop multi-process load harness (the overload drill's engine).
+
+Closed-loop load generators measure a server's ability to make ITS
+clients wait: when the node slows down, a closed loop offers less load,
+and the latency distribution silently heals. Overload behavior only
+shows under an OPEN loop — commands are sent on a fixed schedule
+whether or not earlier replies have arrived, and each command's latency
+is measured from its SCHEDULED send time, so queueing delay (the thing
+overload actually inflicts on users) is part of the number.
+
+Shape:
+
+* N worker PROCESSES (``--procs``), each one connection, each split
+  into a sender thread (schedules sends at the offered rate, pipelines
+  onto the socket) and a receiver thread (parses replies FIFO, matches
+  them to scheduled times, buckets per class). Workers are spawned as
+  ``--worker`` re-executions of this script with a JSON config argv —
+  no multiprocessing pickling, no jax import (the whole script is
+  stdlib + sockets, so a worker boots in milliseconds).
+* Zipfian key skew (``--zipf-s``, YCSB's 0.99 default) plus REGIONAL
+  skew: ``--region-frac`` of ops target ``<region>:``-prefixed keys,
+  modeling the home-region bias a geo-placed workload has.
+* Sustained-overload phases: ``--mults 1,2,4`` runs the same mix at
+  1x, 2x, 4x of the base rate (``--base-rate``, or calibrated to
+  ``CALIB_FRAC`` of measured closed-loop capacity when 0), recording
+  per-phase per-class sent/ok/busy/err, shed fractions, read/write
+  latency percentiles (p50/p99/p99.9), and the delta of the node's
+  OVERLOAD counters (SYSTEM METRICS) across the phase.
+
+The command mix is deliberately two-class: reads are plain ``GCOUNT
+GET`` (the protected class under the default admission policy) and
+writes are ``SESSION WRAP GCOUNT INC`` — session-wrapped exactly so the
+classifier's WRAP-unwrapping is load-bearing in every drill that uses
+this harness (a first-word classifier would never shed them).
+
+``--smoke`` boots a throwaway node (forced-shed failpoint armed so the
+BUSY path is exercised deterministically), runs a two-phase micro-run,
+and asserts the output shape — the ``make ci`` loadgen-smoke step.
+bench.py's ``overload-shed`` config drives this script as a subprocess
+and asserts the acceptance bound on the recorded numbers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import bisect
+import json
+import math
+import os
+import random
+import socket
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+
+# base rate = this fraction of the last sustainable probe rung. The
+# headroom is deliberate, on both ends of the phase ladder: the 1x
+# phase must be CLEANLY under capacity on a noisy shared host (the
+# drill's contract compares 4x tails against it, and the probe
+# criterion sits near the admission enter threshold), and the 4x phase
+# must stay inside the REFUSAL path's own throughput ceiling — a shed
+# command still costs a parse, a classify and a typed reply, so at
+# 0.85 x rung the 4x write flood outran even the refusal path on a
+# small host and the protected tail drowned in arrival backlog.
+CALIB_FRAC = 0.50
+LAT_CAP = 50_000  # reservoir size per class per worker
+
+READ = "read"
+WRITE = "write"
+
+
+# ---- a tiny standalone RESP client (no jylis_tpu import) -------------------
+
+
+def _pack(args: list[bytes]) -> bytes:
+    out = [b"*%d\r\n" % len(args)]
+    for a in args:
+        out.append(b"$%d\r\n%s\r\n" % (len(a), a))
+    return b"".join(out)
+
+
+class _Conn:
+    def __init__(self, host: str, port: int, timeout: float = 60.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.sock.settimeout(timeout)
+        self.buf = b""
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def _fill(self) -> None:
+        chunk = self.sock.recv(1 << 16)
+        if not chunk:
+            raise RuntimeError("connection closed by server")
+        self.buf += chunk
+
+    def _line(self) -> bytes:
+        while b"\r\n" not in self.buf:
+            self._fill()
+        line, self.buf = self.buf.split(b"\r\n", 1)
+        return line
+
+    def read_reply(self):
+        """One reply; error replies return ("err", text) instead of
+        raising so the receiver can bucket them without try/except."""
+        line = self._line()
+        kind, rest = line[:1], line[1:]
+        if kind == b"+":
+            return rest
+        if kind == b"-":
+            return ("err", rest.decode())
+        if kind == b":":
+            return int(rest)
+        if kind == b"$":
+            n = int(rest)
+            if n < 0:
+                return None
+            while len(self.buf) < n + 2:
+                self._fill()
+            out, self.buf = self.buf[:n], self.buf[n + 2 :]
+            return out
+        if kind == b"*":
+            n = int(rest)
+            if n < 0:
+                return None
+            return [self.read_reply() for _ in range(n)]
+        raise RuntimeError(f"unparseable reply line: {line!r}")
+
+    def call(self, *words: bytes):
+        self.sock.sendall(_pack(list(words)))
+        return self.read_reply()
+
+
+# ---- key skew ---------------------------------------------------------------
+
+
+class Zipf:
+    """Rank sampler over ``n`` keys with exponent ``s`` via inverse-CDF
+    bisect (n is small enough that the precomputed CDF is cheap)."""
+
+    def __init__(self, n: int, s: float):
+        weights = [1.0 / math.pow(r, s) for r in range(1, n + 1)]
+        total = sum(weights)
+        acc, cdf = 0.0, []
+        for w in weights:
+            acc += w / total
+            cdf.append(acc)
+        self.cdf = cdf
+
+    def rank(self, rng: random.Random) -> int:
+        return bisect.bisect_left(self.cdf, rng.random())
+
+
+# ---- the worker (one process, one connection, open loop) -------------------
+
+
+def _reservoir(samples: list[float], count: int, v: float,
+               rng: random.Random) -> None:
+    if len(samples) < LAT_CAP:
+        samples.append(v)
+    else:
+        j = rng.randrange(count)
+        if j < LAT_CAP:
+            samples[j] = v
+
+
+def run_worker(cfg: dict) -> dict:
+    rng = random.Random(cfg["seed"])
+    zipf = Zipf(cfg["keys"], cfg["zipf_s"])
+    region = cfg["region"].encode()
+    conn = _Conn(cfg["host"], cfg["port"], timeout=120.0)
+    sent = {READ: 0, WRITE: 0}
+    ok = {READ: 0, WRITE: 0}
+    busy = {READ: 0, WRITE: 0}
+    err = {READ: 0, WRITE: 0}
+    lat = {READ: [], WRITE: []}
+    nlat = {READ: 0, WRITE: 0}
+    pending: deque = deque()
+    done_sending = threading.Event()
+    fail: list[str] = []
+
+    # warmup exclusion, standard open-loop practice (wrk2's --latency
+    # discards its calibration window the same way): the first seconds
+    # of an overload phase are the hysteresis streak plus the standing
+    # backlog it admits before declaring — by design, not steady state.
+    # Counters (sent/ok/busy) still cover the whole phase; only the
+    # latency reservoir starts after warmup.
+    warm_until = [0.0]
+
+    def recv() -> None:
+        try:
+            while True:
+                if not pending:
+                    if done_sending.is_set():
+                        return
+                    time.sleep(0.001)
+                    continue
+                reply = conn.read_reply()
+                cls, t_sched = pending.popleft()
+                dt = time.monotonic() - t_sched
+                is_err = isinstance(reply, tuple) and reply[0] == "err"
+                if is_err and reply[1].startswith("BUSY"):
+                    busy[cls] += 1
+                elif is_err:
+                    err[cls] += 1
+                else:
+                    ok[cls] += 1
+                    if t_sched >= warm_until[0]:
+                        nlat[cls] += 1
+                        _reservoir(lat[cls], nlat[cls], dt * 1e3, rng)
+        except (OSError, RuntimeError) as e:
+            fail.append(f"receiver: {e}")
+
+    rx = threading.Thread(target=recv, daemon=True)
+    rx.start()
+
+    interval = 1.0 / cfg["rate"]
+    t0 = time.monotonic()
+    warm_until[0] = t0 + cfg.get("warmup_s", 0.0)
+    end = t0 + cfg["duration_s"]
+    i = 0
+    try:
+        while True:
+            t_sched = t0 + i * interval
+            if t_sched >= end or fail:
+                break
+            now = time.monotonic()
+            if t_sched > now:
+                time.sleep(min(t_sched - now, 0.005))
+                continue
+            if rng.random() < cfg["region_frac"] and region:
+                key = b"%s:k%d" % (region, zipf.rank(rng))
+            else:
+                key = b"k%d" % zipf.rank(rng)
+            if rng.random() < cfg["read_frac"]:
+                cls, payload = READ, _pack([b"GCOUNT", b"GET", key])
+            else:
+                cls, payload = WRITE, _pack(
+                    [b"SESSION", b"WRAP", b"GCOUNT", b"INC", key, b"1"]
+                )
+            # enqueue BEFORE the (possibly blocking) send: the reply
+            # can arrive while sendall is parked on TCP backpressure
+            pending.append((cls, t_sched))
+            sent[cls] += 1
+            conn.sock.sendall(payload)
+            i += 1
+    except OSError as e:
+        fail.append(f"sender: {e}")
+    done_sending.set()
+    rx.join(timeout=cfg["duration_s"] + 60.0)
+    conn.close()
+    return {
+        "sent": sent, "ok": ok, "busy": busy, "err": err,
+        "lat_ms": lat, "failures": fail,
+    }
+
+
+# ---- parent: calibration, phases, metrics deltas ---------------------------
+
+
+def _metrics_overload(host: str, port: int) -> dict[str, int]:
+    c = _Conn(host, port, timeout=30.0)
+    try:
+        lines = c.call(b"SYSTEM", b"METRICS")
+    finally:
+        c.close()
+    out: dict[str, int] = {}
+    for raw in lines if isinstance(lines, list) else []:
+        if isinstance(raw, bytes) and raw.startswith(b"OVERLOAD "):
+            _, key, val = raw.decode().split(" ", 2)
+            out[key] = int(val)
+    return out
+
+
+def calibrate(host: str, port: int, procs: int, seconds: float,
+              read_frac: float) -> float:
+    """OPEN-loop capacity probe at the workload mix: a rate ladder
+    (x1.5 per rung, ``seconds`` per rung) of short in-process open-loop
+    runs, stopping at the first rung where the p99 from SCHEDULED send
+    time breaches ``_PROBE_P99_MS`` or the node refuses/errs — i.e. the
+    first rung the node cannot actually sustain. Returns the last
+    sustained rate.
+
+    A closed-loop probe is the obvious alternative and is WRONG here:
+    batched request/reply pipelining keeps the whole stream on the
+    native serving path, measuring a ceiling 2-3x above what the same
+    mix sustains open-loop (where backlog routes commands through the
+    per-command Python path). Calibrating against it declares overload
+    at 1x and the drill's baseline phase is meaningless."""
+    del seconds  # rung length is fixed; kept for CLI compat
+    rate = 400.0 * procs
+    good = rate / 1.5
+    for _ in range(14):
+        results = _inline_open_loop(
+            host, port, procs, rate, _PROBE_S, read_frac
+        )
+        lat = sorted(
+            v for r in results for cls in (READ, WRITE)
+            for v in r["lat_ms"][cls]
+        )
+        bad = (
+            any(r["failures"] for r in results)
+            or sum(r["busy"][c] for r in results for c in (READ, WRITE)) > 0
+            or sum(r["err"][c] for r in results for c in (READ, WRITE)) > 0
+            or not lat
+            or _pctl(lat, 0.99) > _PROBE_P99_MS
+        )
+        if bad:
+            break
+        good = rate
+        rate *= 1.5
+        time.sleep(0.3)  # let the probe's tail drain before the next rung
+    return good
+
+
+_PROBE_S = 2.0
+_PROBE_P99_MS = 15.0
+
+
+def _inline_open_loop(host, port, procs, total_rate, duration_s,
+                      read_frac):
+    """``procs`` open-loop workers as in-process threads (calibration
+    only — the measured phases use real worker processes)."""
+    results: list[dict] = [None] * procs  # type: ignore[list-item]
+
+    def drive(idx: int) -> None:
+        results[idx] = run_worker({
+            "host": host, "port": port, "rate": total_rate / procs,
+            "duration_s": duration_s, "seed": 1000 + idx,
+            "keys": 64, "zipf_s": 0.99, "read_frac": read_frac,
+            "region": "", "region_frac": 0.0,
+        })
+
+    threads = [
+        threading.Thread(target=drive, args=(i,)) for i in range(procs)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return [r for r in results if r is not None]
+
+
+def _pctl(sorted_ms: list[float], q: float) -> float:
+    if not sorted_ms:
+        return 0.0
+    idx = min(len(sorted_ms) - 1, int(q * len(sorted_ms)))
+    return sorted_ms[idx]
+
+
+def _merge_phase(results: list[dict], mult: float, offered: float,
+                 od: dict) -> dict:
+    agg: dict = {
+        "mult": mult, "offered_rate": round(offered, 1),
+        "sent": {READ: 0, WRITE: 0}, "ok": {READ: 0, WRITE: 0},
+        "busy": {READ: 0, WRITE: 0}, "err": {READ: 0, WRITE: 0},
+        "failures": [],
+    }
+    lat = {READ: [], WRITE: []}
+    for r in results:
+        for k in ("sent", "ok", "busy", "err"):
+            for cls in (READ, WRITE):
+                agg[k][cls] += r[k][cls]
+        for cls in (READ, WRITE):
+            lat[cls].extend(r["lat_ms"][cls])
+        agg["failures"].extend(r["failures"])
+    agg["shed_frac"] = {
+        cls: round(agg["busy"][cls] / max(agg["sent"][cls], 1), 4)
+        for cls in (READ, WRITE)
+    }
+    agg["lat_ms"] = {}
+    for cls in (READ, WRITE):
+        s = sorted(lat[cls])
+        agg["lat_ms"][cls] = {
+            "n": len(s),
+            "p50": round(_pctl(s, 0.50), 3),
+            "p99": round(_pctl(s, 0.99), 3),
+            "p999": round(_pctl(s, 0.999), 3),
+        }
+    agg["overload_delta"] = od
+    return agg
+
+
+def run_phases(args) -> dict:
+    base = args.base_rate
+    if base <= 0:
+        cap = calibrate(args.host, args.port, args.procs, args.calib_s,
+                        args.read_frac)
+        base = max(cap * CALIB_FRAC, float(args.procs))
+    mults = [float(m) for m in args.mults.split(",")]
+    phases = []
+    for mult in mults:
+        offered = base * mult
+        # quiesce: don't let the previous phase's declared overload /
+        # standing backlog bleed into this phase's baseline. Exiting
+        # takes EXIT_STREAK consecutive calm samples and these polls
+        # are the only traffic feeding the state machine — poll fast
+        # so the streak can complete inside the window (the first poll
+        # after the idle gap resets the stale EWMA, admission.py).
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            if _metrics_overload(args.host, args.port).get("state", 0) == 0:
+                break
+            time.sleep(0.05)
+        # then go quiet past the idle-reset window: the phase's first
+        # admitted command starts the EWMA fresh (admission.py
+        # IDLE_RESET_S) instead of averaging against quiesce residue
+        time.sleep(1.5)
+        before = _metrics_overload(args.host, args.port)
+        procs = []
+        for w in range(args.procs):
+            cfg = {
+                "host": args.host, "port": args.port,
+                "rate": offered / args.procs,
+                "duration_s": args.phase_s,
+                "warmup_s": args.warmup_s,
+                "seed": args.seed + w + int(mult * 1000),
+                "keys": args.keys, "zipf_s": args.zipf_s,
+                "read_frac": args.read_frac,
+                "region": args.region, "region_frac": args.region_frac,
+            }
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--worker", json.dumps(cfg)],
+                    stdout=subprocess.PIPE,
+                )
+            )
+        results = []
+        for p in procs:
+            stdout, _ = p.communicate(timeout=args.phase_s + 120)
+            if p.returncode != 0:
+                raise RuntimeError(f"worker failed rc={p.returncode}")
+            results.append(json.loads(stdout))
+        after = _metrics_overload(args.host, args.port)
+        od = {
+            k: after.get(k, 0) - before.get(k, 0)
+            for k in sorted(set(before) | set(after))
+            if k not in ("state", "ewma_us", "inflight", "queued_bytes")
+        }
+        od["state_after"] = after.get("state", 0)
+        phases.append(_merge_phase(results, mult, offered, od))
+    return {
+        "base_rate": round(base, 1),
+        "procs": args.procs,
+        "phase_s": args.phase_s,
+        "read_frac": args.read_frac,
+        "zipf_s": args.zipf_s,
+        "phases": phases,
+    }
+
+
+# ---- smoke (make ci) --------------------------------------------------------
+
+_SMOKE_SPAWN = (
+    "import jax; jax.config.update('jax_platforms','cpu'); "
+    "import sys; from jylis_tpu.main import main; main(sys.argv[1:])"
+)
+
+
+def smoke() -> dict:
+    """Boot a throwaway node with the forced-shed failpoint on a hit
+    budget, run a micro two-phase open loop, and assert the recorded
+    shape: served ops in both phases, BUSY refusals recorded as shed
+    (not errors), and latency percentiles present."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    node = subprocess.Popen(
+        [sys.executable, "-c", _SMOKE_SPAWN, "--port", str(port),
+         "--addr", "127.0.0.1:9999:lg", "--log-level", "warn",
+         "--admission-policy", "control>read>write>bulk",
+         "--failpoints", "admission.shed=error:40"],
+        cwd=repo, env=env,
+    )
+    try:
+        deadline = time.time() + 120
+        while True:
+            try:
+                _Conn("127.0.0.1", port, timeout=5.0).close()
+                break
+            except OSError:
+                if node.poll() is not None or time.time() > deadline:
+                    raise RuntimeError("smoke node never came up")
+                time.sleep(0.3)
+        args = argparse.Namespace(
+            host="127.0.0.1", port=port, procs=2, phase_s=1.0,
+            mults="1,4", base_rate=300.0, calib_s=0.0, keys=32,
+            zipf_s=0.99, read_frac=0.7, region="", region_frac=0.0,
+            seed=7, warmup_s=0.0,
+        )
+        out = run_phases(args)
+    finally:
+        node.terminate()
+        node.wait(timeout=60)
+    assert len(out["phases"]) == 2, out
+    total_ok = sum(
+        p["ok"][c] for p in out["phases"] for c in (READ, WRITE)
+    )
+    total_busy = sum(
+        p["busy"][c] for p in out["phases"] for c in (READ, WRITE)
+    )
+    assert total_ok > 100, f"barely served: {out}"
+    assert total_busy > 0, f"forced-shed failpoint never refused: {out}"
+    assert all(
+        p["err"][c] == 0 for p in out["phases"] for c in (READ, WRITE)
+    ), f"BUSY must bucket as shed, not error: {out}"
+    assert out["phases"][0]["lat_ms"][READ]["p99"] > 0.0
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=6379)
+    ap.add_argument("--procs", type=int, default=4)
+    ap.add_argument("--phase-s", type=float, default=10.0)
+    ap.add_argument("--mults", default="1,2,4",
+                    help="comma list of offered-load multipliers")
+    ap.add_argument("--base-rate", type=float, default=0.0,
+                    help="ops/s at 1x; 0 = calibrate to "
+                         f"{CALIB_FRAC:.0%} of the open-loop probe "
+                         "ladder's last sustainable rung")
+    ap.add_argument("--calib-s", type=float, default=2.0)
+    ap.add_argument("--warmup-s", type=float, default=1.0,
+                    help="per-phase seconds excluded from the latency "
+                         "reservoir (the hysteresis entry transient); "
+                         "counters still cover the whole phase")
+    ap.add_argument("--keys", type=int, default=512)
+    ap.add_argument("--zipf-s", type=float, default=0.99)
+    ap.add_argument("--read-frac", type=float, default=0.7)
+    ap.add_argument("--region", default="",
+                    help="home region for the regional key skew")
+    ap.add_argument("--region-frac", type=float, default=0.0,
+                    help="fraction of ops on <region>:-prefixed keys")
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--worker", default="",
+                    help=argparse.SUPPRESS)  # internal re-exec
+    args = ap.parse_args(argv)
+    if args.worker:
+        json.dump(run_worker(json.loads(args.worker)), sys.stdout)
+        return 0
+    if args.smoke:
+        out = smoke()
+        print(json.dumps(out, indent=1))
+        print("loadgen smoke OK")
+        return 0
+    print(json.dumps(run_phases(args), indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,277 @@
+"""Overload armor units (jylis_tpu/admission.py).
+
+The classifier (including the satellite fix: SESSION WRAP/READ inherit
+the INNER command's class instead of smuggling writes past shedding as
+control), the policy-spec parser, the hysteresis state machine driven
+by synthetic done() observations, the queued-bytes hard bound, and the
+forced-shed failpoint's control immunity. All pure units — the spawned
+end-to-end overload behavior lives in tests/test_client.py and the
+chaos drill."""
+
+import pytest
+
+from jylis_tpu import faults
+from jylis_tpu.admission import (
+    BULK,
+    CONTROL,
+    ENTER_STREAK,
+    EXIT_SHED_QUIET_S,
+    EXIT_STREAK,
+    READ,
+    SEVERE_FACTOR,
+    WRITE,
+    AdmissionController,
+    PolicySpecError,
+    busy_reply,
+    classify,
+    parse_policy,
+)
+from jylis_tpu.obs.registry import MetricsRegistry
+
+
+# ---- classification ---------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "cmd,want",
+    [
+        ([b"GCOUNT", b"GET", b"k"], READ),
+        ([b"GCOUNT", b"INC", b"k", b"1"], WRITE),
+        ([b"TREG", b"SET", b"k", b"v", b"1"], WRITE),
+        ([b"TENSOR", b"SET", b"k", b"3", b"1", b"2", b"3"], BULK),
+        ([b"TENSOR", b"MRG", b"k", b"3", b"1", b"2", b"3"], BULK),
+        ([b"UJSON", b"SET", b"k", b"{}"], BULK),
+        ([b"UJSON", b"GET", b"k"], READ),
+        ([b"TLOG", b"TRIM", b"k", b"4"], BULK),
+        ([b"TLOG", b"SIZE", b"k"], READ),
+        ([b"SYSTEM", b"METRICS"], CONTROL),
+        ([b"SYSTEM", b"DIGEST"], CONTROL),
+        ([b"SESSION", b"TOKEN"], CONTROL),
+        ([b"SESSION"], CONTROL),
+        ([b"NOPE"], READ),  # unknown word: cheap help render
+        ([], READ),
+    ],
+)
+def test_classify_basic(cmd, want):
+    assert classify(cmd) == want
+
+
+def test_session_wrap_inherits_inner_class():
+    """The satellite fix, pinned: the --admission-cap seed classified by
+    first word only, so SESSION WRAP <write> rode the control lane past
+    shedding. The node-wide classifier must unwrap."""
+    assert classify([b"SESSION", b"WRAP", b"GCOUNT", b"INC", b"k", b"1"]) \
+        == WRITE
+    assert classify([b"SESSION", b"WRAP", b"TENSOR", b"SET", b"k", b"1",
+                     b"7"]) == BULK
+    assert classify([b"SESSION", b"WRAP", b"GCOUNT", b"GET", b"k"]) == READ
+    # SESSION READ <token> <cmd> inherits too (token is opaque bytes)
+    assert classify([b"SESSION", b"READ", b"\x01tok", b"GCOUNT", b"GET",
+                     b"k"]) == READ
+    assert classify([b"SESSION", b"READ", b"\x01tok", b"GCOUNT", b"INC",
+                     b"k", b"1"]) == WRITE
+    # nesting unwraps (bounded), malformed wrapping stays control
+    assert classify([b"SESSION", b"WRAP", b"SESSION", b"WRAP", b"GCOUNT",
+                     b"INC", b"k", b"1"]) == WRITE
+    assert classify([b"SESSION", b"WRAP"]) == CONTROL
+    assert classify([b"SESSION", b"READ", b"\x01tok"]) == CONTROL
+    # the wrapped control plane is still control
+    assert classify([b"SESSION", b"WRAP", b"SYSTEM", b"DIGEST"]) == CONTROL
+
+
+# ---- policy parsing ---------------------------------------------------------
+
+
+def test_parse_policy_defaults_and_options():
+    p = parse_policy("")
+    assert not p["enabled"]
+    p = parse_policy("control>read>write>bulk")
+    assert p["enabled"] and p["order"] == (CONTROL, READ, WRITE, BULK)
+    assert p["enter_ms"] == 25.0 and p["depth_hi"] == 128
+    p = parse_policy("control>write>read>bulk,lat=5.5,depth=32,protect=3")
+    assert p["order"] == (CONTROL, WRITE, READ, BULK)
+    assert p["enter_ms"] == 5.5 and p["depth_hi"] == 32 and p["protect"] == 3
+
+
+@pytest.mark.parametrize(
+    "spec",
+    [
+        "control>read>write",  # missing a class
+        "control>read>write>bulk>bulk",  # duplicate
+        "control>read>write>junk",  # unknown class
+        "control>read>write>bulk,lat",  # option without value
+        "control>read>write>bulk,lat=abc",  # bad float
+        "control>read>write>bulk,zap=1",  # unknown option
+        "control>read>write>bulk,protect=0",  # floor below 1
+        "control>read>write>bulk,protect=4",  # floor past the classes
+    ],
+)
+def test_parse_policy_rejects(spec):
+    with pytest.raises(PolicySpecError):
+        parse_policy(spec)
+
+
+def test_busy_reply_carries_machine_fields():
+    msg = busy_reply(WRITE, 250, "node is shedding this class")
+    assert msg.startswith("BUSY ")
+    assert "class=write" in msg and "retry-after-ms=250" in msg
+
+
+# ---- hysteresis state machine ----------------------------------------------
+
+
+def _drive(adm, n, seconds, cls=READ):
+    for _ in range(n):
+        assert adm.admit(cls) is None
+        adm.done(cls, seconds)
+
+
+def test_overload_enter_exit_hysteresis():
+    reg = MetricsRegistry()
+    adm = AdmissionController("control>read>write>bulk,lat=10", registry=reg)
+    # warm the EWMA calm; a brief pressure burst is NOT an entry
+    _drive(adm, 20, 0.001)
+    assert not adm.overloaded
+    # a full ENTER_STREAK of sustained pressure declares the state once
+    _drive(adm, ENTER_STREAK + 40, 0.050)
+    assert adm.overloaded and adm.enters == 1
+    assert reg.gauges["serving.overload"] == 1.0
+    assert any(
+        e[1] == "serving" and e[2] == "overload_enter" for e in reg.trace.dump()
+    )
+    # while overloaded the bottom rank sheds, protected ranks serve
+    hint = adm.admit(BULK)
+    assert isinstance(hint, int) and hint > 0
+    assert adm.shed[BULK] == 1
+    assert adm.admit(READ) is None
+    adm.done(READ, 0.0)
+    # exit needs EXIT_STREAK CONSECUTIVE calm observations at the
+    # HALVED threshold; zero the EWMA so the count is exact (otherwise
+    # the first ~45 samples just decay it back under the threshold)
+    adm.ewma_ms = 0.0
+    # ... AND a shed-quiet window: that BULK refusal above stamped
+    # _last_shed, so no amount of calm latency exits while refusals
+    # are recent — shedding collapses the latency signal, and exiting
+    # on it re-admits the very flood that caused the overload
+    _drive(adm, EXIT_STREAK + 5, 0.0001)
+    assert adm.overloaded and adm.exits == 0
+    adm._last_shed -= 2 * EXIT_SHED_QUIET_S  # the flood backed off
+    adm.ewma_ms = 0.0
+    _drive(adm, EXIT_STREAK - 1, 0.0001)
+    assert adm.overloaded  # one short of the streak
+    _drive(adm, 1, 0.0001)
+    assert not adm.overloaded and adm.exits == 1
+    assert reg.gauges["serving.overload"] == 0.0
+    assert any(
+        e[1] == "serving" and e[2] == "overload_exit" for e in reg.trace.dump()
+    )
+
+
+def test_severe_overload_sheds_down_to_protect_floor():
+    adm = AdmissionController("control>read>write>bulk,lat=10,protect=2")
+    _drive(adm, ENTER_STREAK + 40, 0.015)  # mild: past lat, not severe
+    assert adm.overloaded
+    assert adm.admit(WRITE) is None  # mild sheds bulk only
+    adm.done(WRITE, 0.015)
+    assert isinstance(adm.admit(BULK), int)
+    # pump the EWMA past SEVERE_FACTOR x enter_ms: writes shed too,
+    # the protected ranks (control, read) still never shed by state
+    _drive(adm, 200, (10.0 * SEVERE_FACTOR / 1e3) * 1.5)
+    assert adm.ewma_ms >= 10.0 * SEVERE_FACTOR
+    assert isinstance(adm.admit(WRITE), int)
+    assert adm.admit(READ) is None
+    adm.done(READ, 0.0)
+    assert adm.admit(CONTROL) is None
+    adm.done(CONTROL, 0.0)
+
+
+def test_enter_streak_is_consecutive_not_cumulative():
+    """Pressure observations must be a STREAK: one calm observation in
+    between resets the count, so 2x(streak-1) interleaved hot samples
+    never declare overload. Driven by the depth signal (no EWMA memory
+    to bleed across observations)."""
+    adm = AdmissionController("control>read>write>bulk,lat=1000,depth=4")
+    for round_ in range(2):
+        for _ in range(4):  # park 4: depth pressure from here on
+            assert adm.admit(WRITE) is None
+        for _ in range(ENTER_STREAK - 1):
+            adm.admit(READ)
+            adm.done(READ, 0.0)
+        assert adm._hot == ENTER_STREAK - 1 and not adm.overloaded
+        for _ in range(4):  # release: the next observation is calm
+            adm.done(WRITE, 0.0)
+        assert adm._hot == 0, f"streak must reset (round {round_})"
+    assert not adm.overloaded and adm.enters == 0
+
+
+def test_depth_signal_alone_can_enter():
+    adm = AdmissionController("control>read>write>bulk,lat=1000,depth=4")
+    for _ in range(6):  # park 6 in flight, no completions yet
+        assert adm.admit(WRITE) is None
+    for _ in range(ENTER_STREAK):
+        adm.admit(READ)
+        adm.done(READ, 0.0)  # timing off: depth signal still runs
+    assert adm.overloaded
+
+
+# ---- queued-bytes hard bound ------------------------------------------------
+
+
+def test_queue_bytes_bound_sheds_every_class():
+    reg = MetricsRegistry()
+    adm = AdmissionController(queue_bytes=1000, registry=reg)
+    assert adm.armed and not adm.enabled
+    adm.note_conn_queued(1, 600)
+    adm.note_conn_queued(2, 300)
+    assert adm.queued_bytes == 900
+    assert adm.admit(CONTROL) is None  # under the cap: everything admits
+    adm.done(CONTROL, 0.0)
+    adm.note_conn_queued(2, 600)
+    assert adm.queued_bytes == 1200
+    assert reg.gauges["serving.queued_bytes"] == 1200.0
+    # past the cap the bound outranks priority: even control is refused
+    for cls in (CONTROL, READ, WRITE, BULK):
+        assert isinstance(adm.admit(cls), int)
+        assert adm.shed[cls] == 1
+    # accounting is incremental, and a dropped connection releases it
+    adm.note_conn_queued(1, 100)
+    assert adm.queued_bytes == 700
+    adm.drop_conn(2)
+    assert adm.queued_bytes == 100
+    assert adm.admit(BULK) is None
+    adm.done(BULK, 0.0)
+
+
+# ---- the forced-shed failpoint ----------------------------------------------
+
+
+def test_forced_shed_spares_only_the_top_rank():
+    adm = AdmissionController("control>read>write>bulk")
+    for cls, shed in ((CONTROL, False), (READ, True), (WRITE, True),
+                      (BULK, True)):
+        got = adm.admit(cls, forced=True)
+        assert (got is not None) == shed
+        if not shed:
+            adm.done(cls, 0.0)
+
+
+def test_gate_consults_admission_shed_failpoint():
+    import asyncio
+
+    from jylis_tpu.admission import gate
+
+    async def drive():
+        adm = AdmissionController("control>read>write>bulk")
+        faults.reset()
+        try:
+            faults.arm_spec("admission.shed=error")
+            assert isinstance(await gate(adm, WRITE), int)
+            assert adm.shed[WRITE] == 1
+            assert await gate(adm, CONTROL) is None  # control immune
+            adm.done(CONTROL, 0.0)
+        finally:
+            faults.reset()
+        assert await gate(adm, WRITE) is None  # disarmed: admitted again
+        adm.done(WRITE, 0.0)
+
+    asyncio.run(drive())

@@ -1624,3 +1624,141 @@ def test_chaos_bridge_sigkill_fails_over_within_bound():
         for p in procs:
             if p.poll() is None:
                 stop_node(p)
+
+
+@pytest.mark.chaos
+def test_chaos_overload_plus_bridge_sigkill_protected_class_serves():
+    """This PR's drill cell: a member under FORCED full shedding
+    (``admission.shed=error`` failpoint, unbounded — every sheddable
+    class refused, the sustained-overload regime without needing to
+    saturate the box) while the region's bridge is SIGKILLed
+    mid-traffic. The armor contract under compound failure: wrapped
+    writes get typed BUSY refusals the whole time (never an accept the
+    node can't honor), the protected control plane answers SYSTEM
+    METRICS throughout — including during the failover window — raw
+    native-path writes (which bypass the Python dispatch gate by
+    design) keep serving and heal cross-region through the successor,
+    the survivors digest-match, and sync_full_dumps stays zero."""
+    import signal as _signal
+
+    from procutil import connect_client, free_port, spawn_node, stop_node
+
+    hb = 0.2
+    demote = 8
+    ports = [free_port() for _ in range(3)]
+    cports = sorted(free_port() for _ in range(3))
+    seed = f"127.0.0.1:{cports[0]}:aye"
+    extra = [
+        "--heartbeat-time", str(hb), "--bridge-demote-ticks", str(demote),
+    ]
+    pa = spawn_node(ports[0], cports[0], "aye", "--region", "r1", *extra)
+    pb = spawn_node(
+        ports[1], cports[1], "bee", "--region", "r1",
+        "--seed-addrs", seed,
+        "--admission-policy", "control>read>write>bulk",
+        "--failpoints", "admission.shed=error",
+        *extra,
+    )
+    pc = spawn_node(
+        ports[2], cports[2], "sea", "--region", "r2",
+        "--seed-addrs", seed, *extra,
+    )
+    procs = [pa, pb, pc]
+    try:
+        ca = connect_client(ports[0], proc=pa)
+        cb = connect_client(ports[1], proc=pb)
+        cc = connect_client(ports[2], proc=pc)
+
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if (
+                _metric(ca, b"CLUSTER", b"bridge_is_self") == 1
+                and _metric(cc, b"CLUSTER", b"bridge_is_self") == 1
+                and _metric(cb, b"CLUSTER", b"bridge_is_self") == 0
+            ):
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("regions never settled to sparse policy")
+
+        # the forced-shed member refuses wrapped writes with the TYPED
+        # reply (class + machine-readable retry hint), and the shed
+        # counter in the OVERLOAD section records each refusal
+        from jylis_tpu.client import ResponseError
+
+        shed0 = _metric(cb, b"OVERLOAD", b"shed_write") or 0
+        for _ in range(10):
+            try:
+                cb.execute_command(
+                    "SESSION", "WRAP", "GCOUNT", "INC", "wrapped", "1"
+                )
+            except ResponseError as e:
+                msg = str(e)
+                assert msg.startswith("BUSY"), msg
+                assert "class=write" in msg, msg
+                assert "retry-after-ms=" in msg, msg
+            else:
+                raise AssertionError("forced shed admitted a wrapped write")
+        assert (_metric(cb, b"OVERLOAD", b"shed_write") or 0) >= shed0 + 10
+
+        # raw native-path writes bypass the gate by design: traffic
+        # keeps flowing and converging while the node refuses the rest
+        cb.execute_command("GCOUNT", "INC", "warm", "1")
+        while cc.execute_command("GCOUNT", "GET", "warm") != 1:
+            assert time.time() < deadline, "relay path never converged"
+            time.sleep(0.05)
+
+        # SIGKILL the bridge mid-traffic, with the member still under
+        # forced shedding the whole time
+        h0 = _metric(cb, b"CLUSTER", b"bridge_handovers")
+        for _ in range(5):
+            cb.execute_command("GCOUNT", "INC", "traffic", "1")
+        t_kill = time.time()
+        os.kill(pa.pid, _signal.SIGKILL)
+        pa.wait(timeout=30)
+        for _ in range(5):
+            cb.execute_command("GCOUNT", "INC", "traffic", "1")
+
+        # the protected control plane serves DURING the failover
+        # window: SYSTEM METRICS is the probe itself — every _metric
+        # poll below is a control-class command answered by a node
+        # that is refusing its write class
+        bound_s = demote * hb + 10.0
+        while _metric(cb, b"CLUSTER", b"bridge_is_self") != 1:
+            assert time.time() - t_kill < bound_s, (
+                f"no successor within {bound_s:.1f}s of SIGKILL"
+            )
+            time.sleep(0.1)
+        assert _metric(cb, b"CLUSTER", b"bridge_handovers") > h0
+
+        # shedding persists through the failover (the failpoint is
+        # process-local state, untouched by the bridge handover)
+        with pytest.raises(ResponseError, match="^BUSY"):
+            cb.execute_command(
+                "SESSION", "WRAP", "GCOUNT", "INC", "wrapped", "1"
+            )
+
+        # cross-region convergence resumes through the successor
+        cb.execute_command("GCOUNT", "INC", "post", "2")
+        while cc.execute_command("GCOUNT", "GET", "post") != 2:
+            assert time.time() < deadline, "post-failover write stranded"
+            time.sleep(0.05)
+        while cc.execute_command("GCOUNT", "GET", "traffic") != 10:
+            assert time.time() < deadline, "mid-kill traffic never healed"
+            time.sleep(0.05)
+
+        # survivors digest-match and the heal never fell back to a
+        # whole-state dump
+        while True:
+            db = cb.execute_command("SYSTEM", "DIGEST")
+            dc = cc.execute_command("SYSTEM", "DIGEST")
+            if db == dc:
+                break
+            assert time.time() < deadline, (db, dc)
+            time.sleep(0.1)
+        assert _metric(cb, b"CLUSTER", b"sync_full_dumps") == 0
+        assert _metric(cc, b"CLUSTER", b"sync_full_dumps") == 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                stop_node(p)

@@ -838,10 +838,11 @@ def test_real_native_surface_is_python_subset():
     manifest = json.load(open(jlint.MANIFEST_PATH))
     assert manifest["python_only"] == {
         # TYPES is SYSTEM DIGEST TYPES' selector literal (the per-type
-        # digest breakdown), extracted as its own oracle-only word
+        # digest breakdown), extracted as its own oracle-only word;
+        # TOPOLOGY is the cluster-aware client's discovery surface
         "SYSTEM": [
-            "DIGEST", "GETLOG", "LATENCY", "METRICS", "TRACE", "TYPES",
-            "VERSION",
+            "DIGEST", "GETLOG", "LATENCY", "METRICS", "TOPOLOGY",
+            "TRACE", "TYPES", "VERSION",
         ],
         "TENSOR": ["GET", "MRG", "SET"],
         "TLOG": ["CLR", "TRIM", "TRIMAT"],
