@@ -105,9 +105,13 @@ metrics-smoke:
 # tiny in-process pass over the open-loop load harness (scripts/loadgen.py
 # — the worker protocol, Zipfian key draw, phase ladder, reservoir
 # percentiles, BUSY/shed accounting against a real armed node) so the
-# plumbing behind the overload-shed numbers can't rot between re-records
+# plumbing behind the overload-shed numbers can't rot between re-records.
+# The per-phase JSON artifact (throughput, refusals, full log2 latency
+# histogram per class) lands in loadgen_phases.json; both CI configs
+# upload it so load-shape drift is diffable like lint_findings.json
 loadgen-smoke:
-	JAX_PLATFORMS=cpu $(PY) scripts/loadgen.py --smoke
+	JAX_PLATFORMS=cpu $(PY) scripts/loadgen.py --smoke \
+		--out loadgen_phases.json
 
 test:
 	$(PY) -m pytest tests/ -x -q

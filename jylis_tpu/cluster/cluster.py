@@ -38,6 +38,7 @@ from collections import deque
 
 from .. import faults
 from .. import sessions as sessions_mod
+from ..obs import jtrace
 from ..obs.trace import now_ms
 from ..ops.p2set import P2Set
 from ..utils.address import Address
@@ -459,6 +460,19 @@ class Cluster:
         self._drive_flush = drive_flush
         self.flush_sink = None
         self.on_push = None
+        # ---- provenance spans (schema v11, obs/jtrace.py) --------------
+        # 1-in-N sequenced flushes get a trace span minted at
+        # broadcast_deltas (0 disables). `last_span` exposes the span of
+        # the most recent broadcast so the lane tee (lanes.py) can carry
+        # the SAME chain onto the external mesh without widening the
+        # broadcast_deltas signature tests and jlint pin. `relay_hop` is
+        # the hop tag relay_deltas stamps — HOP_RELAY for a plain
+        # bridge, overridden by lanes.py/main.py wiring so the bus and
+        # the external cluster legs are distinguishable in a chain.
+        self._trace_sample = max(0, getattr(config, "trace_sample", 0))
+        self._trace_n = 0
+        self.last_span = b""
+        self.relay_hop = jtrace.HOP_RELAY
         # the node's PRIMARY cluster view owns the shared observability
         # names (cluster.rtt histogram, converge_lag_ms/backlog_ms
         # gauges, SYSTEM METRICS CLUSTER section). On lane 0 the
@@ -1535,9 +1549,12 @@ class Cluster:
             # transport seqs that downstream receivers can never
             # observe under this rid, so transport-keyed watermarks
             # would park forever one relay hop out (review find).
+            if msg.span:
+                self._fold_span(msg.span)
             fresh = self._note_session(conn.peer_srid, msg.oseq)
             await self._relay_fresh(
-                fresh, conn.peer_srid, msg.oseq, msg.name, msg.batch
+                fresh, conn.peer_srid, msg.oseq, msg.name, msg.batch,
+                msg.span,
             )
             return
         if isinstance(msg, MsgRelayPush):
@@ -1551,9 +1568,12 @@ class Cluster:
             self._send(conn, MsgDeltaAck(self._track_seq(conn, msg.seq)))
             await self._database.converge_async((msg.name, list(msg.batch)))
             self._record_push_lag(conn, origin_ms)
+            if msg.span:
+                self._fold_span(msg.span)
             fresh = self._note_session(msg.origin, msg.oseq)
             await self._relay_fresh(
-                fresh, msg.origin, msg.oseq, msg.name, msg.batch
+                fresh, msg.origin, msg.oseq, msg.name, msg.batch,
+                msg.span,
             )
             return
         if isinstance(msg, MsgRegionGossip):
@@ -1803,8 +1823,28 @@ class Cluster:
             return False
         return self._sessions.note_applied(origin, seq)
 
+    # ---- provenance spans (schema v11) -------------------------------------
+
+    def _fold_span(self, span: bytes) -> None:
+        """Fold one arrived provenance chain into the registry's span
+        stats, stamped with THIS replica's apply hop. Called after the
+        converge completes (the chain measures applied, not received).
+        A malformed span counts and is dropped — it rides inside the
+        CRC-covered frame, so garbage here means a peer bug, and the
+        frame's deltas have already converged regardless. Every lane
+        folds into the shared registry (SpanStats is locked), so the
+        node-level SLO covers all lanes without aggregator math."""
+        if not self._reg.enabled:
+            return
+        worst = self._reg.spans.ingest(
+            span, self._srid, self._region, self._clock.now_ms()
+        )
+        if worst is not None:
+            self._reg.trace_event("jtrace", "worst_span", "", worst)
+
     async def _relay_fresh(
-        self, fresh: bool, origin: str | None, oseq: int, name: str, batch
+        self, fresh: bool, origin: str | None, oseq: int, name: str, batch,
+        span: bytes = b"",
     ) -> None:
         """Bridge re-export of one first-sight sequenced batch. Lane
         bridge: the on_push hook hands it to the sibling mesh instance.
@@ -1835,9 +1875,9 @@ class Cluster:
         except faults.FaultError:
             return
         if relay_lane:
-            self.on_push(origin, oseq, name, list(batch))
+            self.on_push(origin, oseq, name, list(batch), span)
         if relay_region:
-            self.relay_deltas(origin, oseq, (name, list(batch)))
+            self.relay_deltas(origin, oseq, (name, list(batch)), span)
 
     async def flush_now(self) -> None:
         """Token minting's flush barrier (sessions.SessionIndex.bind):
@@ -2273,8 +2313,24 @@ class Cluster:
         self._delta_seq += 1
         self._own_seq += 1
         seq = self._delta_seq
+        # provenance sampling (schema v11): every Nth sequenced flush
+        # carries a span minted here — the chain every later hop
+        # appends to. `last_span` stays set (or cleared) until the next
+        # sequenced flush so the lane tee can read it synchronously.
+        span = b""
+        if self._trace_sample > 0:
+            self._trace_n += 1
+            if self._trace_n >= self._trace_sample:
+                self._trace_n = 0
+                span = jtrace.append_hop(
+                    b"", jtrace.HOP_ORIGIN, self._srid, self._region,
+                    self._clock.now_ms(),
+                )
+        self.last_span = span
         data = self._wire(
-            codec.encode(MsgSeqPush(seq, self._own_seq, name, tuple(batch)))
+            codec.encode(
+                MsgSeqPush(seq, self._own_seq, name, tuple(batch), span)
+            )
         )
         if self._owns_session:
             # every local write in this batch is now sequenced: the
@@ -2287,7 +2343,8 @@ class Cluster:
         self._ship_sequenced(seq, data)
         return self._srid, self._own_seq
 
-    def relay_deltas(self, origin: str, oseq: int, deltas) -> None:
+    def relay_deltas(self, origin: str, oseq: int, deltas,
+                     span: bytes = b"") -> None:
         """Re-export one first-sight sequenced batch into THIS mesh
         with origin attribution preserved (lane bridge: called by the
         sibling instance's on_push / the tee; region bridge:
@@ -2295,13 +2352,22 @@ class Cluster:
         sequenced path — the frame takes this sender's next seq, rides
         the delta log, is acked and retransmitted — so receivers'
         per-sender contiguity survives bridge fan-out; only the session
-        watermark semantics differ (the ORIGIN's, carried verbatim)."""
+        watermark semantics differ (the ORIGIN's, carried verbatim).
+        A sampled span gets this hop's stamp appended (`relay_hop` —
+        bus/cluster/relay depending on which leg this instance is)."""
         name, batch = deltas
         self._delta_seq += 1
         seq = self._delta_seq
         self._stats["relays_sent"] += 1
+        if span:
+            span = jtrace.append_hop(
+                span, self.relay_hop, self._srid, self._region,
+                self._clock.now_ms(),
+            )
         data = self._wire(
-            codec.encode(MsgRelayPush(seq, origin, oseq, name, tuple(batch)))
+            codec.encode(
+                MsgRelayPush(seq, origin, oseq, name, tuple(batch), span)
+            )
         )
         self._ship_sequenced(seq, data)
 

@@ -62,7 +62,7 @@ from .msg import (
     MsgSyncRequest,
 )
 
-SCHEMA_VERSION = 10
+SCHEMA_VERSION = 11
 
 # The canonical schema text: any change to the wire format MUST change this
 # string (bump SCHEMA_VERSION), which changes the signature, which makes
@@ -150,6 +150,18 @@ SCHEMA_VERSION = 10
 # region or lane verifies in another). msg12 gossips {addr -> region}
 # on the announce cadence so dial policy can classify addresses it
 # never met.
+# v11: provenance spans — transport-only like v8/v10 (delta lines
+# unchanged, so delta_signature() is UNCHANGED from v9 and every
+# snapshot/journal loads as-is). msg7 and msg11 gain ``span``, a
+# length-prefixed opaque trace chain (obs/jtrace.py wire format:
+# tag/len-framed hop stamps, appended per hop) minted for 1-in-N
+# sequenced flushes (--trace-sample) and empty otherwise — the
+# unsampled cost is ONE length byte. The span sits in the prefix
+# (after oseq, before name) so msg7/msg11's name+batch bytes remain
+# msg3's after the prefix and the native codec fast path keeps serving
+# both; receivers fold arrived chains into per-hop and per-region-pair
+# convergence histograms and the converge_slo gauges. Retransmits
+# replay the originally wired bytes, original stamps included.
 _SCHEMA_TEXT = f"""jylis-tpu cluster schema v{SCHEMA_VERSION}
 varint=LEB128 bytes=varint-len-prefixed str=utf8-bytes
 wire=frame(crc32(origin_ms:u64be body):u32be origin_ms:u64be body)
@@ -164,11 +176,11 @@ msg3=PushDeltas(name:str batch:[(key:bytes delta)])
 msg4=SyncRequest(digests:[bytes] order=TREG,TLOG,GCOUNT,PNCOUNT,UJSON,TENSOR,MAP,BCOUNT svec)
 msg5=SyncDone(svec match-only)
 msg6=DeltaAck(cum:varint)
-msg7=SeqPush(seq:varint oseq:varint name:str batch:[(key:bytes delta)])
+msg7=SeqPush(seq:varint oseq:varint span:bytes name:str batch:[(key:bytes delta)])
 msg8=DigestTree(name:str leaves:[(bucket:varint digest:bytes)] fanout=256 bucket=sha256(key)[0])
 msg9=RangeRequest(name:str buckets:[varint])
 msg10=IntervalReset(seq:varint)
-msg11=RelayPush(seq:varint origin:str oseq:varint name:str batch:[(key:bytes delta)])
+msg11=RelayPush(seq:varint origin:str oseq:varint span:bytes name:str batch:[(key:bytes delta)])
 msg12=RegionGossip(regions:[(addr:str region:str epoch:varint)])
 delta/TREG=(value:bytes ts:varint)
 delta/TLOG=delta/SYSTEM=(entries:[(value:bytes ts:varint)] cutoff:varint)
@@ -763,6 +775,7 @@ def encode(msg: Msg) -> bytes:
             out = bytearray((_TAG_SEQ_PUSH,))
             _w_varint(out, msg.seq)
             _w_varint(out, msg.oseq)
+            _w_bytes(out, msg.span)
             out += fast[1:]
             return bytes(out)
     elif isinstance(msg, MsgRelayPush):
@@ -776,6 +789,7 @@ def encode(msg: Msg) -> bytes:
             _w_varint(out, msg.seq)
             _w_str(out, msg.origin)
             _w_varint(out, msg.oseq)
+            _w_bytes(out, msg.span)
             out += fast[1:]
             return bytes(out)
     return _encode_oracle(msg)
@@ -814,6 +828,7 @@ def _encode_oracle(msg: Msg) -> bytes:
         out.append(_TAG_SEQ_PUSH)
         _w_varint(out, msg.seq)
         _w_varint(out, msg.oseq)
+        _w_bytes(out, msg.span)
         _w_str(out, msg.name)
         _w_varint(out, len(msg.batch))
         for key, delta in msg.batch:
@@ -840,6 +855,7 @@ def _encode_oracle(msg: Msg) -> bytes:
         _w_varint(out, msg.seq)
         _w_str(out, msg.origin)
         _w_varint(out, msg.oseq)
+        _w_bytes(out, msg.span)
         _w_str(out, msg.name)
         _w_varint(out, len(msg.batch))
         for key, delta in msg.batch:
@@ -875,10 +891,11 @@ def decode(body: bytes) -> Msg:
         oseq = r.varint()
         if seq > _U64_MAX or oseq > _U64_MAX:
             raise CodecError("seq exceeds u64")
+        span = r.bytes_()
         rest = bytes((_TAG_PUSH,)) + body[r.pos :]
         fast = ncodec.decode_push(rest)
         inner = fast if fast is not None else _decode_oracle(rest)
-        return MsgSeqPush(seq, oseq, inner.name, inner.batch)
+        return MsgSeqPush(seq, oseq, inner.name, inner.batch, span)
     elif body and body[0] == _TAG_RELAY_PUSH:
         # same trick for the relay: strip tag+seq+origin+oseq, decode
         # the remainder as msg3, re-tag
@@ -891,10 +908,11 @@ def decode(body: bytes) -> Msg:
         oseq = r.varint()
         if seq > _U64_MAX or oseq > _U64_MAX:
             raise CodecError("relay seq exceeds u64")
+        span = r.bytes_()
         rest = bytes((_TAG_PUSH,)) + body[r.pos :]
         fast = ncodec.decode_push(rest)
         inner = fast if fast is not None else _decode_oracle(rest)
-        return MsgRelayPush(seq, origin, oseq, inner.name, inner.batch)
+        return MsgRelayPush(seq, origin, oseq, inner.name, inner.batch, span)
     return _decode_oracle(body)
 
 
@@ -928,11 +946,12 @@ def _decode_oracle(body: bytes) -> Msg:
         oseq = r.varint()
         if seq > _U64_MAX or oseq > _U64_MAX:
             raise CodecError("seq exceeds u64")
+        span = r.bytes_()
         name = r.str_()
         batch = tuple(
             (r.bytes_(), _r_delta(r, name)) for _ in range(r.varint())
         )
-        msg = MsgSeqPush(seq, oseq, name, batch)
+        msg = MsgSeqPush(seq, oseq, name, batch, span)
     elif tag == _TAG_DIGEST_TREE:
         name = r.str_()
         leaves = tuple(
@@ -951,11 +970,12 @@ def _decode_oracle(body: bytes) -> Msg:
         oseq = r.varint()
         if seq > _U64_MAX or oseq > _U64_MAX:
             raise CodecError("relay seq exceeds u64")
+        span = r.bytes_()
         name = r.str_()
         batch = tuple(
             (r.bytes_(), _r_delta(r, name)) for _ in range(r.varint())
         )
-        msg = MsgRelayPush(seq, origin, oseq, name, batch)
+        msg = MsgRelayPush(seq, origin, oseq, name, batch, span)
     elif tag == _TAG_REGION_GOSSIP:
         entries = []
         for _ in range(r.varint()):

@@ -87,12 +87,19 @@ class MsgSeqPush:
     receivers AND transitively through any number of relay hops, where
     the intermediate bridges' transport-seq consumption is invisible.
     The transport machinery (acks, retransmit, _recv_cum) stays on
-    ``seq``."""
+    ``seq``.
+
+    Schema v11: also carries ``span``, a sampled provenance trace
+    (obs/jtrace.py — empty for the 1-in-N complement, one length byte
+    on the wire). Transport-only like oseq: the delta signature is
+    untouched. Declared LAST with a default so every positional
+    construction (and the golden corpus) predating v11 stays valid."""
 
     seq: int
     oseq: int
     name: str
     batch: tuple  # tuple[(key: bytes, delta), ...]
+    span: bytes = b""
 
 
 @dataclass(frozen=True)
@@ -192,13 +199,18 @@ class MsgRelayPush:
     hop: receivers advance their session vector for the ORIGIN, which
     is what lets a session token minted in one region verify in
     another. name+batch bytes are msg3's after the prefix (native codec
-    fast path serves the relay hot path too)."""
+    fast path serves the relay hot path too).
+
+    Schema v11: carries ``span`` like MsgSeqPush — the relaying bridge
+    appends its own hop stamp to the origin's chain before re-export,
+    which is what makes the WAN leg visible in SYSTEM TRACE SPANS."""
 
     seq: int
     origin: str
     oseq: int
     name: str
     batch: tuple  # tuple[(key: bytes, delta), ...]
+    span: bytes = b""
 
 
 @dataclass(frozen=True)

@@ -56,6 +56,7 @@ import signal
 import subprocess
 import sys
 
+from .obs import jtrace
 from .obs.prom import MetricsHTTP
 from .utils.address import Address, fnv1a64
 from .utils.net import free_port
@@ -135,6 +136,10 @@ def bus_config(config, lane_id: int):
     # (review find). Across SUPERVISOR restarts the ports (and so the
     # rids) change anyway, which is safe by construction.
     cfg.data_dir = config.data_dir
+    # the bus is where a lane's sequenced flushes originate, so the
+    # operator's provenance sample rate must reach it (a fresh Config
+    # would silently reset it to the default)
+    cfg.trace_sample = config.trace_sample
     cfg.log = config.log
     return cfg
 
@@ -176,16 +181,19 @@ def wire_bridge(bus, external) -> None:
     def tee(deltas) -> None:
         origin, oseq = bus.broadcast_deltas(deltas)
         if origin is not None:
-            external.relay_deltas(origin, oseq, deltas)
+            # carry the bus flush's sampled span (schema v11) onto the
+            # external leg: last_span is set synchronously by the
+            # broadcast above, so the SAME chain crosses both meshes
+            external.relay_deltas(origin, oseq, deltas, bus.last_span)
         else:
             # content-free keepalives: the broadcast path's own
             # unsequenced branch handles them
             external.broadcast_deltas(deltas)
 
     def relay_to(other):
-        def relay(origin, oseq, name, batch) -> None:
+        def relay(origin, oseq, name, batch, span=b"") -> None:
             if origin is not None:
-                other.relay_deltas(origin, oseq, (name, batch))
+                other.relay_deltas(origin, oseq, (name, batch), span)
             else:
                 # relayed SYNC data (rejoin heals, range repairs):
                 # UNSEQUENCED on purpose — re-originating it as
@@ -199,6 +207,11 @@ def wire_bridge(bus, external) -> None:
     bus.flush_sink = tee
     bus.on_push = relay_to(external)
     external.on_push = relay_to(bus)
+    # hop-tag the two legs so a chain reads origin -> bus -> cluster
+    # (obs/jtrace.py): the bus instance's relays are the intra-node
+    # lane fan-out, the external instance's are the WAN leg
+    bus.relay_hop = jtrace.HOP_BUS
+    external.relay_hop = jtrace.HOP_CLUSTER
 
 
 class LaneClusters:
@@ -472,10 +485,15 @@ _SAMPLE_RE = re.compile(
 
 # families whose samples are counters and therefore sum across lanes
 # into the aggregate (no lane label) series; quantile summaries and
-# gauges stay per-lane only — summing a p99 is not a p99
+# gauges stay per-lane only — summing a p99 is not a p99. Cumulative
+# histogram buckets (`_bucket`) SUM correctly by definition — that is
+# the whole point of exporting them — so the aggregate scrape carries
+# a real fleet-level histogram per seam.
 _SUMMABLE = re.compile(
-    r"(_total$|_count$|_sum$|^jylis_trace_events$)"
+    r"(_total$|_count$|_sum$|_bucket$|^jylis_trace_events$)"
 )
+
+_SLO_OK_RE = re.compile(r'kind="ok_(\d+)"')
 
 
 def aggregate_expositions(bodies: dict[int, str | None]) -> str:
@@ -517,6 +535,21 @@ def aggregate_expositions(bodies: dict[int, str | None]) -> str:
     for (name, labels), v in sorted(sums.items()):
         text = f"{v:.9f}".rstrip("0").rstrip(".") if "." in f"{v:.9f}" else str(v)
         out.append(f"{name}{labels} {text}")
+    # fleet-level convergence SLO: the per-lane jylis_converge_slo
+    # gauges are fractions (not summable), but their ok/sampled
+    # NUMERATORS are counters we just summed — recompute the node-wide
+    # fraction from the aggregate counts, which weights lanes by their
+    # actual sample volume instead of averaging ratios
+    sampled = sums.get(("jylis_converge_slo_total", '{kind="sampled"}'), 0.0)
+    for (name, labels), v in sorted(sums.items()):
+        if name != "jylis_converge_slo_total":
+            continue
+        m = _SLO_OK_RE.search(labels)
+        if m is not None:
+            frac = v / sampled if sampled > 0 else 0.0
+            out.append(
+                f'jylis_converge_slo{{le="{m.group(1)}"}} {frac:.6f}'
+            )
     out.append("# TYPE jylis_lane_up gauge")
     for lane_id in sorted(bodies):
         up = 1 if bodies[lane_id] is not None else 0

@@ -194,6 +194,14 @@ async def run(argv: list[str] | None = None) -> None:
     database.set_admission(
         config.admission_policy, config.admission_queue_bytes
     )
+    # fleet-convergence SLO thresholds for the provenance-span folds
+    # (obs/jtrace.py; validated by config_from_cli, defensive here for
+    # direct Config() drives in tests)
+    database.metrics.spans.set_slo_ms(
+        int(s)
+        for s in getattr(config, "converge_slo_ms", "").split(",")
+        if s.strip()
+    )
     log = config.log
     if lane_id is not None:
         # SYSTEM METRICS' LANE section: which lane this connection

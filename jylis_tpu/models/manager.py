@@ -249,6 +249,23 @@ class RepoManager:
         the journal's writer thread, off the serving path."""
         if self.journal is not None:
             self.journal.append(self.name, batch)
+        if self.registry is not None and self.registry.enabled and batch:
+            # per-digest-tree-bucket write heat: count each flushed key
+            # against its sync_bucket (the SAME sha256(key)[0] the
+            # anti-entropy digest tree shards by, database.py), so
+            # SYSTEM OBSERVE can show where writes concentrate in the
+            # tree — the placement telemetry ROADMAP item 3 needs.
+            # Lazy import: database.py imports this module at load.
+            from .database import sync_bucket
+
+            note = self.registry.note_write_heat
+            for key, _delta in batch:
+                note(
+                    self.name,
+                    sync_bucket(
+                        key if isinstance(key, bytes) else key.encode()
+                    ),
+                )
         self._deltas_fn((self.name, batch))
 
     def converge_deltas(self, batch) -> None:

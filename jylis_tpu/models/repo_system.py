@@ -24,8 +24,10 @@ SYSTEM_HELP = LeafHelp(
     "The following are valid SYSTEM commands:\n"
     "  SYSTEM GETLOG [count]\n"
     "  SYSTEM METRICS\n"
-    "  SYSTEM LATENCY\n"
+    "  SYSTEM LATENCY [WINDOW seconds]\n"
+    "  SYSTEM OBSERVE\n"
     "  SYSTEM TRACE [count]\n"
+    "  SYSTEM TRACE SPANS\n"
     "  SYSTEM DIGEST [TYPES]\n"
     "  SYSTEM TOPOLOGY\n"
     "  SYSTEM VERSION"
@@ -122,11 +124,44 @@ class RepoSYSTEM:
             for line in lines:
                 resp.string(line)
             return False
+        if op == b"LATENCY" and len(args) > 1 and args[1] == b"WINDOW":
+            # windowed quantiles: subtract the deposited mark closest to
+            # <seconds> ago from the live buckets, so a fresh regression
+            # on a long-running node is not drowned by since-boot
+            # history. Marks deposit opportunistically on every scrape /
+            # LATENCY call (rate-limited in the registry) — the first
+            # WINDOW query after boot may report "no window yet".
+            try:
+                want_s = float(need(args, 2))
+            except ValueError:
+                raise ParseError() from None
+            if want_s <= 0:
+                raise ParseError()
+            reg = self._registry()
+            reg.window_deposit()
+            achieved, stats = reg.window_stats(want_s)
+            if stats is None:
+                resp.array_start(1)
+                resp.string(b"no window yet (no mark deposited)")
+                return False
+            lines = [f"window_s {achieved:.1f}"]
+            for name, snap in stats:
+                lines.append(
+                    f"{name} count {snap['count']}"
+                    f" p50_us {snap['p50_s'] * 1e6:.0f}"
+                    f" p90_us {snap['p90_s'] * 1e6:.0f}"
+                    f" p99_us {snap['p99_s'] * 1e6:.0f}"
+                )
+            resp.array_start(len(lines))
+            for line in lines:
+                resp.string(line)
+            return False
         if op == b"LATENCY":
             # the latency histograms as one line per seam (count + p50/
             # p90/p99/max in µs), ALL declared seams — a zero count means
             # the seam exists but has not fired, which is itself signal —
             # plus one line per peer with the convergence-lag EWMA
+            self._registry().window_deposit()  # feed LATENCY WINDOW
             lines = []
             for name, snap in self._registry().seam_stats():
                 lines.append(
@@ -139,6 +174,44 @@ class RepoSYSTEM:
             if self.lag_fn is not None:
                 for peer, ms in sorted(self.lag_fn().items()):
                     lines.append(f"converge_lag_ms peer {peer} {ms:.1f}")
+            resp.array_start(len(lines))
+            for line in lines:
+                resp.string(line)
+            return False
+        if op == b"OBSERVE":
+            # fleet-convergence + placement telemetry in one greppable
+            # view: the --converge-slo-ms attainment fractions (from
+            # sampled provenance spans, obs/jtrace.py) and the per-type
+            # digest-tree write-heat concentration (manager.py _emit) —
+            # which tree buckets absorb the write load, the signal a
+            # future placement policy keys on
+            reg = self._registry()
+            lines = [
+                f"converge sampled {reg.spans.sampled}"
+                f" malformed {reg.spans.malformed}"
+            ]
+            for ms, frac, ok in reg.spans.slo_fracs():
+                lines.append(f"converge_slo ms {ms} frac {frac:.4f} ok {ok}")
+            for name in sorted(reg.write_heat):
+                heat = reg.write_heat[name]
+                total = sum(heat)
+                top = sorted(
+                    range(len(heat)), key=heat.__getitem__, reverse=True
+                )[:4]
+                hot = " ".join(f"{b}:{heat[b]}" for b in top if heat[b])
+                lines.append(
+                    f"write_heat {name} total {total} top {hot or '-'}"
+                )
+            resp.array_start(len(lines))
+            for line in lines:
+                resp.string(line)
+            return False
+        if op == b"TRACE" and len(args) > 1 and args[1] == b"SPANS":
+            # the folded provenance-span view: sampled/malformed totals,
+            # per-hop-transition and per-region-pair convergence-latency
+            # quantiles, SLO attainment, and the worst-trace exemplar
+            # chains (origin -> relay hops -> apply with per-hop offsets)
+            lines = self._registry().spans.report_lines()
             resp.array_start(len(lines))
             for line in lines:
                 resp.string(line)

@@ -61,6 +61,23 @@ SEAMS = (
     "journal.fsync",
     "cluster.rtt",
     "cluster.converge_lag",
+    # the serving-pipeline profiler (server.py): per-stage timers on
+    # the RESP path, so ROADMAP item 1's socket-tax attribution is a
+    # measured per-stage split instead of one bench-derived ratio.
+    # Stage semantics (docs/observability.md): accept = connection
+    # setup (one sample per conn), read = one socket read await
+    # (includes client idle — meaningful under saturation), parse =
+    # one Python-path command parse, classify = admission classify +
+    # gate (armed nodes only), dispatch = command settle on either
+    # path (native bursts reuse the native_burst elapsed — no extra
+    # clock read on the hot path), reply_write = one buffered write
+    # flush to the transport.
+    "pipeline.accept",
+    "pipeline.read",
+    "pipeline.parse",
+    "pipeline.classify",
+    "pipeline.dispatch",
+    "pipeline.reply_write",
 )
 
 # Node-wide gauges (per-peer convergence lag lives on the Cluster and

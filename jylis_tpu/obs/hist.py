@@ -52,16 +52,7 @@ class Histogram:
         """The q-quantile (0 < q <= 1) in SECONDS: the upper bound of
         the bucket holding the ceil(q * count)-th sample, 0.0 when
         empty."""
-        n = self.count
-        if n == 0:
-            return 0.0
-        target = q * n
-        cum = 0
-        for i, c in enumerate(self.buckets):
-            cum += c
-            if cum >= target:
-                return 0.0 if i == 0 else float(1 << i) * 1e-9
-        return float(1 << (N_BUCKETS - 1)) * 1e-9  # racing counts: clamp
+        return percentile_of(self.buckets, self.count, q)
 
     def snapshot(self) -> dict:
         """One consistent-enough view for the reporting surfaces:
@@ -74,3 +65,45 @@ class Histogram:
             "p90_s": self.percentile(0.90),
             "p99_s": self.percentile(0.99),
         }
+
+    def mark(self) -> tuple:
+        """A cheap point-in-time copy for windowed (delta-since-mark)
+        quantiles: (buckets copy, count, total)."""
+        return (list(self.buckets), self.count, self.total)
+
+    def snapshot_since(self, marked: tuple) -> dict:
+        """snapshot() over only the samples recorded AFTER ``marked``
+        (a prior mark() of this histogram). Since-boot buckets are
+        monotone, so the bucket-wise difference IS the window's
+        histogram. No max_s: the since-boot max can't be windowed."""
+        mbuckets, mcount, mtotal = marked
+        buckets = [a - b for a, b in zip(self.buckets, mbuckets)]
+        count = self.count - mcount
+        return {
+            "count": count,
+            "sum_s": self.total - mtotal,
+            "p50_s": percentile_of(buckets, count, 0.50),
+            "p90_s": percentile_of(buckets, count, 0.90),
+            "p99_s": percentile_of(buckets, count, 0.99),
+        }
+
+
+def percentile_of(buckets: list, count: int, q: float) -> float:
+    """The quantile walk over an arbitrary bucket vector (shared by the
+    live histogram and windowed bucket differences)."""
+    if count <= 0:
+        return 0.0
+    target = q * count
+    cum = 0
+    for i, c in enumerate(buckets):
+        cum += c
+        if cum >= target:
+            return 0.0 if i == 0 else float(1 << i) * 1e-9
+    return float(1 << (N_BUCKETS - 1)) * 1e-9  # racing counts: clamp
+
+
+def bucket_upper_seconds(i: int) -> float:
+    """Bucket i's inclusive upper bound in seconds — the Prometheus
+    ``le`` label for the cumulative `_bucket` exposition (bucket 0 is
+    the exact-zero bucket; its bound is 0)."""
+    return 0.0 if i == 0 else ((1 << i) - 1) * 1e-9

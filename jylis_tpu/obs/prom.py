@@ -20,6 +20,14 @@ from __future__ import annotations
 import asyncio
 
 from ..utils.net import ipv4_port
+from .hist import N_BUCKETS, bucket_upper_seconds
+
+# the `le` label per log2 bucket, precomputed once (bucket 0 is the
+# exact-zero bucket; the last bucket is the clamp bucket and its upper
+# bound is only nominal — +Inf carries the true total)
+_LE_LABELS = tuple(
+    f"{bucket_upper_seconds(i):.10g}" for i in range(N_BUCKETS)
+)
 
 
 def _esc(v: str) -> str:
@@ -113,12 +121,72 @@ def render(database) -> str:
             f'jylis_seam_latency_seconds_sum{{seam="{seam}"}} {snap["sum_s"]:.9f}'
         )
 
+    # the same seams as REAL cumulative histograms (satellite of the
+    # jtrace round): quantile gauges above are convenient but opaque to
+    # PromQL — histogram_quantile()/Grafana need `_bucket` series, and
+    # cumulative bucket counters sum correctly across lanes where a
+    # quantile never does. Distinct family name: one family cannot be
+    # both summary and histogram.
+    out.append(
+        "# HELP jylis_seam_latency_log2_seconds The same log2 seam "
+        "histograms as cumulative Prometheus buckets."
+    )
+    out.append("# TYPE jylis_seam_latency_log2_seconds histogram")
+    for name in reg.hists:
+        seam = _esc(name)
+        h = reg.hists[name]
+        cum = 0
+        for i, c in enumerate(h.buckets):
+            cum += c
+            out.append(
+                f'jylis_seam_latency_log2_seconds_bucket{{seam="{seam}"'
+                f',le="{_LE_LABELS[i]}"}} {cum}'
+            )
+        # +Inf and _count both use the bucket sum (not h.count) so the
+        # family is self-consistent even mid-race with a recorder
+        out.append(
+            f'jylis_seam_latency_log2_seconds_bucket{{seam="{seam}"'
+            f',le="+Inf"}} {cum}'
+        )
+        out.append(
+            f'jylis_seam_latency_log2_seconds_count{{seam="{seam}"}} {cum}'
+        )
+        out.append(
+            f'jylis_seam_latency_log2_seconds_sum{{seam="{seam}"}}'
+            f" {h.total:.9f}"
+        )
+
+    # fleet convergence SLOs (obs/jtrace.py): the fraction of sampled
+    # deltas fully applied within each --converge-slo-ms threshold,
+    # plus the raw counters the lane aggregator re-derives node-wide
+    # fractions from (fractions are not summable; counts are)
+    out.append(
+        "# HELP jylis_converge_slo Fraction of sampled deltas applied "
+        "within le milliseconds end to end."
+    )
+    out.append("# TYPE jylis_converge_slo gauge")
+    slo = reg.spans.slo_fracs()
+    for ms, frac, _ in slo:
+        out.append(f'jylis_converge_slo{{le="{ms}"}} {frac:.6f}')
+    out.append("# TYPE jylis_converge_slo_total counter")
+    out.append(
+        f'jylis_converge_slo_total{{kind="sampled"}} {reg.spans.sampled}'
+    )
+    out.append(
+        f'jylis_converge_slo_total{{kind="malformed"}} {reg.spans.malformed}'
+    )
+    for ms, _, ok in slo:
+        out.append(f'jylis_converge_slo_total{{kind="ok_{ms}"}} {ok}')
+
     out.append("# HELP jylis_gauge Node-wide observability gauges.")
     out.append("# TYPE jylis_gauge gauge")
     for name, v in sorted(reg.gauges.items()):
         out.append(f'jylis_gauge{{name="{_esc(name)}"}} {v:.3f}')
 
     out.append(f"jylis_trace_events {len(reg.trace)}")
+    # a scrape is a natural (rate-limited) deposit point for the
+    # windowed-quantile marks SYSTEM LATENCY WINDOW subtracts against
+    reg.window_deposit()
     return "\n".join(out) + "\n"
 
 

@@ -26,13 +26,23 @@ manifest descriptions in lockstep.
 
 from __future__ import annotations
 
-from collections import defaultdict
+import time
+from collections import defaultdict, deque
 
 from . import GAUGES, SEAMS
 from .hist import Histogram
+from .jtrace import SpanStats
 from .trace import TraceRing
 
 JOURNAL_KEYS = ("appends", "bytes", "fsyncs", "replayed_batches", "errors")
+
+# windowed-quantile marks: how many point-in-time seam copies we keep,
+# and the minimum spacing between deposits (an opportunistic deposit on
+# every scrape/SYSTEM LATENCY call must not grow cost with poll rate)
+WINDOW_MARKS = 64
+WINDOW_MIN_SPACING_S = 1.0
+
+HEAT_FANOUT = 256  # digest-tree leaf fanout (models/database.py SYNC_FANOUT)
 
 
 class MetricsRegistry:
@@ -59,6 +69,15 @@ class MetricsRegistry:
         self.hists: dict[str, Histogram] = {name: Histogram() for name in SEAMS}
         self.gauges: dict[str, float] = {name: 0.0 for name in GAUGES}
         self.trace = TraceRing(trace_cap)
+        # provenance-span folds (obs/jtrace.py): per-hop + per-region-
+        # pair convergence histograms, SLO counters, worst exemplars
+        self.spans = SpanStats()
+        # per-digest-tree-bucket write heat: type -> 256 counters over
+        # sha256(key)[0], counted where deltas are emitted (manager.py
+        # _emit) — the placement telemetry ROADMAP item 3 needs
+        self.write_heat: dict[str, list[int]] = {}
+        # windowed quantiles: (monotonic ts, {seam: Histogram.mark()})
+        self._window_marks: deque = deque(maxlen=WINDOW_MARKS)
 
     # ---- counters ----------------------------------------------------------
 
@@ -76,6 +95,15 @@ class MetricsRegistry:
 
     def note_serving(self, counter: str, n: int = 1) -> None:
         self.serving_counters[counter] += n
+
+    def note_write_heat(self, name: str, bucket: int, n: int = 1) -> None:
+        """One emitted delta batch touched ``bucket`` of ``name``'s
+        digest tree (0..255). Lazy per-type vectors: a type that never
+        writes costs nothing."""
+        heat = self.write_heat.get(name)
+        if heat is None:
+            heat = self.write_heat[name] = [0] * HEAT_FANOUT
+        heat[bucket] += n
 
     # ---- histograms / gauges / trace --------------------------------------
 
@@ -109,6 +137,44 @@ class MetricsRegistry:
         """(name, snapshot) per declared seam, SEAMS order."""
         for name in SEAMS:
             yield name, self.hists[name].snapshot()
+
+    # ---- windowed quantiles ------------------------------------------------
+
+    def window_deposit(self) -> None:
+        """Opportunistically deposit a point-in-time mark of every seam
+        (called from the reporting surfaces — SYSTEM LATENCY, the
+        scrape — never the hot path). Rate-limited so poll frequency
+        can't inflate the cost; the ring keeps ~the last minute."""
+        now = time.monotonic()
+        if self._window_marks and (
+            now - self._window_marks[-1][0] < WINDOW_MIN_SPACING_S
+        ):
+            return
+        self._window_marks.append(
+            (now, {name: self.hists[name].mark() for name in SEAMS})
+        )
+
+    def window_stats(self, seconds: float):
+        """(achieved_window_s, [(name, delta_snapshot), ...]) against
+        the deposited mark closest to ``seconds`` ago — delta-since-mark
+        quantiles, so a regression on a long-running node isn't drowned
+        by since-boot history. Returns (0.0, None) when no mark is old
+        enough to subtract (callers report 'no window yet')."""
+        if not self._window_marks:
+            return 0.0, None
+        now = time.monotonic()
+        best = min(
+            self._window_marks,
+            key=lambda m: abs((now - m[0]) - seconds),
+        )
+        achieved = now - best[0]
+        if achieved <= 0.0:
+            return 0.0, None
+        marks = best[1]
+        return achieved, [
+            (name, self.hists[name].snapshot_since(marks[name]))
+            for name in SEAMS
+        ]
 
     def report(self) -> str:
         parts = [

@@ -44,6 +44,19 @@ class Server:
         self._reg = database.metrics
         self._h_burst = self._reg.hist("server.native_burst")
         self._h_py = self._reg.hist("server.py_dispatch")
+        # serving-pipeline profiler (obs/): per-stage timers across the
+        # whole RESP path, so the socket tax bench.py can only report as
+        # one ratio (socket_cost_frac) is attributable stage by stage.
+        # Each record is gated on the registry's `enabled` flag at the
+        # seam, and the dispatch stage REUSES the burst/py elapsed above
+        # rather than reading the clock again — the native hot path pays
+        # zero additional perf_counter calls for the profiler.
+        self._h_accept = self._reg.hist("pipeline.accept")
+        self._h_read = self._reg.hist("pipeline.read")
+        self._h_parse = self._reg.hist("pipeline.parse")
+        self._h_classify = self._reg.hist("pipeline.classify")
+        self._h_dispatch = self._reg.hist("pipeline.dispatch")
+        self._h_reply_write = self._reg.hist("pipeline.reply_write")
 
     async def start(self) -> None:
         try:
@@ -85,6 +98,11 @@ class Server:
             # this writer yet, and wait_closed would wait on it forever
             writer.close()
             return
+        reg = self._reg
+        # pipeline.accept: one sample per connection, handler entry to
+        # first read — the setup cost a new client pays before its first
+        # command can even be parsed
+        t_acc = time.perf_counter() if reg.enabled else 0.0
         # jlint: blocking-ok — lib() is memoised at boot (warmup builds
         # an auto-engine Database before serving starts), so this never
         # reaches the loader's listdir/compile path on the loop
@@ -102,7 +120,10 @@ class Server:
 
         def flush(bound: int = 0) -> None:
             if len(out) > bound:
+                t_w = time.perf_counter() if reg.enabled else 0.0
                 writer.write(bytes(out))
+                if t_w:
+                    self._h_reply_write.record(time.perf_counter() - t_w)
                 out.clear()
 
         engine = getattr(self._database, "native_engine", None)
@@ -111,8 +132,18 @@ class Server:
         self._conns.add(writer)
         try:
             adm_armed = self._database.admission.armed
+            if t_acc:
+                self._h_accept.record(time.perf_counter() - t_acc)
             while True:
+                # pipeline.read: one socket read await. Deliberately
+                # includes client idle time — under saturation this IS
+                # the kernel-queue wait, and an idle connection's long
+                # reads land in the top buckets where windowed quantiles
+                # (SYSTEM LATENCY WINDOW) can separate them from load.
+                t_rd = time.perf_counter() if reg.enabled else 0.0
                 data = await reader.read(1 << 16)
+                if t_rd:
+                    self._h_read.record(time.perf_counter() - t_rd)
                 if not data:
                     break
                 # the overload signal's arrival stamp: queue time for
@@ -157,7 +188,18 @@ class Server:
                         data = b""  # demoted: tail already moved into parser
                 parser.append(data)
                 try:
-                    for cmd in parser:
+                    # pipeline.parse: manual next() so each Python-path
+                    # command parse is timed individually; RespError
+                    # still propagates to the handler below exactly as
+                    # the for-loop form raised it
+                    it = iter(parser)
+                    while True:
+                        t_ps = time.perf_counter() if reg.enabled else 0.0
+                        cmd = next(it, None)
+                        if t_ps:
+                            self._h_parse.record(time.perf_counter() - t_ps)
+                        if cmd is None:
+                            break
                         await self._dispatch_py(resp, cmd, writer, out, t_arr)
                         flush(1 << 16)  # bound the reply buffer mid-burst
                 except RespError as e:
@@ -202,8 +244,14 @@ class Server:
                 id(writer),
                 writer.transport.get_write_buffer_size() + len(out),
             )
+            # pipeline.classify: the admission toll per command on an
+            # armed node — classify plus the gate's token walk, timed
+            # for refusals and admissions alike
+            t_cl = time.perf_counter() if self._reg.enabled else 0.0
             cls = admission_mod.classify(cmd)
             hint = await admission_mod.gate(adm, cls)
+            if t_cl:
+                self._h_classify.record(time.perf_counter() - t_cl)
             if hint is not None:
                 resp.err(
                     admission_mod.busy_reply(
@@ -224,11 +272,14 @@ class Server:
             adm.done(cls, t1 - (t_arr or t0))
             if self._reg.enabled:
                 self._h_py.record(t1 - t0)
+                self._h_dispatch.record(t1 - t0)
             return
         t0 = time.perf_counter() if self._reg.enabled else 0.0
         await self._database.apply_async(resp, cmd)
         if t0:
-            self._h_py.record(time.perf_counter() - t0)
+            el = time.perf_counter() - t0
+            self._h_py.record(el)
+            self._h_dispatch.record(el)
 
     # the engine's changed-counter order (serve_engine.cpp scan_apply2)
     _ENGINE_TYPES = ("GCOUNT", "PNCOUNT", "TREG", "TLOG", "UJSON")
@@ -289,12 +340,22 @@ class Server:
                         engine.scan_apply(buf)
                     )
                     if t0:
-                        self._h_burst.record(time.perf_counter() - t0)
+                        # pipeline.dispatch reuses the burst elapsed —
+                        # one engine call settles the whole burst and
+                        # the profiler must not add clock reads here
+                        el = time.perf_counter() - t0
+                        self._h_burst.record(el)
+                        self._h_dispatch.record(el)
                 except faults.FaultError:
                     return demote()
                 if replies:
                     flush()  # deferred-command replies precede these
+                    t_w = time.perf_counter() if self._reg.enabled else 0.0
                     writer.write(replies)
+                    if t_w:
+                        self._h_reply_write.record(
+                            time.perf_counter() - t_w
+                        )
                 for mgr, ch in zip(mgrs, changed):
                     if ch:
                         mgr._maybe_proactive_flush()

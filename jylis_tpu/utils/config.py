@@ -82,6 +82,15 @@ class Config:
     # extension: opt-in Prometheus text-exposition endpoint (obs/prom.py);
     # 0 disables, -1 asks for an ephemeral port (logged at boot)
     metrics_port: int = 0
+    # extension: delta provenance tracing (obs/jtrace.py, schema v11) —
+    # one sequenced delta frame in N carries a hop-stamped trace span;
+    # receivers fold spans into per-hop and per-region-pair convergence
+    # histograms (SYSTEM TRACE SPANS). 0 disables minting entirely.
+    trace_sample: int = 16
+    # ... and the fleet-convergence SLO thresholds: the fraction of
+    # sampled deltas fully applied within each of these milliseconds
+    # bounds, exported as the jylis_converge_slo gauge family
+    converge_slo_ms: str = "50,250,1000"
     # extension: multi-lane serving (lanes.py) — N worker processes
     # sharing the RESP port via SO_REUSEPORT, converging over a loopback
     # delta bus. lanes=1 is the classic single-process node; lane_id is
@@ -279,6 +288,24 @@ def config_from_cli(argv: list[str] | None = None, log_out=None) -> Config:
         "ephemeral port (logged at boot); 0 (default) disables.",
     )
     parser.add_argument(
+        "--trace-sample", type=int, default=Config.trace_sample,
+        help="Delta provenance tracing (docs/observability.md): one "
+        "sequenced delta frame in N carries a trace span stamped at "
+        "every hop (origin lane, lane bus, cluster, bridge relay); the "
+        "applying node folds it into per-hop and per-region-pair "
+        "convergence-latency histograms (SYSTEM TRACE SPANS) and the "
+        "convergence SLO gauges. Schema v11 transport field — v10 "
+        "peers interoperate, unsampled frames cost one byte. 0 "
+        "disables minting (received spans still fold).",
+    )
+    parser.add_argument(
+        "--converge-slo-ms", default=Config.converge_slo_ms,
+        help="Comma-separated millisecond thresholds for the "
+        "fleet-convergence SLO gauges: each exports the fraction of "
+        "sampled deltas (see --trace-sample) fully applied within "
+        "that bound end to end (jylis_converge_slo, SYSTEM OBSERVE).",
+    )
+    parser.add_argument(
         "--lanes", default="1",
         help="Serving lanes: N worker processes each owning a full "
         "ServeEngine/Database/journal-segment/metrics stack, sharing "
@@ -347,6 +374,19 @@ def config_from_cli(argv: list[str] | None = None, log_out=None) -> Config:
     config.admission_queue_bytes = args.admission_queue_bytes
     config.failpoints = args.failpoints
     config.metrics_port = args.metrics_port
+    if args.trace_sample < 0:
+        parser.error("--trace-sample must be >= 0")
+    config.trace_sample = args.trace_sample
+    try:
+        slo = [int(s) for s in args.converge_slo_ms.split(",") if s.strip()]
+    except ValueError:
+        slo = None
+    if not slo or any(ms <= 0 for ms in slo):
+        parser.error(
+            "--converge-slo-ms must be comma-separated positive "
+            f"milliseconds: {args.converge_slo_ms!r}"
+        )
+    config.converge_slo_ms = args.converge_slo_ms
     if args.lanes == "auto":
         config.lanes = resolve_auto_lanes()
     else:

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -136,8 +137,8 @@ CLAIMS = [
     # are cheap enough to stay on
     ("README.md", "concurrent", "obs_cost_frac", fmt_percent,
      "histograms on cost {} of recorded", "README obs cost"),
-    ("docs/operations.md", "concurrent", "obs_cost_frac", fmt_percent,
-     "always-on seams cost {} of recorded", "operations doc obs cost"),
+    ("docs/observability.md", "concurrent", "obs_cost_frac", fmt_percent,
+     "always-on seams cost {} of recorded", "observability doc obs cost"),
     # multi-lane round: the sharded record is the scaling artifact —
     # its headline, the lanes-vs-single-lane ratio (vs_baseline), and
     # the single-lane sweep's own 64-conn point, pinned wherever the
@@ -282,11 +283,44 @@ REPO_CLAIMS = [
 ]
 
 
+# claims whose source of truth is a DEFAULT in the source tree (jtrace
+# round: the observability doc quotes the --trace-sample and
+# --converge-slo-ms defaults; changing Config without the prose — or
+# vice versa — must fail here, not ship a lying doc):
+# (file, source file, regex with one group, formatter, template, label)
+SOURCE_CLAIMS = [
+    ("docs/observability.md", "jylis_tpu/utils/config.py",
+     r"trace_sample: int = (\d+)", str,
+     "`--trace-sample N`, default {};", "observability doc trace-sample default"),
+    ("docs/observability.md", "jylis_tpu/utils/config.py",
+     r'converge_slo_ms: str = "([^"]+)"', str,
+     "`--converge-slo-ms {}` (the default)",
+     "observability doc converge-slo default"),
+]
+
+
 def main() -> int:
     with open(os.path.join(ROOT, "BENCH_full.json")) as f:
         record = {row["config"]: row for row in json.load(f)}
     texts = {}
     failures = []
+    for fname, source, pattern, fmt, template, label in SOURCE_CLAIMS:
+        if fname not in texts:
+            with open(os.path.join(ROOT, fname)) as f:
+                texts[fname] = f.read()
+        with open(os.path.join(ROOT, source)) as f:
+            m = re.search(pattern, f.read())
+        if m is None:
+            failures.append(
+                f"  {label}: {source} no longer matches /{pattern}/"
+            )
+            continue
+        expect = template.format(fmt(m.group(1)))
+        if expect not in texts[fname]:
+            failures.append(
+                f"  {label}: {fname} lacks '{expect}' "
+                f"({source} says {m.group(1)})"
+            )
     for fname, source, extract, fmt, template, label in REPO_CLAIMS:
         if fname not in texts:
             with open(os.path.join(ROOT, fname)) as f:
@@ -322,7 +356,8 @@ def main() -> int:
         return 1
     print(
         f"check-prose: {len(CLAIMS)} bench claims + {len(REPO_CLAIMS)} "
-        f"repo-record claims across {len(texts)} files match their records"
+        f"repo-record claims + {len(SOURCE_CLAIMS)} source-default claims "
+        f"across {len(texts)} files match their records"
     )
     return 0
 

@@ -875,6 +875,21 @@ def build_corpus() -> dict:
         u.ctx.cloud = {(3, 9)}
         return u
 
+    def _span_exemplar(one_hop: bool) -> bytes:
+        """A real jtrace chain: origin at a varint-edge timestamp, plus
+        (for the relay unit) a relay stamp — pins the hop framing."""
+        from jylis_tpu.obs import jtrace
+
+        span = jtrace.append_hop(
+            b"", jtrace.HOP_ORIGIN, "h1:6001:n1!7", "eu-west", 128
+        )
+        if not one_hop:
+            span = jtrace.append_hop(
+                span, jtrace.HOP_RELAY, "h2:6002:n2!1", "eu-west",
+                1700000000000,
+            )
+        return span
+
     p2 = P2Set()
     p2.adds = {Address("h1", "6001", "n1"), Address("h2", "6002", "n2")}
     p2.removes = {Address("h3", "6003", "n3")}
@@ -910,6 +925,18 @@ def build_corpus() -> dict:
         # the region gossip map
         "msg/RelayPush": MsgRelayPush(
             128, "h1:6001:n1!7", 127, "GCOUNT", ((b"k1", {1: 10, 2: 20}),)
+        ),
+        # schema v11: the SAME sequenced/relay frames carrying a sampled
+        # provenance span (transport-only field; the span bytes here are
+        # a real two-hop jtrace chain with a varint-edge timestamp, so
+        # the byte pin covers the hop framing too)
+        "msg/SeqPushSpan": MsgSeqPush(
+            128, 127, "GCOUNT", ((b"k1", {1: 10, 2: 20}),),
+            _span_exemplar(one_hop=True),
+        ),
+        "msg/RelayPushSpan": MsgRelayPush(
+            128, "h1:6001:n1!7", 127, "GCOUNT", ((b"k1", {1: 10, 2: 20}),),
+            _span_exemplar(one_hop=False),
         ),
         "msg/RegionGossip": MsgRegionGossip(
             (("h1:6001:n1", "eu-west", 127),

@@ -619,6 +619,11 @@ def _mk_config(
     cfg.bridge_demote_ticks = (1 << 30) if bridge_unsafe else (
         BRIDGE_DEMOTE_MODEL
     )
+    # provenance tracing (schema v11) stays off under the explorer: a
+    # 1-in-N sampling counter in broadcast_deltas would otherwise make
+    # frame bytes depend on global write ordering, multiplying the
+    # explored state space without adding any modeled behavior
+    cfg.trace_sample = 0
     cfg.log = Log.create_none()
     return cfg
 

@@ -353,8 +353,24 @@ def _pctl(sorted_ms: list[float], q: float) -> float:
     return sorted_ms[idx]
 
 
+def _log2_hist(sorted_ms: list[float]) -> list[list[float]]:
+    """``[[upper_ms, count], ...]`` — the full latency distribution as
+    log2 buckets (sample counted under the smallest power-of-two upper
+    bound >= its value), empty buckets dropped. Three percentiles hide
+    bimodality — an admitted-vs-queued split under overload shows as two
+    humps here — and the bucket shape diffs cleanly across CI runs."""
+    counts: dict[float, int] = {}
+    for v in sorted_ms:
+        m, e = math.frexp(max(v, 1e-3))  # clamp: sub-µs is one bucket
+        if m == 0.5:  # exact power of two belongs in its own bucket
+            e -= 1
+        upper = math.ldexp(1.0, e)
+        counts[upper] = counts.get(upper, 0) + 1
+    return [[u, counts[u]] for u in sorted(counts)]
+
+
 def _merge_phase(results: list[dict], mult: float, offered: float,
-                 od: dict) -> dict:
+                 od: dict, phase_s: float) -> dict:
     agg: dict = {
         "mult": mult, "offered_rate": round(offered, 1),
         "sent": {READ: 0, WRITE: 0}, "ok": {READ: 0, WRITE: 0},
@@ -373,6 +389,10 @@ def _merge_phase(results: list[dict], mult: float, offered: float,
         cls: round(agg["busy"][cls] / max(agg["sent"][cls], 1), 4)
         for cls in (READ, WRITE)
     }
+    agg["throughput"] = {
+        cls: round(agg["ok"][cls] / max(phase_s, 1e-9), 1)
+        for cls in (READ, WRITE)
+    }
     agg["lat_ms"] = {}
     for cls in (READ, WRITE):
         s = sorted(lat[cls])
@@ -381,6 +401,7 @@ def _merge_phase(results: list[dict], mult: float, offered: float,
             "p50": round(_pctl(s, 0.50), 3),
             "p99": round(_pctl(s, 0.99), 3),
             "p999": round(_pctl(s, 0.999), 3),
+            "hist_log2_ms": _log2_hist(s),
         }
     agg["overload_delta"] = od
     return agg
@@ -444,7 +465,9 @@ def run_phases(args) -> dict:
             if k not in ("state", "ewma_us", "inflight", "queued_bytes")
         }
         od["state_after"] = after.get("state", 0)
-        phases.append(_merge_phase(results, mult, offered, od))
+        phases.append(
+            _merge_phase(results, mult, offered, od, args.phase_s)
+        )
     return {
         "base_rate": round(base, 1),
         "procs": args.procs,
@@ -542,6 +565,10 @@ def main(argv=None) -> int:
     ap.add_argument("--region-frac", type=float, default=0.0,
                     help="fraction of ops on <region>:-prefixed keys")
     ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--out", default="",
+                    help="also write the per-phase JSON artifact here "
+                         "(sorted keys, trailing newline — diffable "
+                         "across CI runs like lint_findings.json)")
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--worker", default="",
                     help=argparse.SUPPRESS)  # internal re-exec
@@ -549,12 +576,14 @@ def main(argv=None) -> int:
     if args.worker:
         json.dump(run_worker(json.loads(args.worker)), sys.stdout)
         return 0
+    out = smoke() if args.smoke else run_phases(args)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1, sort_keys=True)
+            f.write("\n")
+    print(json.dumps(out, indent=1))
     if args.smoke:
-        out = smoke()
-        print(json.dumps(out, indent=1))
         print("loadgen smoke OK")
-        return 0
-    print(json.dumps(run_phases(args), indent=1))
     return 0
 
 

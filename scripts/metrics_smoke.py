@@ -134,6 +134,60 @@ def _boot_and_scrape(lanes: int) -> str:
             proc.wait(timeout=10)
 
 
+_HIST_FAMILY = "jylis_seam_latency_log2_seconds"
+_HIST_LINE_RE = re.compile(
+    rf"^{_HIST_FAMILY}_(bucket|count)\{{(?P<labels>[^}}]*)\}} (?P<v>\d+)$"
+)
+_LE_RE = re.compile(r'(?:^|,)le="([^"]+)"')
+
+
+def _check_histograms(body: str, failures: list, tag: str,
+                      hists: list[str]) -> int:
+    """Validate the real-histogram exposition grammar: every manifest
+    seam exposes a `_bucket` series whose counts are CUMULATIVE in le
+    order, ends at le="+Inf", and whose `_count` equals the +Inf bucket
+    — the invariants histogram_quantile() silently miscomputes without.
+    Applies per series (so per-lane AND aggregated lane-less series on
+    a lanes scrape are each checked). Returns the series count."""
+    series: dict[str, list[tuple[float, int]]] = {}
+    counts: dict[str, int] = {}
+    for line in body.splitlines():
+        m = _HIST_LINE_RE.match(line)
+        if not m:
+            continue
+        labels, v = m.group("labels"), int(m.group("v"))
+        if m.group(1) == "count":
+            counts[labels] = v
+            continue
+        le = _LE_RE.search(labels)
+        if le is None:
+            failures.append(f"  [{tag}] _bucket without le: {line!r}")
+            continue
+        key = _LE_RE.sub("", labels)
+        series.setdefault(key, []).append((float(le.group(1)), v))
+    for key, pts in series.items():
+        pts.sort()  # by le; float("+Inf") orders it last
+        if pts[-1][0] != float("inf"):
+            failures.append(f"  [{tag}] no le=\"+Inf\" bucket: {key}")
+            continue
+        vals = [v for _, v in pts]
+        if any(b < a for a, b in zip(vals, vals[1:])):
+            failures.append(
+                f"  [{tag}] non-cumulative _bucket series: {key}"
+            )
+        if counts.get(key) != vals[-1]:
+            failures.append(
+                f"  [{tag}] _count != +Inf bucket for: {key}"
+            )
+    for name in hists:
+        want = f'seam="{name}"'
+        if not any(want in key for key in series):
+            failures.append(
+                f"  [{tag}] manifest seam has no _bucket series: {name}"
+            )
+    return len(series)
+
+
 def _check_exposition(body: str, failures: list, tag: str) -> int:
     n_samples = 0
     for line in body.splitlines():
@@ -155,6 +209,7 @@ def main() -> int:
 
     failures = []
     n_samples = _check_exposition(body, failures, "single")
+    n_hist_series = _check_histograms(body, failures, "single", hists)
     for name in hists:
         if f'seam="{name}"' not in body:
             failures.append(f"  manifest histogram absent from scrape: {name}")
@@ -180,6 +235,19 @@ def main() -> int:
     lanes = int(os.environ.get("JYLIS_SMOKE_LANES", "4"))
     lane_body = _boot_and_scrape(lanes=lanes)
     n_lane_samples = _check_exposition(lane_body, failures, f"lanes={lanes}")
+    n_lane_hist = _check_histograms(
+        lane_body, failures, f"lanes={lanes}", hists
+    )
+    # the aggregator must ALSO sum buckets into lane-less series
+    # (cumulative bucket counters sum correctly; quantiles never do)
+    if not any(
+        line.startswith(f"{_HIST_FAMILY}_bucket{{seam=")
+        and 'lane="' not in line
+        for line in lane_body.splitlines()
+    ):
+        failures.append(
+            "  no aggregate (lane-less) _bucket series on the lanes scrape"
+        )
     for k in range(lanes):
         if f'jylis_lane_up{{lane="{k}"}} 1' not in lane_body:
             failures.append(f"  lane {k} not up in the aggregated scrape")
@@ -208,8 +276,10 @@ def main() -> int:
         return 1
     print(
         f"metrics-smoke: {n_samples} valid samples; {len(hists)} histograms"
-        f" + {len(gauges)} gauges all present; lanes={lanes} aggregate "
-        f"scrape: {n_lane_samples} samples, per-lane + aggregate series ok"
+        f" + {len(gauges)} gauges all present; {n_hist_series} cumulative "
+        f"_bucket series valid; lanes={lanes} aggregate scrape: "
+        f"{n_lane_samples} samples, {n_lane_hist} _bucket series, "
+        f"per-lane + aggregate series ok"
     )
     return 0
 

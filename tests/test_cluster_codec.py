@@ -142,14 +142,23 @@ def test_v8_messages_roundtrip_both_paths():
 
 def test_v8_seq_push_matches_push_deltas_after_prefix():
     """The schema pins msg7's name+batch bytes to msg3's after the
-    tag+seq+oseq prefix (v10 added the own-content ordinal) — the
-    property the native fast-path wrapper relies on. Byte-check it
-    directly."""
+    tag+seq+oseq+span prefix (v10 added the own-content ordinal, v11
+    the transport-only trace span) — the property the native fast-path
+    wrapper relies on. Byte-check it directly: an unsampled frame's
+    span is exactly one zero length byte."""
     batch = ((b"k1", {1: 10, 2: 20}), (b"k2", {7: 1}))
     push = codec.encode(MsgPushDeltas("GCOUNT", batch))
     seq_push = codec.encode(MsgSeqPush(5, 3, "GCOUNT", batch))
     assert seq_push[0] == 7 and seq_push[1] == 5 and seq_push[2] == 3
-    assert seq_push[3:] == push[1:]
+    assert seq_push[3] == 0  # empty span = one byte on the wire
+    assert seq_push[4:] == push[1:]
+    # a sampled frame differs ONLY in the span field: delta signatures
+    # and the name+batch suffix are untouched by v11
+    span = b"\x01\x05\x00\x00\x01\x02\x03"
+    stamped = codec.encode(MsgSeqPush(5, 3, "GCOUNT", batch, span))
+    assert stamped[3] == len(span)
+    assert stamped[4:4 + len(span)] == span
+    assert stamped[4 + len(span):] == push[1:]
 
 
 def test_v8_truncation_at_every_byte_is_codec_error():

@@ -839,10 +839,13 @@ def test_real_native_surface_is_python_subset():
     assert manifest["python_only"] == {
         # TYPES is SYSTEM DIGEST TYPES' selector literal (the per-type
         # digest breakdown), extracted as its own oracle-only word;
-        # TOPOLOGY is the cluster-aware client's discovery surface
+        # TOPOLOGY is the cluster-aware client's discovery surface;
+        # OBSERVE/SPANS/WINDOW are the jtrace round's SLO + span-fold +
+        # windowed-quantile views (SPANS and WINDOW are selector words
+        # of SYSTEM TRACE SPANS / SYSTEM LATENCY WINDOW)
         "SYSTEM": [
-            "DIGEST", "GETLOG", "LATENCY", "METRICS", "TOPOLOGY",
-            "TRACE", "TYPES", "VERSION",
+            "DIGEST", "GETLOG", "LATENCY", "METRICS", "OBSERVE",
+            "SPANS", "TOPOLOGY", "TRACE", "TYPES", "VERSION", "WINDOW",
         ],
         "TENSOR": ["GET", "MRG", "SET"],
         "TLOG": ["CLR", "TRIM", "TRIMAT"],

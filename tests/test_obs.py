@@ -376,3 +376,192 @@ def test_journal_seams_record_append_and_fsync(tmp_path):
     assert reg.journal_counters["appends"] == 2
     # per-instance: the process default saw none of it
     assert metrics.DEFAULT.hists["journal.append"].count == default_before
+
+
+# ---- windowed snapshots (SYSTEM LATENCY WINDOW) -----------------------------
+
+
+def test_histogram_mark_and_snapshot_since():
+    h = Histogram()
+    h.record(0.001)
+    h.record(0.002)
+    marked = h.mark()
+    h.record(0.1)
+    delta = h.snapshot_since(marked)
+    assert delta["count"] == 1
+    # the delta's quantiles see ONLY the post-mark sample
+    assert delta["p50_s"] > 0.05
+    # since-boot snapshot unchanged by the mark
+    assert h.snapshot()["count"] == 3
+
+
+def test_registry_window_stats_empty_then_delta():
+    reg = MetricsRegistry()
+    assert reg.window_stats(60.0) == (0.0, None)  # no mark yet
+    reg.hist("journal.append").record(0.001)
+    reg.window_deposit()
+    reg.window_deposit()  # rate-limited: second deposit is dropped
+    assert len(reg._window_marks) == 1
+    reg.hist("journal.append").record(0.05)
+    achieved, stats = reg.window_stats(0.001)
+    assert achieved > 0.0 and stats is not None
+    snap = dict(stats)["journal.append"]
+    assert snap["count"] == 1  # pre-mark sample subtracted
+
+
+def test_system_latency_window_command():
+    import time as _time
+
+    db = Database(identity=31)
+    db.metrics.hist("journal.append").record(0.001)
+    resp = _Resp()
+    db.apply(resp, [b"SYSTEM", b"LATENCY"])  # deposits the first mark
+    _time.sleep(1.1)  # past WINDOW_MIN_SPACING_S so a fresh mark lands
+    db.metrics.hist("journal.append").record(0.002)
+    resp2 = _Resp()
+    db.apply(resp2, [b"SYSTEM", b"LATENCY", b"WINDOW", b"1"])
+    lines = resp2.strings()
+    assert lines[0].startswith("window_s ")
+    (ja,) = [l for l in lines if l.startswith("journal.append ")]
+    # only the post-mark sample: count 1, not 2
+    assert re.fullmatch(
+        r"journal\.append count 1 p50_us \d+ p90_us \d+ p99_us \d+", ja
+    )
+    # bad arguments fall back to the BADCOMMAND help, never a crash
+    for bad in ([b"SYSTEM", b"LATENCY", b"WINDOW"],
+                [b"SYSTEM", b"LATENCY", b"WINDOW", b"nope"],
+                [b"SYSTEM", b"LATENCY", b"WINDOW", b"-3"]):
+        r = _Resp()
+        db.apply(r, bad)
+        assert any(
+            n == "err" and "SYSTEM LATENCY" in a[0] for n, a in r.calls
+        ), bad
+
+
+# ---- Prometheus cumulative _bucket series + converge_slo --------------------
+
+
+def test_prom_bucket_series_cumulative_and_consistent():
+    from jylis_tpu.obs import prom
+
+    db = Database(identity=32)
+    h = db.metrics.hist("journal.append")
+    for s in (0.0001, 0.002, 0.002, 1.5):
+        h.record(s)
+    body = prom.render(db)
+    pat = re.compile(
+        r'jylis_seam_latency_log2_seconds_bucket\{seam="journal\.append"'
+        r',le="([^"]+)"\} (\d+)'
+    )
+    pts = [(float(le), int(v)) for le, v in pat.findall(body)]
+    assert pts, "no _bucket series for an armed seam"
+    les = [le for le, _ in pts]
+    assert les == sorted(les) and les[-1] == float("inf")
+    vals = [v for _, v in pts]
+    assert all(b >= a for a, b in zip(vals, vals[1:]))  # cumulative
+    assert vals[-1] == 4
+    m = re.search(
+        r'jylis_seam_latency_log2_seconds_count\{seam="journal\.append"\}'
+        r" (\d+)", body,
+    )
+    assert m and int(m.group(1)) == 4  # _count == +Inf bucket
+    # every declared seam has a bucket series from boot (zero counts)
+    for seam in SEAMS:
+        assert f'_bucket{{seam="{seam}",le="+Inf"}}' in body
+
+
+def test_prom_converge_slo_families_render():
+    from jylis_tpu.obs import prom
+    from jylis_tpu.obs import jtrace
+
+    db = Database(identity=33)
+    span = jtrace.append_hop(b"", jtrace.HOP_ORIGIN, "n1", "r1", 1000)
+    db.metrics.spans.ingest(span, "n2", "r2", 1020)  # 20ms: under all
+    db.metrics.spans.ingest(b"\xff", "n2", "r2", 0)  # malformed
+    body = prom.render(db)
+    assert 'jylis_converge_slo{le="50"} 1.000000' in body
+    assert 'jylis_converge_slo_total{kind="sampled"} 1' in body
+    assert 'jylis_converge_slo_total{kind="malformed"} 1' in body
+    assert 'jylis_converge_slo_total{kind="ok_50"} 1' in body
+
+
+# ---- serving-pipeline profiler seams ---------------------------------------
+
+
+def test_pipeline_seams_record_over_live_connection():
+    async def main():
+        db = Database(identity=34)
+        burst = (
+            b"GCOUNT INC pk 1\r\nGCOUNT GET pk\r\nSYSTEM VERSION\r\n"
+        )
+        await _drive_server(db, burst, 3)
+        for seam in ("pipeline.accept", "pipeline.read",
+                     "pipeline.dispatch", "pipeline.reply_write"):
+            assert db.metrics.hist(seam).count > 0, seam
+        # accept is one sample per CONNECTION, not per command
+        assert db.metrics.hist("pipeline.accept").count == 1
+        # dispatch mirrors the per-burst/per-command serving seams
+        served = (db.metrics.hist("server.native_burst").count
+                  + db.metrics.hist("server.py_dispatch").count)
+        assert db.metrics.hist("pipeline.dispatch").count == served
+
+    asyncio.run(main())
+
+
+def test_pipeline_parse_seam_times_python_path_commands():
+    """pipeline.parse is a Python-path seam (a native burst parses in
+    C++ inside pipeline.dispatch): force the fallback and each command
+    gets an individually-timed parse."""
+
+    async def main():
+        db = Database(identity=38)
+        db.native_engine = None
+        burst = b"GCOUNT INC pk 1\r\nGCOUNT GET pk\r\nSYSTEM VERSION\r\n"
+        await _drive_server(db, burst, 3)
+        # one timed parse per command, plus the final None probe(s)
+        assert db.metrics.hist("pipeline.parse").count >= 3
+        assert db.metrics.hist("pipeline.dispatch").count == \
+            db.metrics.hist("server.py_dispatch").count
+
+    asyncio.run(main())
+
+
+def test_pipeline_seams_disabled_registry_records_nothing():
+    async def main():
+        db = Database(identity=35)
+        db.metrics.enabled = False
+        await _drive_server(db, b"GCOUNT INC pk 1\r\n", 1)
+        for seam in ("pipeline.accept", "pipeline.read", "pipeline.parse",
+                     "pipeline.classify", "pipeline.dispatch",
+                     "pipeline.reply_write"):
+            assert db.metrics.hist(seam).count == 0, seam
+
+    asyncio.run(main())
+
+
+# ---- write heat -------------------------------------------------------------
+
+
+def test_write_heat_counts_flushed_keys_per_bucket():
+    from jylis_tpu.models.database import sync_bucket
+
+    db = Database(identity=36)
+    flushed = []
+    resp = _Resp()
+    db.apply(resp, [b"GCOUNT", b"INC", b"heat-a", b"1"])
+    db.apply(resp, [b"GCOUNT", b"INC", b"heat-b", b"2"])
+    db.flush_deltas(lambda deltas: flushed.append(deltas))
+    assert flushed
+    heat = db.metrics.write_heat["GCOUNT"]
+    assert sum(heat) == 2
+    assert heat[sync_bucket(b"heat-a")] >= 1
+    assert heat[sync_bucket(b"heat-b")] >= 1
+
+
+def test_write_heat_disabled_registry_counts_nothing():
+    db = Database(identity=37)
+    db.metrics.enabled = False
+    resp = _Resp()
+    db.apply(resp, [b"GCOUNT", b"INC", b"cold", b"1"])
+    db.flush_deltas(lambda deltas: None)
+    assert "GCOUNT" not in db.metrics.write_heat
